@@ -1,0 +1,43 @@
+"""kungfu_tpu — a TPU-native adaptive distributed training framework.
+
+A ground-up re-design of the capabilities of KungFu (reference:
+``srcs/go``, ``srcs/cpp``, ``srcs/python`` of DingtongHan/KungFu-1) for
+TPU hardware:
+
+* the **data plane** (allreduce / broadcast / barrier / allgather) lowers to
+  XLA/ICI collectives via ``jax.lax`` under ``shard_map`` over a
+  ``jax.sharding.Mesh`` — this replaces both the reference's Go TCP/Unix-socket
+  collective engine (reference ``srcs/go/kungfu/session``) and its NCCL
+  subsystem (reference ``srcs/cpp/src/nccl``);
+* the **control plane** (launcher, membership, config server, failure
+  detector, p2p blob store, consensus) is a host-side runtime under
+  :mod:`kungfu_tpu.runner`, :mod:`kungfu_tpu.elastic` and
+  :mod:`kungfu_tpu.store`;
+* the **algorithm layer** (distributed optimizers, monitoring,
+  adaptation policies) is pure JAX under :mod:`kungfu_tpu.optimizers`
+  and :mod:`kungfu_tpu.monitor`.
+
+Top-level convenience API (parity with reference
+``srcs/python/kungfu/python/__init__.py``):
+
+    >>> import kungfu_tpu as kf
+    >>> kf.init()
+    >>> kf.current_rank(), kf.cluster_size()
+"""
+
+from kungfu_tpu.python import (  # noqa: F401
+    current_rank,
+    current_local_rank,
+    current_local_size,
+    cluster_size,
+    detached,
+    init,
+    finalize,
+    propose_new_size,
+    resize,
+    run_barrier,
+    uid,
+    current_communicator,
+)
+
+__version__ = "0.1.0"

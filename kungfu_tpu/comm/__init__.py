@@ -1,0 +1,22 @@
+"""Communication layer.
+
+Two planes, mirroring the reference's split between its Go collective engine
+(``srcs/go/kungfu/session``) and its control connections:
+
+* :mod:`kungfu_tpu.comm.device` — the **data plane**: a
+  :class:`Communicator` wrapping one *mesh epoch* (an immutable
+  ``jax.sharding.Mesh`` + cluster version).  Collectives lower to XLA/ICI
+  (``psum``/``all_gather``/``ppermute``) under ``shard_map``; this replaces
+  both the reference's graph-driven Go allreduce and its NCCL subsystem.
+
+* :mod:`kungfu_tpu.comm.host` — the **control plane**: TCP/Unix-socket
+  message channels between worker processes (rendezvous-by-name, connection
+  tokens fencing cluster versions), used for barrier/consensus during
+  membership changes (when no mesh exists), gossip blob exchange, and
+  heartbeats.  The rchannel analog.
+"""
+
+from kungfu_tpu.comm.device import Communicator
+from kungfu_tpu.comm.host import HostChannel, ConnType
+
+__all__ = ["Communicator", "HostChannel", "ConnType"]

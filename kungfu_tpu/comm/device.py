@@ -1,0 +1,304 @@
+"""Device-plane communicator: mesh epochs + XLA/ICI collectives.
+
+This is the TPU-native replacement for the reference's collective engine
+(``srcs/go/kungfu/session/session.go`` — graph-driven chunked allreduce over
+TCP) and its NCCL subsystem (``srcs/cpp/src/nccl``).  Design:
+
+* A :class:`Communicator` is an **immutable mesh epoch**: a cluster
+  membership + version + a ``jax.sharding.Mesh`` over the participating
+  devices.  Elastic resize never mutates a communicator — it builds a new
+  one (the analog of the reference's new-``Session``-per-membership-change,
+  ``peer/peer.go:144-166``, and of ``ResetNcclHelper``).
+
+* Collectives are compiled: each eager call dispatches to a cached
+  ``jit(shard_map(...))`` whose body is a ``jax.lax`` collective.  XLA
+  schedules and routes them over ICI — there is no per-message routing
+  graph, no chunking (XLA tiles transfers), and no launch-order scheduler
+  (SPMD compilation fixes a global order; the reference needed a dedicated
+  NCCL thread + ``LinearExecutor`` for this, ``scheduler.cpp:37-77``).
+
+* The mesh is 2-D ``(host, local)`` mirroring the reference's hierarchy of
+  local/cross/global strategy lists (``session/strategy.go:176-210``):
+  ``local_*`` collectives reduce over the intra-host axis, ``cross_*`` over
+  the inter-host axis, global ones over both.
+
+Eager semantics (single-controller): a "peer" is a mesh device; values are
+**stacked** on a leading peer axis of size ``n`` and collectives return the
+stacked result (e.g. ``all_reduce(x)[i] == x.sum(0)`` for every ``i``).
+Inside user jit code, use :mod:`kungfu_tpu.ops` with the communicator's
+axis names instead — that is the hot path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kungfu_tpu.plan.cluster import Cluster
+
+HOST_AXIS = "kf_host"
+LOCAL_AXIS = "kf_local"
+GLOBAL_AXES = (HOST_AXIS, LOCAL_AXIS)
+
+_REDUCE_OPS = ("sum", "min", "max", "prod", "mean")
+
+
+def _tree_stack_check(n: int, x):
+    for leaf in jax.tree_util.tree_leaves(x):
+        if leaf.shape[0] != n:
+            raise ValueError(
+                f"stacked collective input must have leading peer axis {n}, got {leaf.shape}"
+            )
+
+
+class Communicator:
+    """One mesh epoch.  Immutable; resize creates a new instance."""
+
+    def __init__(
+        self,
+        cluster: Optional[Cluster] = None,
+        version: int = 0,
+        devices: Optional[Sequence] = None,
+        local_size: Optional[int] = None,
+    ):
+        self.cluster = cluster
+        self.version = version
+        devs = list(devices) if devices is not None else list(jax.devices())
+        n = len(devs)
+        if local_size is None:
+            local_size = self._infer_local_size(cluster, n)
+        if n % local_size != 0:
+            raise ValueError(f"{n} devices not divisible by local_size={local_size}")
+        self._n = n
+        self._local = local_size
+        self._hosts = n // local_size
+        self.mesh = Mesh(
+            np.asarray(devs).reshape(self._hosts, self._local), GLOBAL_AXES
+        )
+        self.axis = GLOBAL_AXES  # pass to kungfu_tpu.ops inside user jit code
+        self._fns = {}
+
+    @staticmethod
+    def _infer_local_size(cluster: Optional[Cluster], n: int) -> int:
+        """Use the cluster's per-host worker counts when they evenly tile the
+        device count; else flat (1 logical host)."""
+        if cluster is not None and cluster.size() > 0:
+            parts = [len(v) for v in cluster.workers.partition_by_host().values()]
+            if len(set(parts)) == 1 and n % (n // len(parts) or 1) == 0:
+                per_host = n // len(parts)
+                if per_host * len(parts) == n and per_host >= 1:
+                    return per_host
+        return n
+
+    # -- metadata --------------------------------------------------------
+    @property
+    def size(self) -> int:
+        return self._n
+
+    @property
+    def num_hosts(self) -> int:
+        return self._hosts
+
+    @property
+    def local_size(self) -> int:
+        return self._local
+
+    def __repr__(self):
+        return (
+            f"Communicator(v{self.version}, {self._n} devices as "
+            f"{self._hosts}x{self._local})"
+        )
+
+    # -- compiled collective factory -------------------------------------
+    def _spec_in(self):
+        # leading peer axis split over both mesh axes
+        return P(GLOBAL_AXES)
+
+    def _cached(self, key, build: Callable):
+        fn = self._fns.get(key)
+        if fn is None:
+            fn = build()
+            self._fns[key] = fn
+        return fn
+
+    def _shard_jit(self, body, out_replicated=False):
+        spec = self._spec_in()
+        out_spec = P() if out_replicated else spec
+        f = shard_map(body, mesh=self.mesh, in_specs=(spec,), out_specs=out_spec)
+        return jax.jit(f)
+
+    # -- collectives (eager, stacked) ------------------------------------
+    def all_reduce(self, x, op: str = "sum"):
+        """Stacked allreduce: out[i] = reduce_j x[j].  Pytrees supported."""
+        if op not in _REDUCE_OPS:
+            raise ValueError(f"op {op!r} not in {_REDUCE_OPS}")
+        _tree_stack_check(self._n, x)
+        return jax.tree_util.tree_map(lambda a: self._all_reduce_leaf(a, op, GLOBAL_AXES), x)
+
+    def _all_reduce_leaf(self, a, op, axes):
+        a = jnp.asarray(a)
+        key = ("ar", op, axes, a.shape, a.dtype.name)
+
+        def build():
+            def body(s):
+                if op == "sum":
+                    return jax.lax.psum(s, axes)
+                if op == "mean":
+                    return jax.lax.pmean(s, axes)
+                if op == "min":
+                    return jax.lax.pmin(s, axes)
+                if op == "max":
+                    return jax.lax.pmax(s, axes)
+                # prod: gather then reduce (no pprod primitive)
+                g = jax.lax.all_gather(s, axes, axis=0, tiled=False)
+                g = g.reshape((-1,) + s.shape)
+                return jnp.prod(g, axis=0)
+
+            return self._shard_jit(body)
+
+        return self._cached(key, build)(a)
+
+    def reduce(self, x, root: int = 0, op: str = "sum"):
+        """Result valid on peer ``root`` (others get the same value — on TPU
+        psum to all is as cheap as reduce-to-root; parity semantics kept)."""
+        return self.all_reduce(x, op)
+
+    def broadcast(self, x, root: int = 0):
+        """out[i] = x[root] for all i."""
+        _tree_stack_check(self._n, x)
+
+        def leaf(a):
+            a = jnp.asarray(a)
+            key = ("bc", root, a.shape, a.dtype.name)
+
+            def build():
+                def body(s):
+                    idx = _flat_index()
+                    mask = (idx == root).astype(s.dtype)
+                    return jax.lax.psum(s * mask, GLOBAL_AXES)
+
+                return self._shard_jit(body)
+
+            return self._cached(key, build)(a)
+
+        return jax.tree_util.tree_map(leaf, x)
+
+    def all_gather(self, x):
+        """out[i] = stack_j x[j] — every peer sees all slices; eager result
+        has shape [n, n, ...] (reference ``allgather.go:17-45``)."""
+        _tree_stack_check(self._n, x)
+
+        def leaf(a):
+            a = jnp.asarray(a)
+            key = ("ag", a.shape, a.dtype.name)
+
+            def build():
+                def body(s):
+                    g = jax.lax.all_gather(s, GLOBAL_AXES, axis=0, tiled=True)
+                    return jnp.broadcast_to(g[None], (s.shape[0],) + g.shape)
+
+                return self._shard_jit(body)
+
+            return self._cached(key, build)(a)
+
+        return jax.tree_util.tree_map(leaf, x)
+
+    def gather(self, x):
+        """Gather to rank 0 (others receive the same stacked copy)."""
+        return self.all_gather(x)
+
+    def local_all_reduce(self, x, op: str = "sum"):
+        """Reduce over the intra-host mesh axis only."""
+        return self._axis_reduce(x, op, (LOCAL_AXIS,))
+
+    def cross_all_reduce(self, x, op: str = "sum"):
+        """Reduce over the inter-host axis (the local-masters stage of the
+        reference's hierarchical allreduce, ``allreduce.go:38``)."""
+        return self._axis_reduce(x, op, (HOST_AXIS,))
+
+    def _axis_reduce(self, x, op, axes):
+        _tree_stack_check(self._n, x)
+        return jax.tree_util.tree_map(lambda a: self._all_reduce_leaf(jnp.asarray(a), op, axes), x)
+
+    def local_broadcast(self, x):
+        """Broadcast each host's local-rank-0 slice to its host peers."""
+        _tree_stack_check(self._n, x)
+
+        def leaf(a):
+            a = jnp.asarray(a)
+            key = ("lbc", a.shape, a.dtype.name)
+
+            def build():
+                def body(s):
+                    idx = jax.lax.axis_index(LOCAL_AXIS)
+                    mask = (idx == 0).astype(s.dtype)
+                    return jax.lax.psum(s * mask, (LOCAL_AXIS,))
+
+                return self._shard_jit(body)
+
+            return self._cached(key, build)(a)
+
+        return jax.tree_util.tree_map(leaf, x)
+
+    # -- group / fused variants ------------------------------------------
+    def group_all_reduce(self, tensors: List, op: str = "sum", fuse: bool = True):
+        """Allreduce a list of stacked tensors.  With ``fuse=True`` they are
+        flattened into one buffer for a single collective (the reference's
+        tensor-fusion optimization, ``ops/__init__.py:29-46``); XLA usually
+        fuses anyway, but one launch keeps small-tensor latency flat."""
+        if not fuse:
+            return [self.all_reduce(t, op) for t in tensors]
+        from kungfu_tpu.ops.fuse import fuse as _fuse, defuse as _defuse
+
+        flat, treedef = _fuse(tensors, batch_axes=1)
+        out = self.all_reduce(flat, op)
+        return _defuse(out, treedef, batch_axes=1)
+
+    # -- sync primitives --------------------------------------------------
+    def barrier(self) -> None:
+        """1-element allreduce + block (reference ``session.go:102-113``)."""
+        x = jnp.ones((self._n, 1), dtype=jnp.int32)
+        jax.block_until_ready(self.all_reduce(x))
+
+    def consensus(self, x) -> bool:
+        """True iff every peer's slice is bit-identical — allreduce MIN ==
+        allreduce MAX (reference ``session.go:124-155``)."""
+        _tree_stack_check(self._n, x)
+        ok = True
+        for leaf in jax.tree_util.tree_leaves(x):
+            a = jnp.asarray(leaf)
+            if a.dtype == jnp.bool_:
+                a = a.astype(jnp.int32)
+            lo = self._all_reduce_leaf(a, "min", GLOBAL_AXES)
+            hi = self._all_reduce_leaf(a, "max", GLOBAL_AXES)
+            ok = ok and bool(jnp.all(lo == hi))
+        return ok
+
+    def consensus_bytes(self, data: bytes) -> bool:
+        """Consensus over an opaque byte string (cluster digests)."""
+        arr = np.frombuffer(data, dtype=np.uint8).astype(np.int32)
+        stacked = jnp.broadcast_to(arr[None], (self._n,) + arr.shape)
+        # every peer contributes the same local bytes in single-controller
+        # mode; in multi-process mode the caller stacks differing digests.
+        return self.consensus(stacked)
+
+    # -- sharding helpers -------------------------------------------------
+    def data_sharding(self) -> NamedSharding:
+        """Sharding for a global batch split over all peers (DP)."""
+        return NamedSharding(self.mesh, P(GLOBAL_AXES))
+
+    def replicated_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+
+def _flat_index():
+    """Global peer index inside shard_map over the 2-D mesh."""
+    h = jax.lax.axis_index(HOST_AXIS)
+    l = jax.lax.axis_index(LOCAL_AXIS)
+    return h * jax.lax.axis_size(LOCAL_AXIS) + l

@@ -1,0 +1,312 @@
+"""Host-side message channel — the control plane between worker processes.
+
+TPU-native analog of the reference's rchannel
+(``srcs/go/rchannel/{connection,client,server,handler}``): typed,
+named messages over TCP between peers, rendezvous-by-name receive queues,
+connect retries while peers come up, and **version-token fencing** — a
+message tagged with a stale cluster version is rejected, exactly like the
+reference's connection-token check (``connection.go:28-47,77-87``).
+
+This layer deliberately does *not* carry gradient traffic (that is the
+device plane, :mod:`kungfu_tpu.comm.device`).  It exists for the phases
+when no mesh exists or data must move peer-to-peer off the ICI:
+
+* membership consensus + barrier during elastic resize;
+* the versioned blob store pulls of PairAveraging gossip;
+* heartbeat / failure-detection signals.
+
+Wire format (little-endian), one message per connection:
+
+    magic u32 | token u32 | conn_type u8 | src_len u16 | src utf8
+    | name_len u16 | name utf8 | payload_len u32 | payload
+
+A future C++ transport (kungfu_tpu/native) can replace the socket loop
+behind the same API.
+"""
+
+from __future__ import annotations
+
+import enum
+import queue
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from kungfu_tpu.plan.peer import PeerID, parse_peer_id
+from kungfu_tpu.plan.peerlist import PeerList
+from kungfu_tpu.utils.log import get_logger
+
+_log = get_logger("host-chan")
+
+MAGIC = 0x4B465450  # "KFTP"
+CONNECT_RETRIES = 500
+CONNECT_RETRY_PERIOD_S = 0.2  # reference: 500 x 200ms (config.go:16-18)
+
+
+class ConnType(enum.IntEnum):
+    """Parity with reference ``message.go:12-17``."""
+
+    PING = 1
+    CONTROL = 2
+    COLLECTIVE = 3
+    PEER_TO_PEER = 4
+
+
+class _Msg:
+    __slots__ = ("token", "conn_type", "src", "name", "payload")
+
+    def __init__(self, token, conn_type, src, name, payload):
+        self.token = token
+        self.conn_type = conn_type
+        self.src = src
+        self.name = name
+        self.payload = payload
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        buf += chunk
+    return buf
+
+
+def _encode(token: int, conn_type: int, src: str, name: str, payload: bytes) -> bytes:
+    sb, nb = src.encode(), name.encode()
+    return (
+        struct.pack("<IIBH", MAGIC, token, conn_type, len(sb))
+        + sb
+        + struct.pack("<H", len(nb))
+        + nb
+        + struct.pack("<I", len(payload))
+        + payload
+    )
+
+
+def _decode(sock: socket.socket) -> _Msg:
+    magic, token, conn_type, src_len = struct.unpack("<IIBH", _read_exact(sock, 11))
+    if magic != MAGIC:
+        raise ValueError(f"bad magic {magic:#x}")
+    src = _read_exact(sock, src_len).decode()
+    (name_len,) = struct.unpack("<H", _read_exact(sock, 2))
+    name = _read_exact(sock, name_len).decode()
+    (payload_len,) = struct.unpack("<I", _read_exact(sock, 4))
+    payload = _read_exact(sock, payload_len)
+    return _Msg(token, conn_type, src, name, payload)
+
+
+class HostChannel:
+    """Per-process message endpoint.
+
+    ``token`` is the cluster version; bump it with :meth:`set_token` on
+    membership change — in-flight COLLECTIVE messages from the old epoch
+    are then dropped (fencing).
+    """
+
+    def __init__(self, self_id: PeerID, token: int = 0, bind_host: str = ""):
+        self.self_id = self_id
+        self._token = token
+        self._queues: Dict[Tuple[int, str, str], queue.Queue] = {}
+        self._qlock = threading.Lock()
+        self._control_handlers = []
+        self._p2p_handlers = []
+
+        chan = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    msg = _decode(self.request)
+                except (ConnectionError, ValueError) as e:
+                    _log.debug("bad message: %s", e)
+                    return
+                chan._dispatch(msg, self.request)
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((bind_host or "0.0.0.0", self_id.port), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    # -- lifecycle -------------------------------------------------------
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def set_token(self, token: int) -> None:
+        self._token = token
+
+    @property
+    def token(self) -> int:
+        return self._token
+
+    # -- dispatch --------------------------------------------------------
+    def _queue(self, conn_type: int, src: str, name: str) -> queue.Queue:
+        with self._qlock:
+            key = (conn_type, src, name)
+            q = self._queues.get(key)
+            if q is None:
+                q = self._queues[key] = queue.Queue()
+            return q
+
+    def _dispatch(self, msg: _Msg, sock: socket.socket) -> None:
+        if msg.conn_type == ConnType.PING:
+            try:
+                sock.sendall(_encode(self._token, ConnType.PING, str(self.self_id), msg.name, b""))
+            except OSError:
+                pass
+            return
+        if msg.conn_type == ConnType.COLLECTIVE and msg.token != self._token:
+            _log.warning(
+                "dropping %s from %s: token %d != current %d (fenced)",
+                msg.name, msg.src, msg.token, self._token,
+            )
+            return
+        if msg.conn_type == ConnType.CONTROL and self._control_handlers:
+            for h in list(self._control_handlers):
+                h(msg.name, msg.payload, msg.src)
+            return
+        if (
+            msg.conn_type == ConnType.PEER_TO_PEER
+            and msg.name.startswith("req.")
+            and self._p2p_handlers
+        ):
+            for h in list(self._p2p_handlers):
+                h(msg.name, msg.payload, msg.src)
+            return
+        self._queue(msg.conn_type, msg.src, msg.name).put(msg.payload)
+
+    def on_control(self, handler) -> None:
+        """Register ``handler(name, payload, src)`` for CONTROL messages."""
+        self._control_handlers.append(handler)
+
+    def on_p2p_request(self, handler) -> None:
+        """Register ``handler(name, payload, src)`` for PEER_TO_PEER messages
+        whose name starts with ``req.`` (the blob-store responder)."""
+        self._p2p_handlers.append(handler)
+
+    # -- client side -----------------------------------------------------
+    def _connect(self, peer: PeerID, retries=CONNECT_RETRIES) -> socket.socket:
+        last = None
+        for _ in range(retries):
+            try:
+                return socket.create_connection((peer.host, peer.port), timeout=10)
+            except OSError as e:
+                last = e
+                time.sleep(CONNECT_RETRY_PERIOD_S)
+        raise ConnectionError(f"cannot reach {peer} after {retries} retries: {last}")
+
+    def send(
+        self,
+        peer: PeerID,
+        name: str,
+        payload: bytes,
+        conn_type: ConnType = ConnType.COLLECTIVE,
+        retries: int = CONNECT_RETRIES,
+    ) -> None:
+        with self._connect(peer, retries) as sock:
+            sock.sendall(_encode(self._token, conn_type, str(self.self_id), name, payload))
+
+    def recv(
+        self, src: PeerID, name: str, conn_type: ConnType = ConnType.COLLECTIVE,
+        timeout: Optional[float] = 60.0,
+    ) -> bytes:
+        try:
+            return self._queue(conn_type, str(src), name).get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError(f"recv {name!r} from {src} timed out after {timeout}s") from None
+
+    def ping(self, peer: PeerID, timeout: float = 10.0) -> bool:
+        try:
+            with socket.create_connection((peer.host, peer.port), timeout=timeout) as sock:
+                sock.sendall(_encode(self._token, ConnType.PING, str(self.self_id), "ping", b""))
+                _decode(sock)
+                return True
+        except (OSError, ValueError, ConnectionError):
+            return False
+
+    def wait(self, peer: PeerID, timeout: float = 120.0) -> None:
+        """Poll-ping until the peer is up (reference ``client.go:47-59``)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.ping(peer):
+                return
+            time.sleep(CONNECT_RETRY_PERIOD_S)
+        raise TimeoutError(f"peer {peer} not up after {timeout}s")
+
+    # -- control-plane collectives over a peer list ----------------------
+    # Star-rooted at rank 0: fine for control traffic (small payloads,
+    # infrequent); the device plane handles bulk data.
+    def _rank(self, peers: PeerList) -> int:
+        r = peers.rank(self.self_id)
+        if r is None:
+            raise RuntimeError(f"{self.self_id} not in {peers}")
+        return r
+
+    def gather_bytes(self, data: bytes, peers: PeerList, name: str) -> Optional[List[bytes]]:
+        """Root (rank 0) returns all peers' payloads in rank order."""
+        rank = self._rank(peers)
+        if rank == 0:
+            out = [data]
+            for p in list(peers)[1:]:
+                out.append(self.recv(p, name))
+            return out
+        self.send(peers[0], name, data)
+        return None
+
+    def broadcast_bytes(self, data: Optional[bytes], peers: PeerList, name: str) -> bytes:
+        rank = self._rank(peers)
+        if rank == 0:
+            assert data is not None
+            for p in list(peers)[1:]:
+                self.send(p, name, data)
+            return data
+        return self.recv(peers[0], name)
+
+    def allgather_bytes(self, data: bytes, peers: PeerList, name: str) -> List[bytes]:
+        gathered = self.gather_bytes(data, peers, name + ".g")
+        if self._rank(peers) == 0:
+            blob = _pack_list(gathered)
+        else:
+            blob = None
+        return _unpack_list(self.broadcast_bytes(blob, peers, name + ".b"))
+
+    def barrier(self, peers: PeerList, name: str = "barrier") -> None:
+        self.gather_bytes(b"", peers, name + ".in")
+        self.broadcast_bytes(b"" if self._rank(peers) == 0 else None, peers, name + ".out")
+
+    def consensus_bytes(self, data: bytes, peers: PeerList, name: str = "consensus") -> bool:
+        """True iff all peers supplied identical bytes
+        (control-plane analog of ``session.go:124-155``)."""
+        gathered = self.gather_bytes(data, peers, name + ".g")
+        if self._rank(peers) == 0:
+            ok = all(g == gathered[0] for g in gathered)
+            self.broadcast_bytes(b"\x01" if ok else b"\x00", peers, name + ".b")
+            return ok
+        return self.broadcast_bytes(None, peers, name + ".b") == b"\x01"
+
+
+def _pack_list(items: List[bytes]) -> bytes:
+    out = [struct.pack("<I", len(items))]
+    for it in items:
+        out.append(struct.pack("<I", len(it)))
+        out.append(it)
+    return b"".join(out)
+
+
+def _unpack_list(blob: bytes) -> List[bytes]:
+    (n,), off = struct.unpack_from("<I", blob), 4
+    items = []
+    for _ in range(n):
+        (ln,) = struct.unpack_from("<I", blob, off)
+        off += 4
+        items.append(blob[off : off + ln])
+        off += ln
+    return items

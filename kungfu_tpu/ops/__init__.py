@@ -1,0 +1,43 @@
+"""In-jit collective ops — the hot-path API.
+
+Parity with the reference's TF custom ops
+(``srcs/cpp/src/tensorflow/ops/cpu/collective.cpp``,
+``srcs/python/kungfu/tensorflow/ops/collective.py``), re-designed for XLA:
+these are plain functions used **inside** ``jit``/``shard_map`` code with
+the communicator's axis names; XLA lowers them to ICI collectives.  There
+is no async op machinery (the reference needed AsyncOpKernels + done
+callbacks; XLA overlaps collectives with compute automatically).
+
+Example (inside a training step shard-mapped over ``comm.axis``)::
+
+    grads = ops.group_all_reduce(grads, axis=comm.axis, mean=True)
+"""
+
+from kungfu_tpu.ops.collective import (
+    all_reduce,
+    group_all_reduce,
+    all_gather,
+    broadcast,
+    barrier_value,
+    peer_rank,
+    peer_size,
+)
+from kungfu_tpu.ops.fuse import fuse, defuse
+from kungfu_tpu.ops.monitor import global_noise_scale, group_all_reduce_with_variance
+from kungfu_tpu.ops.state import counter, exponential_moving_average
+
+__all__ = [
+    "all_reduce",
+    "group_all_reduce",
+    "all_gather",
+    "broadcast",
+    "barrier_value",
+    "peer_rank",
+    "peer_size",
+    "fuse",
+    "defuse",
+    "global_noise_scale",
+    "group_all_reduce_with_variance",
+    "counter",
+    "exponential_moving_average",
+]

@@ -1,0 +1,58 @@
+"""Tensor fusion: flatten a pytree into one contiguous buffer and back.
+
+Parity with reference ``kungfu/tensorflow/ops/__init__.py:29-46`` (fuse /
+defuse) and the fused ``ModelBuffer`` (``model_buffer.hpp:13-53``): small
+tensors are packed into one buffer so a collective or a gossip transfer is
+one launch instead of hundreds.
+
+``batch_axes`` preserves leading stacked axes (the eager communicator's
+per-peer axis) outside the flattening.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class FuseTreeDef(NamedTuple):
+    treedef: Any
+    shapes: Tuple[Tuple[int, ...], ...]
+    dtypes: Tuple[Any, ...]
+    sizes: Tuple[int, ...]
+    fused_dtype: Any
+
+
+def fuse(tree, batch_axes: int = 0, dtype=None):
+    """Flatten every leaf (beyond ``batch_axes`` leading dims) and concat.
+
+    Returns ``(buffer, FuseTreeDef)``.  All leaves are cast to a common
+    ``dtype`` (default: result dtype promotion across leaves)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        raise ValueError("fuse of empty tree")
+    shapes = tuple(tuple(l.shape) for l in leaves)
+    dtypes = tuple(l.dtype for l in leaves)
+    if dtype is None:
+        dtype = jnp.result_type(*dtypes)
+    flat = [
+        jnp.reshape(l, l.shape[:batch_axes] + (-1,)).astype(dtype) for l in leaves
+    ]
+    sizes = tuple(f.shape[-1] for f in flat)
+    buf = jnp.concatenate(flat, axis=-1)
+    return buf, FuseTreeDef(treedef, shapes, dtypes, sizes, dtype)
+
+
+def defuse(buf, spec: FuseTreeDef, batch_axes: int = 0):
+    """Inverse of :func:`fuse`."""
+    offsets = np.cumsum([0] + list(spec.sizes))
+    leaves = []
+    for i, (shape, dt) in enumerate(zip(spec.shapes, spec.dtypes)):
+        piece = jax.lax.slice_in_dim(
+            buf, offsets[i], offsets[i + 1], axis=buf.ndim - 1
+        )
+        leaves.append(jnp.reshape(piece, shape).astype(dt))
+    return jax.tree_util.tree_unflatten(spec.treedef, leaves)

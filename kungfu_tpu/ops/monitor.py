@@ -1,0 +1,56 @@
+"""In-graph training-statistics monitors.
+
+Parity with the reference's monitoring ops: gradient noise scale
+(``NoiseScale`` op, ``tensorflow/ops/cpu/collective.cpp:212-260``;
+estimator from the OpenAI GNS paper, used by
+``optimizers/grad_noise_scale.py``) and gradient variance
+(``optimizers/grad_variance.py``).  Pure JAX — on TPU these are a few
+fused reductions piggybacking on the allreduce, essentially free.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from kungfu_tpu.ops.collective import all_reduce, peer_size
+
+
+def _sq_norm(tree):
+    leaves = jax.tree_util.tree_leaves(tree)
+    return sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+
+
+def global_noise_scale(local_grads, avg_grads, local_batch_size, axis):
+    """Gradient noise scale estimate from one step.
+
+    ``local_grads``: this peer's gradients (batch ``b_small``);
+    ``avg_grads``: the allreduced mean gradients (batch ``b_big = n*b_small``).
+
+    Returns the raw (noisy) per-step estimate ``S / |G|^2``; smooth it with
+    :func:`kungfu_tpu.ops.state.exponential_moving_average` as the reference
+    does (``grad_noise_scale.py:41-88``)."""
+    n = peer_size(axis)
+    b_small = jnp.asarray(local_batch_size, jnp.float32)
+    b_big = b_small * n
+    g_local_sq = _sq_norm(local_grads)
+    # average the local square norms so the estimate is symmetric across peers
+    g_local_sq = all_reduce(g_local_sq, axis, op="mean")
+    g_global_sq = _sq_norm(avg_grads)
+    g2 = (b_big * g_global_sq - b_small * g_local_sq) / (b_big - b_small)
+    s = (g_local_sq - g_global_sq) / (1.0 / b_small - 1.0 / b_big)
+    return s / (jnp.abs(g2) + 1e-30)
+
+
+def group_all_reduce_with_variance(grads, axis) -> Tuple:
+    """Mean-allreduce gradients and simultaneously estimate the cross-peer
+    gradient variance  E_i |g_i - gـavg|^2  (one extra psum of squares).
+
+    Returns ``(avg_grads, variance_scalar)``."""
+    avg = all_reduce(grads, axis, op="mean")
+    local_sq = _sq_norm(grads)
+    mean_sq = all_reduce(local_sq, axis, op="mean")
+    var = mean_sq - _sq_norm(avg)
+    return avg, jnp.maximum(var, 0.0)

@@ -1,0 +1,43 @@
+"""Stateful scalar ops, functional style.
+
+Parity with the reference's stateful TF kernels ``Counter`` and
+``ExponentialMovingAverage`` (``tensorflow/ops/cpu/state.cpp:6-78``).  In
+JAX state is explicit: each op is ``new_state, value = f(state, ...)`` and
+the state rides in the optimizer/train state pytree.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+
+
+class CounterState(NamedTuple):
+    step: jnp.ndarray  # int32
+
+
+def counter(state: Optional[CounterState] = None, incr: int = 1):
+    """Returns ``(new_state, value_before_increment)`` — matches the
+    reference op which emits the pre-increment count."""
+    if state is None:
+        return CounterState(jnp.asarray(incr, jnp.int32)), jnp.asarray(0, jnp.int32)
+    return CounterState(state.step + incr), state.step
+
+
+class EMAState(NamedTuple):
+    value: jnp.ndarray
+    initialized: jnp.ndarray  # bool
+
+
+def ema_init(shape=(), dtype=jnp.float32) -> EMAState:
+    return EMAState(jnp.zeros(shape, dtype), jnp.asarray(False))
+
+
+def exponential_moving_average(state: EMAState, x, alpha: float = 0.01):
+    """``v <- (1-alpha)*v + alpha*x``; first sample initializes v=x
+    (reference ``state.cpp`` EMA semantics).  Returns ``(state, value)``."""
+    x = jnp.asarray(x, state.value.dtype)
+    new = jnp.where(state.initialized, (1 - alpha) * state.value + alpha * x, x)
+    st = EMAState(new, jnp.asarray(True))
+    return st, new

@@ -1,0 +1,221 @@
+"""Worker-side peer runtime: membership + mesh epochs + elasticity.
+
+Parity with reference ``srcs/go/kungfu/peer/peer.go``: a ``Peer`` is created
+from the env bootstrap contract, owns the host-side message endpoint and the
+current :class:`~kungfu_tpu.comm.device.Communicator` (the analog of the
+reference's per-membership ``Session``), and implements the membership
+change protocol (consensus on the proposed cluster → notify runners →
+bump version → rebuild communicator, or mark self detached).
+
+Process model on TPU: one peer process per host, driving all local chips
+(the launcher sets ``KF_COORDINATOR``/``KF_NUM_PROCESSES``/``KF_PROCESS_ID``
+and we bring up ``jax.distributed``); or one process per simulated device in
+CPU-backend test clusters; or a single process in single-controller mode.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from typing import Optional
+
+from kungfu_tpu.comm.device import Communicator
+from kungfu_tpu.comm.host import ConnType, HostChannel
+from kungfu_tpu.plan.cluster import Cluster
+from kungfu_tpu.utils import envs
+from kungfu_tpu.utils.log import get_logger, log_event
+from kungfu_tpu.utils.stall import stall_detector
+
+_log = get_logger("peer")
+
+
+class Peer:
+    def __init__(self, config: Optional[envs.Config] = None):
+        self.config = config or envs.parse_config_from_env()
+        self.cluster: Cluster = self.config.cluster
+        self.cluster_version: int = self.config.init_version
+        self.detached: bool = False
+        self._channel: Optional[HostChannel] = None
+        self._comm: Optional[Communicator] = None
+        self._comm_version = -1
+        self._lock = threading.RLock()
+        self._started = False
+        self._jax_initialized = False
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        with self._lock:
+            if self._started:
+                return
+            self._started = True
+            if not self.config.single_process:
+                self._channel = HostChannel(
+                    self.config.self_id, token=self.cluster_version
+                )
+                from kungfu_tpu.store import install_p2p_handler
+
+                install_p2p_handler(self._channel)
+            if self.config.coordinator and self.config.num_processes > 1:
+                self._init_jax_distributed()
+            log_event("peer-started")
+
+    def _init_jax_distributed(self) -> None:
+        import jax
+
+        with stall_detector("jax.distributed.initialize"):
+            jax.distributed.initialize(
+                coordinator_address=self.config.coordinator,
+                num_processes=self.config.num_processes,
+                process_id=self.config.process_id,
+            )
+        self._jax_initialized = True
+
+    def close(self) -> None:
+        with self._lock:
+            if self._channel is not None:
+                self._channel.close()
+                self._channel = None
+            self._started = False
+
+    # -- identity --------------------------------------------------------
+    def rank(self) -> int:
+        if self.detached:
+            return -1
+        r = self.cluster.workers.rank(self.config.self_id)
+        if r is None:
+            raise RuntimeError(
+                f"{self.config.self_id} not in worker list {self.cluster.workers}"
+            )
+        return r
+
+    def size(self) -> int:
+        return self.cluster.size()
+
+    def local_rank(self) -> int:
+        r = self.cluster.workers.local_rank(self.config.self_id)
+        return 0 if r is None else r
+
+    def local_size(self) -> int:
+        return self.cluster.workers.local_size(self.config.self_id)
+
+    @property
+    def channel(self) -> Optional[HostChannel]:
+        return self._channel
+
+    # -- communicator (mesh epoch) ---------------------------------------
+    def communicator(self) -> Communicator:
+        """The communicator for the current cluster version; rebuilt lazily
+        after membership changes (analog of ``Peer.CurrentSession`` +
+        ``updateTo``, peer.go:138-166)."""
+        with self._lock:
+            if self._comm is None or self._comm_version != self.cluster_version:
+                self._comm = Communicator(
+                    cluster=self.cluster, version=self.cluster_version
+                )
+                self._comm_version = self.cluster_version
+                _log.info("new %r", self._comm)
+            return self._comm
+
+    # -- sync ------------------------------------------------------------
+    def barrier(self) -> None:
+        """Host-level barrier across worker processes."""
+        if self.size() <= 1 or self._channel is None:
+            return
+        with stall_detector("barrier"):
+            self._channel.barrier(
+                self.cluster.workers, name=f"barrier.v{self.cluster_version}"
+            )
+
+    def consensus_bytes(self, data: bytes, name: str = "consensus") -> bool:
+        if self.size() <= 1 or self._channel is None:
+            return True
+        return self._channel.consensus_bytes(
+            data, self.cluster.workers, name=f"{name}.v{self.cluster_version}"
+        )
+
+    # -- elasticity (full protocol in kungfu_tpu.elastic) -----------------
+    def propose_new_size(self, new_size: int) -> None:
+        """Rank 0 PUTs the resized cluster to the config server
+        (reference ``peer/legacy.go:18-39``)."""
+        if not self.config.config_server:
+            raise RuntimeError("propose_new_size requires KF_CONFIG_SERVER")
+        if self.rank() != 0:
+            return
+        new_cluster = self.cluster.resize(new_size)
+        req = urllib.request.Request(
+            self.config.config_server,
+            data=new_cluster.to_json().encode(),
+            method="PUT",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            resp.read()
+
+    def resize_cluster_from_url(self) -> bool:
+        """Fetch the target cluster from the config server, reach consensus,
+        and apply (reference ``peer.go:236-263``).  Returns True if
+        membership changed."""
+        if not self.config.config_server:
+            raise RuntimeError("resize requires KF_CONFIG_SERVER")
+        from kungfu_tpu.elastic.resize import fetch_cluster_with_consensus
+
+        new_cluster, version = fetch_cluster_with_consensus(self)
+        return self._propose(new_cluster, version)
+
+    def resize_cluster(self, n: int) -> bool:
+        """Direct resize (config-server-backed when available)."""
+        if self.config.config_server:
+            self.propose_new_size(n)
+            return self.resize_cluster_from_url()
+        new_cluster = self.cluster.resize(n)
+        return self._propose(new_cluster, self.cluster_version + 1)
+
+    def _propose(self, new_cluster: Cluster, version: int) -> bool:
+        """Apply an agreed membership change (reference ``peer.go:177-225``):
+        notify runners, bump version, detach if not in the new worker list."""
+        with self._lock:
+            if new_cluster.workers == self.cluster.workers:
+                return False
+            with stall_detector("propose"):
+                self._notify_runners(new_cluster, version)
+                self.cluster = new_cluster
+                self.cluster_version = version
+                if self._channel is not None:
+                    self._channel.set_token(version)
+                self.detached = (
+                    new_cluster.workers.rank(self.config.self_id) is None
+                )
+                self._comm = None  # next communicator() call builds the new epoch
+            log_event(f"cluster-resized-v{version}-n{new_cluster.size()}")
+            return True
+
+    def _notify_runners(self, new_cluster: Cluster, version: int) -> None:
+        """Send the new Stage to every runner so they can spawn/kill local
+        workers (reference ``peer.go:195-209`` → ``runner/handler.go``)."""
+        if self._channel is None or self.rank() != 0:
+            return
+        stage = json.dumps(
+            {"version": version, "cluster": json.loads(new_cluster.to_json())}
+        ).encode()
+        for runner in new_cluster.runners:
+            try:
+                self._channel.wait(runner, timeout=10)
+                self._channel.send(runner, "update", stage, ConnType.CONTROL)
+            except (TimeoutError, ConnectionError) as e:
+                _log.warning("cannot notify runner %s: %s", runner, e)
+
+    # -- p2p blob store (gossip) -----------------------------------------
+    def save(self, name: str, blob: bytes, version: Optional[str] = None) -> None:
+        from kungfu_tpu.store import get_local_store
+
+        get_local_store().save(name, blob, version)
+
+    def request(self, target_rank: int, name: str, version: Optional[str] = None) -> Optional[bytes]:
+        """Pull a named blob from a peer's versioned store
+        (reference ``p2p.go:15-41``, ``handler/p2p.go:102-120``)."""
+        from kungfu_tpu.store import remote_request
+
+        target = self.cluster.workers[target_rank]
+        return remote_request(self, target, name, version)

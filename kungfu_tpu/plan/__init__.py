@@ -1,0 +1,56 @@
+"""Topology / plan layer — pure data structures describing the cluster.
+
+TPU-native analog of reference ``srcs/go/plan``: peer identity, ordered peer
+lists, host specs, cluster membership with validated resize, and the
+communication graphs used by the host-side (gossip / control) collectives.
+
+On TPU the *device* data plane does not consume these graphs — XLA lowers
+collectives onto the ICI torus itself.  The graphs remain load-bearing for:
+
+* host-side control-plane collectives (consensus, barrier across processes);
+* the async gossip channel (PairAveraging peer selection);
+* strategy benchmarking/adaptation (choosing among compiled collective
+  schedules, see :mod:`kungfu_tpu.comm.strategies`).
+"""
+
+from kungfu_tpu.plan.graph import Graph, Node
+from kungfu_tpu.plan.peer import PeerID, parse_peer_id
+from kungfu_tpu.plan.peerlist import PeerList
+from kungfu_tpu.plan.hostspec import HostSpec, HostList, parse_host_list, DEFAULT_RUNNER_PORT, DEFAULT_PORT_RANGE
+from kungfu_tpu.plan.cluster import Cluster
+from kungfu_tpu.plan.topology import (
+    gen_star,
+    gen_tree,
+    gen_binary_tree,
+    gen_binary_tree_star,
+    gen_multi_binary_tree_star,
+    gen_multi_star,
+    gen_circular_graph_pair,
+    gen_default_reduce_graph,
+)
+from kungfu_tpu.plan.strategy import Strategy, parse_strategy, auto_select
+
+__all__ = [
+    "Graph",
+    "Node",
+    "PeerID",
+    "parse_peer_id",
+    "PeerList",
+    "HostSpec",
+    "HostList",
+    "parse_host_list",
+    "Cluster",
+    "Strategy",
+    "parse_strategy",
+    "auto_select",
+    "gen_star",
+    "gen_tree",
+    "gen_binary_tree",
+    "gen_binary_tree_star",
+    "gen_multi_binary_tree_star",
+    "gen_multi_star",
+    "gen_circular_graph_pair",
+    "gen_default_reduce_graph",
+    "DEFAULT_RUNNER_PORT",
+    "DEFAULT_PORT_RANGE",
+]

@@ -1,0 +1,97 @@
+"""Cluster = runners + workers, with validation and resize.
+
+Parity with reference ``srcs/go/plan/cluster.go:10-118``: a JSON-serializable
+membership document validated on every update, plus the resize rule — shrink
+drops the tail of the worker list, grow appends workers round-robin onto
+hosts that still have free slots (``cluster.go:75-106`` growOne).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass
+from typing import Optional
+
+from kungfu_tpu.plan.hostspec import DEFAULT_PORT_RANGE, DEFAULT_RUNNER_PORT
+from kungfu_tpu.plan.peer import PeerID
+from kungfu_tpu.plan.peerlist import PeerList
+
+
+@dataclass(frozen=True)
+class Cluster:
+    runners: PeerList
+    workers: PeerList
+
+    # -- codec -----------------------------------------------------------
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "runners": [str(p) for p in self.runners],
+                "workers": [str(p) for p in self.workers],
+            },
+            sort_keys=True,
+        )
+
+    @classmethod
+    def from_json(cls, s: str) -> "Cluster":
+        d = json.loads(s)
+        c = cls(
+            runners=PeerList.parse(",".join(d.get("runners", []))),
+            workers=PeerList.parse(",".join(d.get("workers", []))),
+        )
+        c.validate()
+        return c
+
+    def digest(self) -> bytes:
+        """Canonical bytes for the membership consensus collective."""
+        return hashlib.blake2b(self.to_json().encode(), digest_size=16).digest()
+
+    # -- validation ------------------------------------------------------
+    def validate(self) -> None:
+        runner_hosts = {r.host for r in self.runners}
+        for w in self.workers:
+            if w.host not in runner_hosts:
+                raise ValueError(f"worker {w} has no runner on its host")
+        if len(set(self.workers.peers)) != len(self.workers):
+            raise ValueError("duplicate workers")
+
+    def size(self) -> int:
+        return len(self.workers)
+
+    # -- resize ----------------------------------------------------------
+    def resize(self, new_size: int, port_range=DEFAULT_PORT_RANGE) -> "Cluster":
+        if new_size < 0:
+            raise ValueError("negative cluster size")
+        workers = list(self.workers.peers)
+        if new_size <= len(workers):
+            return Cluster(self.runners, PeerList(tuple(workers[:new_size])))
+        while len(workers) < new_size:
+            nxt = self._grow_one(workers, port_range)
+            if nxt is None:
+                raise ValueError(
+                    f"cannot grow to {new_size}: all {len(self.runners)} hosts full"
+                )
+            workers.append(nxt)
+        return Cluster(self.runners, PeerList(tuple(workers)))
+
+    def _grow_one(self, workers, port_range) -> Optional[PeerID]:
+        """Place one more worker on the least-loaded runner host with a free
+        port slot (ports are allocated densely from the range start)."""
+        lo, hi = port_range
+        load = {r.host: 0 for r in self.runners}
+        used = {}
+        for w in workers:
+            load[w.host] = load.get(w.host, 0) + 1
+            used.setdefault(w.host, set()).add(w.port)
+        for host in sorted(load, key=lambda h: load[h]):
+            for port in range(lo, hi):
+                if port not in used.get(host, set()):
+                    return PeerID(host, port)
+        return None
+
+    @classmethod
+    def single_process(cls, host: str = "127.0.0.1") -> "Cluster":
+        w = PeerList.of(PeerID(host, DEFAULT_PORT_RANGE[0]))
+        r = PeerList.of(PeerID(host, DEFAULT_RUNNER_PORT))
+        return cls(r, w)

@@ -1,0 +1,39 @@
+"""Peer identity.
+
+Parity with reference ``srcs/go/plan/{id,addr}.go``: a peer is identified by
+``(host, port)``; colocated peers may exchange host-side messages over a Unix
+domain socket.  On TPU one *peer process* typically drives all local TPU
+chips of a host (one process per host), but the framework also supports one
+process per chip for CPU-backend testing.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+_PEER_RE = re.compile(r"^(?P<host>[^:]+):(?P<port>\d+)$")
+
+
+@dataclass(frozen=True, order=True)
+class PeerID:
+    host: str
+    port: int
+
+    def __str__(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def sock_file(self) -> str:
+        """Unix-socket path used for colocated host-side transport
+        (analog of reference ``plan/addr.go:24``)."""
+        return f"/tmp/kungfu-tpu-{self.port}.sock"
+
+    def named_addr(self, name: str) -> str:
+        return f"{self}#{name}"
+
+
+def parse_peer_id(s: str) -> PeerID:
+    m = _PEER_RE.match(s.strip())
+    if not m:
+        raise ValueError(f"invalid peer id {s!r}; want host:port")
+    return PeerID(m.group("host"), int(m.group("port")))

@@ -1,0 +1,44 @@
+"""Collective strategy names.
+
+Parity with reference ``srcs/go/kungfu/base/strategy.go:10-22``: eight named
+strategies plus AUTO.  On TPU a *strategy* selects among compiled collective
+schedules (see :mod:`kungfu_tpu.comm.strategies`) rather than per-message
+routing graphs, but the names, the env/flag surface, and the AUTO selection
+rule (single host → STAR, multi host → BINARY_TREE_STAR) are preserved.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class Strategy(enum.Enum):
+    STAR = "STAR"
+    MULTI_STAR = "MULTI_STAR"
+    RING = "RING"
+    CLIQUE = "CLIQUE"
+    TREE = "TREE"
+    BINARY_TREE = "BINARY_TREE"
+    BINARY_TREE_STAR = "BINARY_TREE_STAR"
+    MULTI_BINARY_TREE_STAR = "MULTI_BINARY_TREE_STAR"
+    AUTO = "AUTO"
+
+    def __str__(self) -> str:
+        return self.value
+
+
+DEFAULT_STRATEGY = Strategy.BINARY_TREE_STAR
+
+
+def parse_strategy(s: str) -> Strategy:
+    try:
+        return Strategy(s.strip().upper().replace("-", "_"))
+    except ValueError:
+        names = ", ".join(m.value for m in Strategy)
+        raise ValueError(f"unknown strategy {s!r}; one of: {names}") from None
+
+
+def auto_select(num_hosts: int) -> Strategy:
+    """Reference AUTO rule (``session/strategy.go:90-99``): one host → STAR,
+    otherwise BINARY_TREE_STAR."""
+    return Strategy.STAR if num_hosts <= 1 else Strategy.BINARY_TREE_STAR

@@ -1,0 +1,90 @@
+"""Core runtime singleton — rank/size/resize surface.
+
+Parity with reference ``srcs/python/kungfu/python/__init__.py``: a default
+peer created from the env bootstrap contract, exposing
+``current_rank/cluster_size/local_rank/local_size``, ``uid``, ``detached``,
+``run_barrier``, ``propose_new_size`` and ``resize``.  Unlike the reference
+(which ctypes-inits at import), initialisation here is lazy or explicit via
+:func:`init` — import side effects and JAX runtime startup don't mix.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+_default_peer = None
+_lock = threading.RLock()
+
+
+def init(config=None):
+    """Create (or return) the process-wide default Peer."""
+    global _default_peer
+    with _lock:
+        if _default_peer is None:
+            from kungfu_tpu.peer import Peer
+
+            _default_peer = Peer(config=config)
+            _default_peer.start()
+        return _default_peer
+
+
+def finalize():
+    global _default_peer
+    with _lock:
+        if _default_peer is not None:
+            _default_peer.close()
+            _default_peer = None
+
+
+def _peer():
+    return init()
+
+
+def uid() -> int:
+    """(cluster_version << 32) | rank — like reference ``python/__init__.py`` uid."""
+    p = _peer()
+    return (p.cluster_version << 32) | p.rank()
+
+
+def current_rank() -> int:
+    return _peer().rank()
+
+
+def cluster_size() -> int:
+    return _peer().size()
+
+
+def current_local_rank() -> int:
+    return _peer().local_rank()
+
+
+def current_local_size() -> int:
+    return _peer().local_size()
+
+
+def detached() -> bool:
+    return _peer().detached
+
+
+def run_barrier() -> None:
+    _peer().barrier()
+
+
+def propose_new_size(new_size: int) -> None:
+    _peer().propose_new_size(new_size)
+
+
+def resize(n: Optional[int] = None) -> bool:
+    """Resize the cluster; returns True if membership changed.
+    With ``n=None``, pull the target size from the config server
+    (reference ``python/__init__.py`` resize/resize_from_url)."""
+    p = _peer()
+    if n is None:
+        return p.resize_cluster_from_url()
+    return p.resize_cluster(n)
+
+
+def current_communicator():
+    """The active :class:`~kungfu_tpu.comm.Communicator` (mesh epoch)."""
+    return _peer().communicator()

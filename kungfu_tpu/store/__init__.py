@@ -1,0 +1,23 @@
+"""Named blob stores + p2p model exchange.
+
+Parity with reference ``srcs/go/store/{store,versionedstore}.go`` and the
+PeerToPeerEndpoint (``rchannel/handler/p2p.go``): a process-local KV store
+of named byte blobs, a versioned store keeping a sliding window of model
+versions (default 3, like ``handler/p2p.go:11``), and the request/response
+protocol async gossip peers use to pull each other's models.
+
+A future C++ backend (kungfu_tpu/native) can hold the blobs outside the
+GIL; the Python API stays identical.
+"""
+
+from kungfu_tpu.store.store import Store, VersionedStore, get_local_store, reset_local_store
+from kungfu_tpu.store.p2p import install_p2p_handler, remote_request
+
+__all__ = [
+    "Store",
+    "VersionedStore",
+    "get_local_store",
+    "reset_local_store",
+    "install_p2p_handler",
+    "remote_request",
+]

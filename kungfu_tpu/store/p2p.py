@@ -1,0 +1,72 @@
+"""P2P blob request/response over the host channel.
+
+Parity with the reference's PeerToPeerEndpoint round trip
+(``rchannel/handler/p2p.go:36-47,102-120``): the requester names a blob
+(+ optional version), the responder streams it back, or flags failure
+(the ``RequestFailed`` flag → here an explicit status byte).  Used by
+PairAveraging gossip to pull a random peer's model.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+from typing import Optional
+
+from kungfu_tpu.comm.host import ConnType, HostChannel
+from kungfu_tpu.plan.peer import PeerID, parse_peer_id
+from kungfu_tpu.store.store import get_local_store
+from kungfu_tpu.utils.log import get_logger
+
+_log = get_logger("p2p-store")
+_req_counter = itertools.count()
+_OK = b"\x01"
+_FAIL = b"\x00"
+
+
+def install_p2p_handler(channel: HostChannel) -> None:
+    """Make this process answer blob requests from its local store."""
+
+    def handle(name: str, payload: bytes, src: str):
+        # name = "req.<id>"; payload = json {"name":..., "version":...}
+        req_id = name[len("req."):]
+        try:
+            req = json.loads(payload.decode())
+            blob = get_local_store().get(req["name"], req.get("version") or None)
+        except (ValueError, KeyError) as e:
+            _log.warning("bad p2p request from %s: %s", src, e)
+            blob = None
+        status, body = (_OK, blob) if blob is not None else (_FAIL, b"")
+        try:
+            channel.send(
+                parse_peer_id(src),
+                f"rsp.{req_id}",
+                status + body,
+                ConnType.PEER_TO_PEER,
+                retries=5,
+            )
+        except ConnectionError as e:
+            _log.warning("cannot answer %s: %s", src, e)
+
+    channel.on_p2p_request(handle)
+
+
+def remote_request(
+    peer, target: PeerID, name: str, version: Optional[str] = None,
+    timeout: float = 60.0,
+) -> Optional[bytes]:
+    """Pull blob ``name`` from ``target``'s store; None when unavailable."""
+    channel = peer.channel
+    if channel is None:
+        # single-process mode: serve from the local store directly
+        return get_local_store().get(name, version)
+    if target == peer.config.self_id:
+        return get_local_store().get(name, version)
+    req_id = f"{peer.config.self_id.port}-{next(_req_counter)}"
+    body = json.dumps({"name": name, "version": version or ""}).encode()
+    channel.send(target, f"req.{req_id}", body, ConnType.PEER_TO_PEER)
+    rsp = channel.recv(target, f"rsp.{req_id}", ConnType.PEER_TO_PEER, timeout=timeout)
+    if rsp[:1] != _OK:
+        return None
+    return rsp[1:]

@@ -1,0 +1,90 @@
+"""Process-local blob stores."""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+DEFAULT_VERSION_COUNT = 3  # reference handler/p2p.go:11
+
+
+class Store:
+    """Named blob KV store with size-checked get-or-create
+    (reference ``store.go:14-59``)."""
+
+    def __init__(self):
+        self._blobs: Dict[str, bytes] = {}
+        self._lock = threading.RLock()
+
+    def save(self, name: str, blob: bytes) -> None:
+        with self._lock:
+            existing = self._blobs.get(name)
+            if existing is not None and len(existing) != len(blob):
+                raise ValueError(
+                    f"blob {name!r} size changed: {len(existing)} -> {len(blob)}"
+                )
+            self._blobs[name] = bytes(blob)
+
+    def get(self, name: str) -> Optional[bytes]:
+        with self._lock:
+            return self._blobs.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._blobs)
+
+
+class VersionedStore:
+    """Sliding window of named blob sets keyed by version string
+    (reference ``versionedstore.go`` — keeps the last ``window`` versions)."""
+
+    def __init__(self, window: int = DEFAULT_VERSION_COUNT):
+        self._window = window
+        self._versions: "OrderedDict[str, Store]" = OrderedDict()
+        self._lock = threading.RLock()
+
+    def save(self, name: str, blob: bytes, version: Optional[str] = None) -> None:
+        version = version or ""
+        with self._lock:
+            st = self._versions.get(version)
+            if st is None:
+                st = Store()
+                self._versions[version] = st
+                while len(self._versions) > self._window:
+                    self._versions.popitem(last=False)
+            st.save(name, blob)
+
+    def get(self, name: str, version: Optional[str] = None) -> Optional[bytes]:
+        with self._lock:
+            if version is not None and version != "":
+                st = self._versions.get(version)
+                return st.get(name) if st else None
+            # latest version containing the name
+            for st in reversed(self._versions.values()):
+                blob = st.get(name)
+                if blob is not None:
+                    return blob
+            return None
+
+    def versions(self) -> List[str]:
+        with self._lock:
+            return list(self._versions)
+
+
+_local: Optional[VersionedStore] = None
+_local_lock = threading.Lock()
+
+
+def get_local_store() -> VersionedStore:
+    global _local
+    with _local_lock:
+        if _local is None:
+            _local = VersionedStore()
+        return _local
+
+
+def reset_local_store() -> None:
+    global _local
+    with _local_lock:
+        _local = None

@@ -1,0 +1,4 @@
+from kungfu_tpu.utils.log import get_logger, log_event
+from kungfu_tpu.utils.stall import stall_detector
+
+__all__ = ["get_logger", "log_event", "stall_detector"]

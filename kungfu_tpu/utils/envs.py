@@ -1,0 +1,171 @@
+"""The worker bootstrap env-var contract.
+
+Parity with reference ``srcs/go/kungfu/env/envs.go:4-18`` and
+``kungfu/config/config.go``: the launcher communicates everything a worker
+needs through ``KF_*`` environment variables; unset envs fall back to
+single-process mode (reference ``env/config.go:24-80``).
+
+Bootstrap envs (written by the runner, read once at init):
+
+==========================  ====================================================
+``KF_SELF_SPEC``            this worker's ``host:port``
+``KF_INIT_PEERS``           comma-separated worker list
+``KF_INIT_RUNNERS``         comma-separated runner list
+``KF_PARENT_ID``            runner that spawned us (``host:port``)
+``KF_INIT_CLUSTER_VERSION`` integer mesh-epoch at spawn time
+``KF_ALLREDUCE_STRATEGY``   strategy name (see plan.strategy)
+``KF_CONFIG_SERVER``        URL of the elastic config server
+``KF_JOB_START_TIMESTAMP``  unix seconds the job started (event timeline)
+``KF_PROC_START_TIMESTAMP`` unix seconds this process started
+``KF_NUM_DEVICES``          virtual device count for CPU-backend clusters
+``KF_COORDINATOR``          jax.distributed coordinator address
+``KF_NUM_PROCESSES``        jax.distributed process count
+``KF_PROCESS_ID``           jax.distributed process index
+==========================  ====================================================
+
+Tuning envs (read anywhere, any time):
+
+=================================  ============================================
+``KF_CONFIG_ENABLE_MONITORING``    "true"/"false"
+``KF_CONFIG_MONITORING_PERIOD``    seconds, default 1
+``KF_CONFIG_ENABLE_STALL_DETECTION`` "true"/"false"
+``KF_CONFIG_LOG_LEVEL``            DEBUG/INFO/WARN/ERROR
+``KF_CONFIG_STRATEGY_HASH_METHOD`` chunk→strategy hash: "simple"|"name"
+``KF_CONFIG_WAIT_RUNNER_TIMEOUT``  seconds, default 30
+=================================  ============================================
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kungfu_tpu.plan.cluster import Cluster
+from kungfu_tpu.plan.peer import PeerID, parse_peer_id
+from kungfu_tpu.plan.peerlist import PeerList
+from kungfu_tpu.plan.strategy import Strategy, parse_strategy
+
+# bootstrap envs
+SELF_SPEC = "KF_SELF_SPEC"
+INIT_PEERS = "KF_INIT_PEERS"
+INIT_RUNNERS = "KF_INIT_RUNNERS"
+PARENT_ID = "KF_PARENT_ID"
+INIT_CLUSTER_VERSION = "KF_INIT_CLUSTER_VERSION"
+ALLREDUCE_STRATEGY = "KF_ALLREDUCE_STRATEGY"
+CONFIG_SERVER = "KF_CONFIG_SERVER"
+JOB_START_TIMESTAMP = "KF_JOB_START_TIMESTAMP"
+PROC_START_TIMESTAMP = "KF_PROC_START_TIMESTAMP"
+NUM_DEVICES = "KF_NUM_DEVICES"
+COORDINATOR = "KF_COORDINATOR"
+NUM_PROCESSES = "KF_NUM_PROCESSES"
+PROCESS_ID = "KF_PROCESS_ID"
+
+# tuning envs
+ENABLE_MONITORING = "KF_CONFIG_ENABLE_MONITORING"
+MONITORING_PERIOD = "KF_CONFIG_MONITORING_PERIOD"
+ENABLE_STALL_DETECTION = "KF_CONFIG_ENABLE_STALL_DETECTION"
+LOG_LEVEL = "KF_CONFIG_LOG_LEVEL"
+STRATEGY_HASH_METHOD = "KF_CONFIG_STRATEGY_HASH_METHOD"
+WAIT_RUNNER_TIMEOUT = "KF_CONFIG_WAIT_RUNNER_TIMEOUT"
+
+ALL_BOOTSTRAP_ENVS = [
+    SELF_SPEC, INIT_PEERS, INIT_RUNNERS, PARENT_ID, INIT_CLUSTER_VERSION,
+    ALLREDUCE_STRATEGY, CONFIG_SERVER, JOB_START_TIMESTAMP,
+    PROC_START_TIMESTAMP, NUM_DEVICES, COORDINATOR, NUM_PROCESSES, PROCESS_ID,
+]
+
+
+def parse_bool_env(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclass
+class Config:
+    """Parsed bootstrap configuration for one worker process."""
+
+    self_id: PeerID
+    cluster: Cluster
+    parent: Optional[PeerID] = None
+    strategy: Strategy = Strategy.AUTO
+    init_version: int = 0
+    config_server: str = ""
+    single_process: bool = False
+    coordinator: str = ""
+    num_processes: int = 1
+    process_id: int = 0
+    job_start: float = field(default_factory=time.time)
+    proc_start: float = field(default_factory=time.time)
+
+    @property
+    def detached(self) -> bool:
+        """True when self is not a member of the current worker list."""
+        return self.cluster.workers.rank(self.self_id) is None
+
+    @property
+    def rank(self) -> int:
+        r = self.cluster.workers.rank(self.self_id)
+        if r is None:
+            raise RuntimeError(
+                f"peer {self.self_id} is not in the worker list {self.cluster.workers}"
+            )
+        return r
+
+    @property
+    def size(self) -> int:
+        return self.cluster.size()
+
+
+def parse_config_from_env(env=None) -> Config:
+    """Parse the bootstrap contract; fall back to single-process mode when
+    ``KF_SELF_SPEC`` is unset (reference ``env/config.go:24-80``)."""
+    env = env if env is not None else os.environ
+    self_spec = env.get(SELF_SPEC)
+    if not self_spec:
+        c = Cluster.single_process()
+        return Config(self_id=c.workers[0], cluster=c, single_process=True)
+    self_id = parse_peer_id(self_spec)
+    workers = PeerList.parse(env.get(INIT_PEERS, self_spec))
+    runners_spec = env.get(INIT_RUNNERS, "")
+    if runners_spec:
+        runners = PeerList.parse(runners_spec)
+    else:
+        # no runner daemon (mp-spawn / test mode): synthesize one per host
+        from kungfu_tpu.plan.hostspec import DEFAULT_RUNNER_PORT
+
+        runners = PeerList(tuple(PeerID(h, DEFAULT_RUNNER_PORT) for h in workers.hosts()))
+    cluster = Cluster(runners, workers)
+    cluster.validate()
+    parent = parse_peer_id(env[PARENT_ID]) if env.get(PARENT_ID) else None
+    return Config(
+        self_id=self_id,
+        cluster=cluster,
+        parent=parent,
+        strategy=parse_strategy(env.get(ALLREDUCE_STRATEGY, "AUTO")),
+        init_version=int(env.get(INIT_CLUSTER_VERSION, "0")),
+        config_server=env.get(CONFIG_SERVER, ""),
+        coordinator=env.get(COORDINATOR, ""),
+        num_processes=int(env.get(NUM_PROCESSES, "1")),
+        process_id=int(env.get(PROCESS_ID, "0")),
+        job_start=float(env.get(JOB_START_TIMESTAMP, time.time())),
+        proc_start=float(env.get(PROC_START_TIMESTAMP, time.time())),
+    )
+
+
+def single_machine_env(rank: int, size: int, host: str = "127.0.0.1") -> dict:
+    """Env dict for mp-spawned single-machine workers
+    (reference ``env/config.go:59`` SingleMachineEnv)."""
+    from kungfu_tpu.plan.hostspec import DEFAULT_PORT_RANGE
+
+    lo, _ = DEFAULT_PORT_RANGE
+    peers = ",".join(f"{host}:{lo + i}" for i in range(size))
+    return {
+        SELF_SPEC: f"{host}:{lo + rank}",
+        INIT_PEERS: peers,
+        INIT_RUNNERS: f"{host}:38080",
+        INIT_CLUSTER_VERSION: "0",
+    }

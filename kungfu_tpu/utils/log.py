@@ -1,0 +1,37 @@
+"""Leveled logging + event timeline.
+
+Parity with reference ``srcs/go/log/logger.go`` (level from env) and
+``srcs/python/kungfu/python/_utils.py`` ``_log_event`` (wall time + seconds
+since job/proc start, for measuring init/resync latency in elastic runs).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import time
+
+_FMT = "[kf-tpu] %(asctime)s %(levelname).1s %(name)s: %(message)s"
+
+
+def get_logger(name: str = "kungfu_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(logging.Formatter(_FMT, datefmt="%H:%M:%S"))
+        logger.addHandler(h)
+        level = os.environ.get("KF_CONFIG_LOG_LEVEL", "INFO").upper()
+        logger.setLevel(getattr(logging, level, logging.INFO))
+        logger.propagate = False
+    return logger
+
+
+def log_event(name: str) -> None:
+    """Print an event with wall time and offsets from job/proc start."""
+    now = time.time()
+    job0 = float(os.environ.get("KF_JOB_START_TIMESTAMP", now))
+    proc0 = float(os.environ.get("KF_PROC_START_TIMESTAMP", now))
+    get_logger("event").info(
+        "%s | wall=%.3f job+%.3fs proc+%.3fs", name, now, now - job0, now - proc0
+    )

@@ -1,0 +1,20 @@
+"""Test config: force an 8-device virtual CPU platform.
+
+This is the TPU analog of the reference's fake-cluster trick
+(``scripts/tests/run-integration-tests.sh`` runs N processes on localhost):
+we test all sharding/collective paths on N virtual CPU devices.
+
+Note: the environment preloads jax (axon sitecustomize), so setting
+JAX_PLATFORMS via os.environ is too late — use jax.config instead.
+XLA_FLAGS is still read at first backend init, which has not happened yet.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,141 @@
+"""Control-plane (host channel) and blob-store tests.
+
+Multiple HostChannels on distinct localhost ports inside one process stand
+in for multiple worker processes — same trick as the reference's localhost
+multi-process integration tests, one level cheaper.
+"""
+
+import threading
+
+import pytest
+
+from kungfu_tpu.comm.host import ConnType, HostChannel
+from kungfu_tpu.plan import PeerID, PeerList
+from kungfu_tpu.store.store import Store, VersionedStore
+
+
+BASE_PORT = 21000
+
+
+@pytest.fixture
+def channels():
+    peers = PeerList.of(*(PeerID("127.0.0.1", BASE_PORT + i) for i in range(3)))
+    chans = [HostChannel(p, token=0, bind_host="127.0.0.1") for p in peers]
+    yield peers, chans
+    for c in chans:
+        c.close()
+
+
+def run_all(fns):
+    """Run one closure per simulated peer concurrently; re-raise errors."""
+    errors = []
+    results = [None] * len(fns)
+
+    def wrap(i, f):
+        try:
+            results[i] = f()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=wrap, args=(i, f)) for i, f in enumerate(fns)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    if errors:
+        raise errors[0]
+    return results
+
+
+class TestHostChannel:
+    def test_send_recv(self, channels):
+        peers, (a, b, _) = channels
+        a.send(peers[1], "hello", b"payload")
+        assert b.recv(peers[0], "hello") == b"payload"
+
+    def test_ping(self, channels):
+        peers, (a, b, c) = channels
+        assert a.ping(peers[1])
+        assert a.ping(peers[2])
+        assert not a.ping(PeerID("127.0.0.1", 22999), timeout=0.3)
+
+    def test_token_fencing(self, channels):
+        peers, (a, b, _) = channels
+        b.set_token(5)  # b moved to epoch 5; a still at 0
+        a.send(peers[1], "stale", b"x")
+        with pytest.raises(TimeoutError):
+            b.recv(peers[0], "stale", timeout=0.5)
+        # control messages are not fenced
+        got = []
+        b.on_control(lambda name, payload, src: got.append((name, payload)))
+        a.send(peers[1], "update", b"cfg", ConnType.CONTROL)
+        import time
+
+        for _ in range(50):
+            if got:
+                break
+            time.sleep(0.05)
+        assert got == [("update", b"cfg")]
+
+    def test_barrier(self, channels):
+        peers, chans = channels
+        run_all([lambda c=c: c.barrier(peers) for c in chans])
+
+    def test_allgather(self, channels):
+        peers, chans = channels
+        outs = run_all(
+            [lambda i=i, c=c: c.allgather_bytes(f"blob{i}".encode(), peers, "ag") for i, c in enumerate(chans)]
+        )
+        for out in outs:
+            assert out == [b"blob0", b"blob1", b"blob2"]
+
+    def test_consensus(self, channels):
+        peers, chans = channels
+        outs = run_all([lambda c=c: c.consensus_bytes(b"same", peers, "c1") for c in chans])
+        assert outs == [True, True, True]
+        outs = run_all(
+            [lambda i=i, c=c: c.consensus_bytes(b"same" if i < 2 else b"diff", peers, "c2") for i, c in enumerate(chans)]
+        )
+        assert outs == [False, False, False]
+
+
+class TestStore:
+    def test_size_check(self):
+        s = Store()
+        s.save("w", b"1234")
+        with pytest.raises(ValueError):
+            s.save("w", b"12345")
+        assert s.get("w") == b"1234"
+        assert s.get("missing") is None
+
+    def test_versioned_window(self):
+        vs = VersionedStore(window=3)
+        for v in range(5):
+            vs.save("model", bytes([v] * 4), version=str(v))
+        assert vs.versions() == ["2", "3", "4"]
+        assert vs.get("model", "1") is None
+        assert vs.get("model", "3") == b"\x03\x03\x03\x03"
+        assert vs.get("model") == b"\x04\x04\x04\x04"  # latest
+
+
+class TestP2PStore:
+    def test_remote_request(self, channels):
+        peers, (a, b, _) = channels
+        from kungfu_tpu.store import install_p2p_handler, reset_local_store
+        from kungfu_tpu.store.p2p import remote_request
+        from kungfu_tpu.store.store import get_local_store
+
+        reset_local_store()
+        get_local_store().save("model", b"weights-v0", version="0")
+        install_p2p_handler(b)  # b answers from the (shared) local store
+
+        class FakePeer:
+            channel = a
+
+            class config:
+                self_id = peers[0]
+
+        got = remote_request(FakePeer, peers[1], "model", "0")
+        assert got == b"weights-v0"
+        assert remote_request(FakePeer, peers[1], "nope") is None
+        reset_local_store()

@@ -1,0 +1,232 @@
+"""Tests for kungfu_tpu.plan — mirrors reference Go unit tests
+(srcs/go/plan/*_test.go, plan/graph/graph_test.go)."""
+
+import pytest
+
+from kungfu_tpu.plan import (
+    Cluster,
+    Graph,
+    HostList,
+    HostSpec,
+    PeerID,
+    PeerList,
+    Strategy,
+    auto_select,
+    gen_binary_tree,
+    gen_binary_tree_star,
+    gen_circular_graph_pair,
+    gen_multi_binary_tree_star,
+    gen_multi_star,
+    gen_star,
+    gen_tree,
+    parse_host_list,
+    parse_strategy,
+)
+from kungfu_tpu.plan.hostfile import parse_hostfile_text
+from kungfu_tpu.plan.peer import parse_peer_id
+
+
+class TestPeer:
+    def test_parse(self):
+        p = parse_peer_id("10.0.0.1:10000")
+        assert p == PeerID("10.0.0.1", 10000)
+        assert str(p) == "10.0.0.1:10000"
+
+    def test_parse_bad(self):
+        with pytest.raises(ValueError):
+            parse_peer_id("nocolon")
+
+
+class TestPeerList:
+    def test_ranks(self):
+        pl = PeerList.parse("a:10000,a:10001,b:10000,b:10001")
+        assert len(pl) == 4
+        assert pl.rank(PeerID("b", 10000)) == 2
+        assert pl.local_rank(PeerID("b", 10001)) == 1
+        assert pl.local_size(PeerID("a", 10000)) == 2
+        assert pl.hosts() == ["a", "b"]
+        assert pl.partition_by_host() == {"a": [0, 1], "b": [2, 3]}
+        assert pl.local_masters() == [0, 2]
+
+    def test_diff(self):
+        a = PeerList.parse("h:10000,h:10001")
+        b = PeerList.parse("h:10001,h:10002")
+        added, removed = a.diff(b)
+        assert added == [PeerID("h", 10002)]
+        assert removed == [PeerID("h", 10000)]
+
+    def test_roundtrip(self):
+        s = "x:1,y:2"
+        assert str(PeerList.parse(s)) == s
+
+
+class TestHostSpec:
+    def test_parse_forms(self):
+        assert HostSpec.parse("1.2.3.4") == HostSpec("1.2.3.4", 1, "1.2.3.4")
+        assert HostSpec.parse("1.2.3.4:8").slots == 8
+        assert HostSpec.parse("1.2.3.4:8:pub").public_addr == "pub"
+
+    def test_host_list(self):
+        hl = parse_host_list("a:2,b:2")
+        assert hl.cap() == 4
+        peers = hl.gen_peer_list(3)
+        assert [str(p) for p in peers] == ["a:10000", "a:10001", "b:10000"]
+        runners = hl.gen_runner_list()
+        assert [p.port for p in runners] == [38080, 38080]
+
+    def test_np_exceeds_cap(self):
+        with pytest.raises(ValueError):
+            parse_host_list("a:1").gen_peer_list(2)
+
+    def test_duplicate_host(self):
+        with pytest.raises(ValueError):
+            parse_host_list("a:1,a:2")
+
+    def test_hostfile(self):
+        hl = parse_hostfile_text("10.0.0.1 slots=4\n# cmt\n10.0.0.2\n")
+        assert hl.cap() == 5
+
+
+class TestGraph:
+    def test_forest_roundtrip(self):
+        f = [0, 0, 0, 1, 1, 2]
+        g = Graph.from_forest_array(f)
+        assert g.to_forest_array() == f
+        assert g.is_self_loop(0)
+        assert set(g.nexts(0)) == {1, 2}
+
+    def test_cycle_detected(self):
+        with pytest.raises(ValueError):
+            Graph.from_forest_array([1, 0])
+
+    def test_reverse(self):
+        g = Graph(3)
+        g.add_edge(0, 1)
+        g.add_edge(0, 2)
+        r = g.reverse()
+        assert set(r.nexts(1)) == {0}
+        assert set(r.prevs(0)) == {1, 2}
+
+    def test_digest_equality(self):
+        a = Graph.from_forest_array([0, 0, 1])
+        b = Graph.from_forest_array([0, 0, 1])
+        c = Graph.from_forest_array([0, 0, 0])
+        assert a == b
+        assert a != c
+
+
+def _check_broadcast_tree(b, n, expect_root=None):
+    """Every node reachable exactly once from the root."""
+    roots = [i for i in range(n) if b.is_self_loop(i)]
+    assert len(roots) == 1
+    if expect_root is not None:
+        assert roots[0] == expect_root
+    seen = set()
+    stack = [roots[0]]
+    while stack:
+        i = stack.pop()
+        assert i not in seen
+        seen.add(i)
+        stack.extend(b.nexts(i))
+    assert seen == set(range(n))
+
+
+def _check_reduce_graph(r, n):
+    # every node contributes itself
+    for i in range(n):
+        assert r.is_self_loop(i)
+
+
+class TestTopology:
+    @pytest.mark.parametrize("n", [1, 2, 3, 4, 7, 8, 16])
+    def test_star(self, n):
+        red, bc = gen_star(n)
+        _check_broadcast_tree(bc, n, expect_root=0)
+        _check_reduce_graph(red, n)
+
+    @pytest.mark.parametrize("n", [1, 2, 5, 8])
+    def test_tree_families(self, n):
+        for gen in (gen_tree, gen_binary_tree):
+            red, bc = gen(n)
+            _check_broadcast_tree(bc, n)
+            _check_reduce_graph(red, n)
+
+    @pytest.mark.parametrize("hosts,n", [([[0, 1], [2, 3]], 4), ([[0, 1, 2, 3], [4, 5, 6, 7]], 8), ([[0]], 1)])
+    def test_binary_tree_star(self, hosts, n):
+        red, bc = gen_binary_tree_star(n, hosts)
+        _check_broadcast_tree(bc, n)
+        _check_reduce_graph(red, n)
+
+    def test_multi_binary_tree_star(self):
+        pairs = gen_multi_binary_tree_star(4, [[0, 1], [2, 3]])
+        assert len(pairs) == 2
+        for red, bc in pairs:
+            _check_broadcast_tree(bc, 4)
+
+    def test_multi_star(self):
+        pairs = gen_multi_star(3)
+        assert len(pairs) == 3
+        for c, (red, bc) in enumerate(pairs):
+            _check_broadcast_tree(bc, 3, expect_root=c)
+
+    @pytest.mark.parametrize("n", [2, 4, 8])
+    def test_ring(self, n):
+        red, bc = gen_circular_graph_pair(n)
+        # reduce chain ends where broadcast starts
+        _check_reduce_graph(red, n)
+        ends = [i for i in range(n) if not red.nexts(i)]
+        assert len(ends) == 1
+        assert bc.is_self_loop(ends[0])
+
+
+class TestStrategy:
+    def test_parse(self):
+        assert parse_strategy("ring") == Strategy.RING
+        assert parse_strategy("binary-tree-star") == Strategy.BINARY_TREE_STAR
+        with pytest.raises(ValueError):
+            parse_strategy("nope")
+
+    def test_auto(self):
+        assert auto_select(1) == Strategy.STAR
+        assert auto_select(3) == Strategy.BINARY_TREE_STAR
+
+
+class TestCluster:
+    def _cluster(self, spec="a:4,b:4", np=4):
+        hl = HostList.parse(spec)
+        return Cluster(hl.gen_runner_list(), hl.gen_peer_list(np))
+
+    def test_json_roundtrip(self):
+        c = self._cluster()
+        c2 = Cluster.from_json(c.to_json())
+        assert c2 == c
+        assert c.digest() == c2.digest()
+
+    def test_validate_orphan_worker(self):
+        with pytest.raises(ValueError):
+            Cluster(
+                PeerList.parse("a:38080"),
+                PeerList.parse("b:10000"),
+            ).validate()
+
+    def test_shrink(self):
+        c = self._cluster(np=4).resize(2)
+        assert c.size() == 2
+        assert [str(p) for p in c.workers] == ["a:10000", "a:10001"]
+
+    def test_grow(self):
+        c = self._cluster(np=2)  # both on host a
+        g = c.resize(4)
+        assert g.size() == 4
+        hosts = [p.host for p in g.workers]
+        assert hosts.count("b") >= 1  # grew onto the empty host first
+
+    def test_grow_beyond_capacity(self):
+        hl = HostList.parse("a:1")
+        c = Cluster(hl.gen_runner_list(), hl.gen_peer_list(1))
+        # port-range capacity is large; grow within range works
+        assert c.resize(3).size() == 3
+
+    def test_digest_changes(self):
+        c = self._cluster()
+        assert c.digest() != c.resize(2).digest()
