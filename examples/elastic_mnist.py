@@ -1,0 +1,86 @@
+"""Elastic MNIST training — online cluster resize mid-job.
+
+Parity with reference ``tests/python/integration/test_elastic_estimator.py``
+(+ ``gen_schedule.py``): train under a step-based schedule like
+``1:8,2:8,4:8`` — the cluster grows/shrinks at the scheduled steps without
+restarting the job; weights re-broadcast after every membership change.
+
+Run (watch mode + builtin config server)::
+
+    python -m kungfu_tpu.runner.cli -w -builtin-config-port 9100 \
+        -np 1 -H 127.0.0.1:4 python3 examples/elastic_mnist.py \
+        --schedule 1:6,2:6,4:6
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--schedule", default="1:6,2:6")
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.2)
+    args = ap.parse_args()
+
+    import kungfu_tpu as kf
+    from kungfu_tpu.elastic import ElasticState, elastic_step
+    from kungfu_tpu.elastic.schedule import total_steps
+    from kungfu_tpu.initializer import broadcast_parameters
+    from kungfu_tpu.models import mnist_slp
+    from examples.mnist_slp import synthetic_mnist
+
+    peer = kf.init()
+    rank = kf.current_rank()
+    print(f"worker {rank}/{kf.cluster_size()} up (v{peer.cluster_version})", flush=True)
+
+    model = mnist_slp()
+    params = model.init(jax.random.PRNGKey(7 + rank))
+    params = broadcast_parameters(params, peer)
+
+    x, y = synthetic_mnist()
+    loss_grad = jax.jit(jax.value_and_grad(model.loss))
+    opt = optax.sgd(args.lr)
+    opt_state = opt.init(params)
+
+    state = ElasticState()
+    n_steps = total_steps(args.schedule)
+    sizes_seen = []
+    while state.step < n_steps:
+        size, rank = kf.cluster_size(), kf.current_rank()
+        sizes_seen.append(size)
+        # data-parallel batch: worker `rank` takes slice `rank` of step's window
+        lo = ((state.step * size + rank) * args.batch_size) % (len(x) - args.batch_size)
+        xb, yb = x[lo : lo + args.batch_size], y[lo : lo + args.batch_size]
+        loss, grads = loss_grad(params, (xb, yb))
+        engine = peer.engine()
+        if engine is not None:
+            flat, spec = kf.ops.fuse(grads)
+            red = engine.all_reduce(np.asarray(flat), op="mean")
+            grads = kf.ops.defuse(jnp.asarray(red), spec)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        state, params, stop = elastic_step(peer, state, args.schedule, params)
+        if stop:
+            print(f"worker {rank}: detached at step {state.step}", flush=True)
+            return 0
+        if rank == 0 and state.step % 3 == 0:
+            print(f"step {state.step}: size {kf.cluster_size()} loss {float(loss):.4f}", flush=True)
+
+    print(
+        f"worker {kf.current_rank()}: done at step {state.step}, "
+        f"sizes seen {sorted(set(sizes_seen))}, resizes survived {state.resized}",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,6 +25,7 @@ Top-level convenience API (parity with reference
     >>> kf.current_rank(), kf.cluster_size()
 """
 
+from kungfu_tpu import ops  # noqa: F401
 from kungfu_tpu.python import (  # noqa: F401
     current_rank,
     current_local_rank,

@@ -179,9 +179,10 @@ class Communicator:
 
             def build():
                 def body(s):
-                    idx = _flat_index()
-                    mask = (idx == root).astype(s.dtype)
-                    return jax.lax.psum(s * mask, GLOBAL_AXES)
+                    # where() not mask-multiply: non-root NaN must not
+                    # poison the psum (broadcast recovers diverged replicas)
+                    contrib = jnp.where(_flat_index() == root, s, jnp.zeros_like(s))
+                    return jax.lax.psum(contrib, GLOBAL_AXES)
 
                 return self._shard_jit(body)
 
@@ -237,8 +238,8 @@ class Communicator:
             def build():
                 def body(s):
                     idx = jax.lax.axis_index(LOCAL_AXIS)
-                    mask = (idx == 0).astype(s.dtype)
-                    return jax.lax.psum(s * mask, (LOCAL_AXIS,))
+                    contrib = jnp.where(idx == 0, s, jnp.zeros_like(s))
+                    return jax.lax.psum(contrib, (LOCAL_AXIS,))
 
                 return self._shard_jit(body)
 

@@ -3,9 +3,13 @@
 TPU-native analog of the reference's rchannel
 (``srcs/go/rchannel/{connection,client,server,handler}``): typed,
 named messages over TCP between peers, rendezvous-by-name receive queues,
-connect retries while peers come up, and **version-token fencing** — a
-message tagged with a stale cluster version is rejected, exactly like the
-reference's connection-token check (``connection.go:28-47,77-87``).
+connect retries while peers come up, and **version-token fencing** — every
+COLLECTIVE message is queued under the cluster-version token it was sent
+with and only ever *read* under the receiver's current token, so stale
+payloads can never alias a later epoch's collectives (the moral equivalent
+of the reference's connection-token check, ``connection.go:28-47,77-87``;
+we queue-and-isolate rather than drop so a future-epoch message arriving
+early is preserved).
 
 This layer deliberately does *not* carry gradient traffic (that is the
 device plane, :mod:`kungfu_tpu.comm.device`).  It exists for the phases
@@ -104,8 +108,8 @@ class HostChannel:
     """Per-process message endpoint.
 
     ``token`` is the cluster version; bump it with :meth:`set_token` on
-    membership change — in-flight COLLECTIVE messages from the old epoch
-    are then dropped (fencing).
+    membership change — COLLECTIVE queues of older epochs are purged and
+    any late stale-epoch arrival is discarded at enqueue (fencing).
     """
 
     def __init__(self, self_id: PeerID, token: int = 0, bind_host: str = ""):
@@ -115,17 +119,22 @@ class HostChannel:
         self._qlock = threading.Lock()
         self._control_handlers = []
         self._p2p_handlers = []
+        self._pool: Dict[PeerID, list] = {}
+        self._pool_lock = threading.Lock()
 
         chan = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
-                try:
-                    msg = _decode(self.request)
-                except (ConnectionError, ValueError) as e:
-                    _log.debug("bad message: %s", e)
-                    return
-                chan._dispatch(msg, self.request)
+                # stream loop: a pooled client sends many messages on one
+                # connection (reference Stream(), handler.go:30-41)
+                while True:
+                    try:
+                        msg = _decode(self.request)
+                    except (ConnectionError, ValueError, OSError) as e:
+                        _log.debug("connection done: %s", e)
+                        return
+                    chan._dispatch(msg, self.request)
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -137,20 +146,36 @@ class HostChannel:
 
     # -- lifecycle -------------------------------------------------------
     def close(self) -> None:
+        self.reset_connections()
         self._server.shutdown()
         self._server.server_close()
 
     def set_token(self, token: int) -> None:
+        """Move to a new cluster epoch; purge collective queues of older
+        epochs (their contents can never legally be read again)."""
         self._token = token
+        with self._qlock:
+            dead = [
+                k for k in self._queues
+                if k[0] == ConnType.COLLECTIVE and k[3] < token
+            ]
+            for k in dead:
+                del self._queues[k]
 
     @property
     def token(self) -> int:
         return self._token
 
     # -- dispatch --------------------------------------------------------
-    def _queue(self, conn_type: int, src: str, name: str) -> queue.Queue:
+    def _queue(self, conn_type: int, src: str, name: str, token: int = 0) -> queue.Queue:
+        # COLLECTIVE queues are keyed by epoch token so a stale queued
+        # payload can never alias a same-named collective of a later epoch
         with self._qlock:
-            key = (conn_type, src, name)
+            if conn_type == ConnType.COLLECTIVE and token < self._token:
+                # late stale-epoch arrival: nothing will ever read it and
+                # the purge already ran — don't retain the payload
+                return queue.Queue()
+            key = (conn_type, src, name, token if conn_type == ConnType.COLLECTIVE else 0)
             q = self._queues.get(key)
             if q is None:
                 q = self._queues[key] = queue.Queue()
@@ -163,12 +188,18 @@ class HostChannel:
             except OSError:
                 pass
             return
+        # COLLECTIVE fencing: messages are queued under their epoch token and
+        # only ever read under the receiver's *current* token.  Stale-epoch
+        # payloads land in queues nobody reads (purged on set_token); a
+        # future-epoch message arriving before this peer bumps its token is
+        # preserved, not dropped — the sender already moved to the new epoch
+        # and will not retry (drop-at-dispatch would deadlock the first
+        # post-resize collective).
         if msg.conn_type == ConnType.COLLECTIVE and msg.token != self._token:
-            _log.warning(
-                "dropping %s from %s: token %d != current %d (fenced)",
+            _log.debug(
+                "queueing %s from %s under epoch %d (current %d)",
                 msg.name, msg.src, msg.token, self._token,
             )
-            return
         if msg.conn_type == ConnType.CONTROL and self._control_handlers:
             for h in list(self._control_handlers):
                 h(msg.name, msg.payload, msg.src)
@@ -181,7 +212,7 @@ class HostChannel:
             for h in list(self._p2p_handlers):
                 h(msg.name, msg.payload, msg.src)
             return
-        self._queue(msg.conn_type, msg.src, msg.name).put(msg.payload)
+        self._queue(msg.conn_type, msg.src, msg.name, msg.token).put(msg.payload)
 
     def on_control(self, handler) -> None:
         """Register ``handler(name, payload, src)`` for CONTROL messages."""
@@ -203,6 +234,18 @@ class HostChannel:
                 time.sleep(CONNECT_RETRY_PERIOD_S)
         raise ConnectionError(f"cannot reach {peer} after {retries} retries: {last}")
 
+    def _pooled(self, peer: PeerID):
+        """Persistent per-peer send connection slot + its lock (reference
+        keeps a connection pool in rchannel/client; per-message connect
+        would exhaust ephemeral ports on the gradient path).  The connect
+        itself happens in send() *under* the entry lock, so concurrent
+        first sends cannot double-connect."""
+        with self._pool_lock:
+            entry = self._pool.get(peer)
+            if entry is None:
+                entry = self._pool[peer] = [None, threading.Lock()]
+            return entry
+
     def send(
         self,
         peer: PeerID,
@@ -211,15 +254,46 @@ class HostChannel:
         conn_type: ConnType = ConnType.COLLECTIVE,
         retries: int = CONNECT_RETRIES,
     ) -> None:
-        with self._connect(peer, retries) as sock:
-            sock.sendall(_encode(self._token, conn_type, str(self.self_id), name, payload))
+        data = _encode(self._token, conn_type, str(self.self_id), name, payload)
+        entry = self._pooled(peer)
+        with entry[1]:
+            if entry[0] is None:
+                entry[0] = self._connect(peer, retries)
+            try:
+                entry[0].sendall(data)
+            except OSError:
+                # stale pooled socket (peer restarted): reconnect once
+                try:
+                    entry[0].close()
+                except OSError:
+                    pass
+                entry[0] = None
+                entry[0] = self._connect(peer, retries)
+                entry[0].sendall(data)
+
+    def reset_connections(self) -> None:
+        """Drop pooled connections (on membership change; reference
+        ``client.go:82`` ResetConnections).  Sockets are closed without
+        taking the per-entry send locks: a sender stuck in the reconnect
+        loop toward a dead peer must not block the reset (its in-flight
+        sendall fails fast when the socket closes under it)."""
+        with self._pool_lock:
+            entries = list(self._pool.values())
+            self._pool.clear()
+        for entry in entries:
+            sock = entry[0]
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
 
     def recv(
         self, src: PeerID, name: str, conn_type: ConnType = ConnType.COLLECTIVE,
         timeout: Optional[float] = 60.0,
     ) -> bytes:
         try:
-            return self._queue(conn_type, str(src), name).get(timeout=timeout)
+            return self._queue(conn_type, str(src), name, self._token).get(timeout=timeout)
         except queue.Empty:
             raise TimeoutError(f"recv {name!r} from {src} timed out after {timeout}s") from None
 

@@ -1,0 +1,30 @@
+"""Elasticity — online cluster resize without restarting the job.
+
+Parity with the reference's headline capability (``resize_cluster``):
+
+* :mod:`kungfu_tpu.elastic.configserver` — HTTP cluster-config store
+  (reference ``srcs/go/kungfu/elastic/configserver``);
+* :mod:`kungfu_tpu.elastic.resize` — worker-side fetch + consensus
+  protocol (reference ``peer/peer.go:227-276``);
+* :mod:`kungfu_tpu.elastic.schedule` — ``step_based_schedule`` config
+  parsing (reference ``tensorflow/ops/cpu/elastic.cpp:16-82``);
+* :mod:`kungfu_tpu.elastic.hooks` — the elastic train loop driver
+  (reference ``hooks/elastic.py`` KungFuElasticTrainHook).
+
+On TPU a resize is a **mesh-epoch swap**: membership changes on the host
+plane (consensus + runner notify), then the next ``communicator()`` /
+``engine()`` call builds the new epoch and state is re-broadcast from rank
+0 — the analog of the reference's new Session + ``ResetNcclHelper``.
+"""
+
+from kungfu_tpu.elastic.configserver import ConfigServer
+from kungfu_tpu.elastic.schedule import step_based_schedule, parse_schedule
+from kungfu_tpu.elastic.hooks import ElasticState, elastic_step
+
+__all__ = [
+    "ConfigServer",
+    "step_based_schedule",
+    "parse_schedule",
+    "ElasticState",
+    "elastic_step",
+]

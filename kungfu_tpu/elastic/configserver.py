@@ -1,0 +1,112 @@
+"""HTTP cluster-config store.
+
+REST parity with reference ``elastic/configserver/configserver.go:24-112``:
+
+* ``GET  /get``   → ``{"version": N, "cluster": {...}}`` (404 when cleared)
+* ``PUT  /put``   → body = cluster JSON; validated; version++
+* ``POST /reset`` → body = cluster JSON; reset to version 0
+* ``DELETE /``    → clear
+* ``GET  /stop``  → shut the server down
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from kungfu_tpu.plan.cluster import Cluster
+from kungfu_tpu.utils.log import get_logger
+
+_log = get_logger("config-server")
+
+
+class ConfigServer:
+    def __init__(self, port: int = 9100, cluster: Optional[Cluster] = None, host: str = "0.0.0.0"):
+        self.port = port
+        self._lock = threading.Lock()
+        self._cluster = cluster
+        self._version = 0
+        self._thread: Optional[threading.Thread] = None
+        srv = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                _log.debug(fmt, *args)
+
+            def _reply(self, code: int, body: bytes = b""):
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+            def _body(self) -> bytes:
+                n = int(self.headers.get("Content-Length", "0"))
+                return self.rfile.read(n)
+
+            def do_GET(self):
+                if self.path.startswith("/stop"):
+                    self._reply(200, b"{}")
+                    threading.Thread(target=srv.stop, daemon=True).start()
+                    return
+                with srv._lock:
+                    if srv._cluster is None:
+                        self._reply(404, b'{"error": "no cluster"}')
+                        return
+                    body = json.dumps(
+                        {"version": srv._version, "cluster": json.loads(srv._cluster.to_json())}
+                    ).encode()
+                self._reply(200, body)
+
+            def do_PUT(self):
+                try:
+                    cluster = Cluster.from_json(self._body().decode())
+                except (ValueError, KeyError) as e:
+                    self._reply(400, json.dumps({"error": str(e)}).encode())
+                    return
+                with srv._lock:
+                    srv._cluster = cluster
+                    srv._version += 1
+                    v = srv._version
+                _log.info("cluster updated to version %d (n=%d)", v, cluster.size())
+                self._reply(200, json.dumps({"version": v}).encode())
+
+            def do_POST(self):
+                try:
+                    cluster = Cluster.from_json(self._body().decode())
+                except (ValueError, KeyError) as e:
+                    self._reply(400, json.dumps({"error": str(e)}).encode())
+                    return
+                with srv._lock:
+                    srv._cluster = cluster
+                    srv._version = 0
+                self._reply(200, b'{"version": 0}')
+
+            def do_DELETE(self):
+                with srv._lock:
+                    srv._cluster = None
+                    srv._version = 0
+                self._reply(200, b"{}")
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}/get"
+
+    def start(self) -> "ConfigServer":
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def snapshot(self):
+        with self._lock:
+            return self._version, self._cluster

@@ -1,0 +1,91 @@
+"""Elastic train-loop driver.
+
+Parity with reference ``KungFuElasticTrainHook`` (``hooks/elastic.py:14-87``)
+and the policy hooks: once per training step the loop (1) re-syncs the
+global step by allreduce-MAX, (2) proposes the scheduled cluster size,
+(3) runs the resize protocol, and (4) after a membership change
+re-broadcasts params from rank 0 and re-syncs the step — or stops if this
+worker was detached.
+
+New workers spawned mid-job by the watch runner join at the new cluster
+version; their *initial* ``broadcast_parameters`` call (named by cluster
+version) rendezvouses with the survivors' *re*-broadcast, so state flows
+to them without a checkpoint.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Optional, Tuple
+
+import numpy as np
+
+from kungfu_tpu.elastic.schedule import step_based_schedule
+from kungfu_tpu.initializer import broadcast_parameters
+from kungfu_tpu.utils.log import get_logger, log_event
+
+_log = get_logger("elastic")
+
+
+@dataclass
+class ElasticState:
+    step: int = 0
+    detached: bool = False
+    resized: int = 0  # number of membership changes survived
+
+
+def sync_step(peer, step: int) -> int:
+    """Cluster-wide step = MAX over workers (reference
+    ``hooks/elastic.py:33,50-52``) — new joiners jump to the global step."""
+    engine = peer.engine()
+    if engine is None:
+        return step
+    # auto-named (engine sequence numbers): a joiner's first sync must
+    # rendezvous with the survivors' Nth — names must not embed the step
+    out = engine.all_reduce(np.array([step], np.int64), op="max")
+    return int(out[0])
+
+
+def elastic_step(
+    peer,
+    state: ElasticState,
+    schedule: Optional[str],
+    params,
+) -> Tuple[ElasticState, object, bool]:
+    """Run once per completed training step.
+
+    Returns ``(new_state, params, should_stop)``; ``params`` are re-broadcast
+    when membership changed.
+
+    Call order per training step is: local grads → gradient allreduce →
+    apply → ``elastic_step``.  The step re-sync happens *first* here so a
+    newly-joined worker (local step 0) jumps to the global step before the
+    schedule is consulted — otherwise it would propose the schedule's
+    step-0 size and shrink the cluster it just joined."""
+    step = sync_step(peer, state.step)
+    target = step_based_schedule(schedule, step) if schedule else peer.size()
+    changed = False
+    if target != peer.size():
+        log_event(f"proposing-resize-{peer.size()}->{target}-at-step-{step}")
+        if peer.config.config_server:
+            peer.propose_new_size(target)
+            changed = peer.resize_cluster_from_url()
+        else:
+            _log.warning("no config server; cannot resize to %d", target)
+    if changed:
+        if peer.detached:
+            log_event("detached-stopping")
+            return replace(state, detached=True), params, True
+        log_event(f"resynced-after-resize-v{peer.cluster_version}")
+        # re-broadcast runs on the host channel (safe while the new engine
+        # is cold).  Do NOT run an engine collective here: a joiner's first
+        # engine op is its step's gradient allreduce, so the survivors'
+        # first new-epoch engine op must be the same — alignment happens at
+        # the top of the next elastic_step via sync_step.
+        params = broadcast_parameters(params, peer)
+        return (
+            ElasticState(step=step + 1, resized=state.resized + 1),
+            params,
+            False,
+        )
+    return replace(state, step=step + 1), params, False

@@ -1,0 +1,51 @@
+"""Worker-side resize protocol: fetch + consensus.
+
+Parity with reference ``peer/peer.go:236-276``: loop — GET the cluster
+JSON from the config server, run a bytes-consensus over its digest among
+the *current* workers until every peer observed the same config, then hand
+the agreed (cluster, version) to ``Peer._propose``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Tuple
+
+from kungfu_tpu.plan.cluster import Cluster
+from kungfu_tpu.utils.log import get_logger
+
+_log = get_logger("resize")
+
+FETCH_RETRY_PERIOD_S = 0.2
+DEFAULT_TIMEOUT_S = 120.0
+
+
+def fetch_cluster(url: str) -> Tuple[Cluster, int]:
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        doc = json.loads(resp.read().decode())
+    cluster = Cluster.from_json(json.dumps(doc["cluster"]))
+    return cluster, int(doc["version"])
+
+
+def fetch_cluster_with_consensus(peer, timeout: float = DEFAULT_TIMEOUT_S) -> Tuple[Cluster, int]:
+    """All current workers converge on one (cluster, version) snapshot."""
+    url = peer.config.config_server
+    deadline = time.time() + timeout
+    attempt = 0
+    while True:
+        if time.time() > deadline:
+            raise TimeoutError(f"no consensus on cluster config after {timeout}s")
+        try:
+            cluster, version = fetch_cluster(url)
+        except (urllib.error.URLError, OSError, KeyError, ValueError) as e:
+            _log.debug("config fetch failed: %s", e)
+            time.sleep(FETCH_RETRY_PERIOD_S)
+            continue
+        payload = cluster.digest() + version.to_bytes(8, "little")
+        if peer.consensus_bytes(payload, name=f"resize.{attempt}"):
+            return cluster, version
+        attempt += 1
+        time.sleep(FETCH_RETRY_PERIOD_S)

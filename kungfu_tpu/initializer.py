@@ -1,0 +1,52 @@
+"""Variable broadcast initialization.
+
+Parity with reference ``kungfu/tensorflow/initializer`` (
+``BroadcastGlobalVariablesOp/Hook/Callback``) and ``torch
+broadcast_parameters``: make every worker start from (or re-sync to) rank
+0's weights — at job start, and again after every elastic resize
+(reference ``hooks/elastic.py:54``).
+
+Two paths:
+
+* :func:`broadcast_parameters` — host-side, process-to-process over the
+  host channel (works while no mesh exists, e.g. right after a resize).
+* :func:`device_broadcast` — in-jit ``ops.broadcast`` over the mesh axis
+  (for stacked/simulated peers or per-device states).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kungfu_tpu import ops
+from kungfu_tpu.ops.fuse import defuse, fuse
+
+
+def broadcast_parameters(params, peer=None, root: int = 0, name: str = "bcast-params"):
+    """Replace every worker's params with rank ``root``'s (host channel)."""
+    if peer is None:
+        from kungfu_tpu.python import init as _init
+
+        peer = _init()
+    if peer.size() <= 1 or peer.channel is None:
+        return params
+    buf, spec = fuse(params, dtype=jnp.float32)
+    data = np.asarray(buf).tobytes() if peer.rank() == root else None
+    # star broadcast rooted at `root`: reuse rank-0 rooted primitive by
+    # rotating the peer list so `root` is first
+    workers = peer.cluster.workers
+    order = list(range(len(workers)))
+    order = order[root:] + order[:root]
+    rotated = workers.select(order)
+    blob = peer.channel.broadcast_bytes(
+        data, rotated, name=f"{name}.v{peer.cluster_version}"
+    )
+    arr = jnp.asarray(np.frombuffer(blob, dtype=np.float32).copy())
+    return defuse(arr, spec)
+
+
+def device_broadcast(params, axis, root: int = 0):
+    """In-jit broadcast of a param pytree from peer ``root`` over ``axis``."""
+    return ops.broadcast(params, axis, root=root)

@@ -1,0 +1,40 @@
+"""Model zoo + minimal functional NN library.
+
+The reference framework carries no model code (it moves gradient buffers;
+models live in its examples/benchmarks: MNIST SLP/CNN examples, and the
+ResNet-50/VGG16/BERT *size lists* used by its benchmark harnesses —
+``srcs/python/kungfu/tensorflow/v1/benchmarks/model_sizes.py``,
+``tests/go/fakemodel/fakemodel.go:12-17``).  The TPU build ships real
+models because they are its benchmark workload:
+
+* :mod:`kungfu_tpu.models.nn` — tiny functional layer library (explicit
+  param pytrees, pure apply fns — jit/shard_map friendly, bf16-first).
+* :mod:`kungfu_tpu.models.mlp` — MNIST SLP/MLP (the reference's minimum
+  end-to-end example, ``examples/tf1_mnist_session.py``).
+* :mod:`kungfu_tpu.models.resnet` — ResNet-50 (v1.5), NHWC, bf16 compute.
+* :mod:`kungfu_tpu.models.transformer` — GPT-style transformer (the
+  flagship; BERT-base-sized config included), ring-attention capable.
+* :mod:`kungfu_tpu.models.fake` — gradient-shaped fake models for
+  collective benchmarking without real compute (parity with
+  ``tests/go/fakemodel``).
+"""
+
+from kungfu_tpu.models import nn
+from kungfu_tpu.models.mlp import MLP, mnist_slp
+from kungfu_tpu.models.resnet import ResNet, resnet50
+from kungfu_tpu.models.transformer import Transformer, TransformerConfig, bert_base, gpt_small
+from kungfu_tpu.models.fake import fake_model_sizes, fake_grads
+
+__all__ = [
+    "nn",
+    "MLP",
+    "mnist_slp",
+    "ResNet",
+    "resnet50",
+    "Transformer",
+    "TransformerConfig",
+    "bert_base",
+    "gpt_small",
+    "fake_model_sizes",
+    "fake_grads",
+]

@@ -1,0 +1,65 @@
+"""Gradient-shaped fake models for collective benchmarking.
+
+Parity with reference ``tests/go/fakemodel/fakemodel.go:12-17`` and the
+benchmark size lists (``kungfu/tensorflow/v1/benchmarks/model_sizes.py``):
+parameter-count lists for resnet50-imagenet, vgg16-imagenet, bert and
+slp-mnist, materialized as gradient-shaped buffers without any compute —
+used to measure allreduce bus bandwidth.
+
+Sizes are the classic per-variable parameter counts used by such harnesses
+(grouped to keep the lists manageable); totals match the well-known model
+sizes (~25.6M ResNet-50, ~138M VGG16, ~110M BERT-base, 7.9k SLP).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+# per-tensor float counts (grouped); totals are what matters for bandwidth
+_FAKE_SIZES: Dict[str, List[int]] = {
+    "slp-mnist": [784 * 10, 10],
+    "resnet50-imagenet": (
+        [9408, 64, 64]
+        + [4096, 16384, 36864, 64, 64, 256] * 3
+        + [32768, 131072, 147456, 128, 128, 512] * 4
+        + [131072, 524288, 589824, 256, 256, 1024] * 6
+        + [524288, 2097152, 2359296, 512, 512, 2048] * 3
+        + [2048 * 1000, 1000]
+    ),
+    "vgg16-imagenet": [
+        1728, 36864, 73728, 147456, 294912, 589824, 589824,
+        1179648, 2359296, 2359296, 2359296, 2359296, 2359296,
+        102760448, 16777216, 4096000,
+    ],
+    "bert": [30528 * 768, 512 * 768, 2 * 768]
+    + [768 * 768 * 4 + 768 * 4 + 768 * 3072 * 2 + 3072 + 768 * 3] * 12
+    + [768 * 768, 768],
+}
+
+
+def fake_model_names() -> List[str]:
+    return sorted(_FAKE_SIZES)
+
+
+def fake_model_sizes(name: str) -> List[int]:
+    try:
+        return list(_FAKE_SIZES[name])
+    except KeyError:
+        raise ValueError(f"unknown fake model {name!r}; one of {fake_model_names()}") from None
+
+
+def fake_grads(name: str, dtype=np.float32, stacked: int = 0, seed: int = 0):
+    """Materialize gradient-shaped buffers; with ``stacked=n`` adds a
+    leading peer axis for the eager communicator."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for sz in fake_model_sizes(name):
+        shape = (stacked, sz) if stacked else (sz,)
+        out.append(rng.uniform(-1, 1, size=shape).astype(dtype))
+    return out
+
+
+def total_params(name: str) -> int:
+    return sum(fake_model_sizes(name))

@@ -1,0 +1,58 @@
+"""MNIST SLP / MLP — the minimum end-to-end model.
+
+Parity with the reference's canonical example workload
+(``examples/tf1_mnist_session.py`` single-layer perceptron, also used by
+its convergence test ``tests/python/integration/test_mnist_slp.py`` and
+the ``slp-mnist`` fake model ``tests/go/fakemodel/fakemodel.go``).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from kungfu_tpu.models import nn
+
+
+class MLP:
+    """Plain MLP: flatten → dense(+relu)* → dense(logits)."""
+
+    def __init__(self, layer_dims: Sequence[int], num_classes: int = 10, input_dim: int = 784):
+        self.dims = [input_dim] + list(layer_dims) + [num_classes]
+
+    def init(self, key):
+        keys = jax.random.split(key, len(self.dims) - 1)
+        return {
+            f"dense_{i}": nn.dense_init(keys[i], self.dims[i], self.dims[i + 1])
+            for i in range(len(self.dims) - 1)
+        }
+
+    def apply(self, params, x, dtype=None):
+        x = x.reshape(x.shape[0], -1)
+        if dtype is not None:
+            x = x.astype(dtype)
+        n = len(self.dims) - 1
+        for i in range(n):
+            x = nn.dense_apply(params[f"dense_{i}"], x, dtype=dtype)
+            if i < n - 1:
+                x = jax.nn.relu(x)
+        return x.astype(jnp.float32)
+
+    def loss(self, params, batch, dtype=None):
+        """Softmax cross-entropy mean loss; batch = (images, int labels)."""
+        x, y = batch
+        logits = self.apply(params, x, dtype=dtype)
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(logp, y[:, None], axis=1).squeeze(1)
+        return jnp.mean(nll)
+
+    def accuracy(self, params, batch, dtype=None):
+        x, y = batch
+        return jnp.mean((jnp.argmax(self.apply(params, x, dtype=dtype), -1) == y).astype(jnp.float32))
+
+
+def mnist_slp() -> MLP:
+    """Single-layer perceptron 784→10 (the reference example's model)."""
+    return MLP(layer_dims=[], num_classes=10, input_dim=784)

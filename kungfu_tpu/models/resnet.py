@@ -1,0 +1,124 @@
+"""ResNet v1.5 (50/101/152) — NHWC, bf16 compute, TPU-friendly.
+
+The reference benchmarks throughput on ResNet-50 via Keras applications
+(``benchmarks/system/benchmark_kungfu.py``) and ships its layer-size list
+as a fake model (``tests/go/fakemodel/fakemodel.go:12``).  This is a fresh
+implementation: bottleneck v1.5 (stride in the 3x3), sync-BN capable,
+channels-last for XLA's TPU conv layouts.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from kungfu_tpu.models import nn
+
+_STAGES = {
+    50: (3, 4, 6, 3),
+    101: (3, 4, 23, 3),
+    152: (3, 8, 36, 3),
+}
+
+
+class ResNet:
+    def __init__(self, depth: int = 50, num_classes: int = 1000, width: int = 64):
+        if depth not in _STAGES:
+            raise ValueError(f"depth must be one of {sorted(_STAGES)}")
+        self.blocks_per_stage = _STAGES[depth]
+        self.num_classes = num_classes
+        self.width = width
+
+    # -- init ------------------------------------------------------------
+    def init(self, key) -> Tuple[dict, dict]:
+        """Returns (params, bn_state)."""
+        params, state = {}, {}
+        key, k = jax.random.split(key)
+        params["stem"] = nn.conv_init(k, 3, self.width, (7, 7))
+        params["stem_bn"] = nn.batchnorm_init(self.width)
+        state["stem_bn"] = nn.batchnorm_state_init(self.width)
+
+        in_ch = self.width
+        for s, nblocks in enumerate(self.blocks_per_stage):
+            mid = self.width * (2 ** s)
+            out_ch = mid * 4
+            for b in range(nblocks):
+                name = f"s{s}b{b}"
+                key, *ks = jax.random.split(key, 5)
+                blk = {
+                    "conv1": nn.conv_init(ks[0], in_ch, mid, (1, 1)),
+                    "bn1": nn.batchnorm_init(mid),
+                    "conv2": nn.conv_init(ks[1], mid, mid, (3, 3)),
+                    "bn2": nn.batchnorm_init(mid),
+                    "conv3": nn.conv_init(ks[2], mid, out_ch, (1, 1)),
+                    "bn3": nn.batchnorm_init(out_ch),
+                }
+                st = {
+                    "bn1": nn.batchnorm_state_init(mid),
+                    "bn2": nn.batchnorm_state_init(mid),
+                    "bn3": nn.batchnorm_state_init(out_ch),
+                }
+                if b == 0:
+                    blk["proj"] = nn.conv_init(ks[3], in_ch, out_ch, (1, 1))
+                    blk["proj_bn"] = nn.batchnorm_init(out_ch)
+                    st["proj_bn"] = nn.batchnorm_state_init(out_ch)
+                params[name] = blk
+                state[name] = st
+                in_ch = out_ch
+        key, k = jax.random.split(key)
+        params["head"] = nn.dense_init(k, in_ch, self.num_classes)
+        return params, state
+
+    # -- apply -----------------------------------------------------------
+    def apply(self, params, state, x, train: bool = False, dtype=jnp.bfloat16, axis_name=None):
+        """x: [N, H, W, 3] float.  Returns (logits_f32, new_state)."""
+        new_state = {}
+        x = x.astype(dtype)
+
+        h = nn.conv_apply(params["stem"], x, stride=2, dtype=dtype)
+        h, ns = nn.batchnorm_apply(params["stem_bn"], state["stem_bn"], h, train, axis_name=axis_name)
+        new_state["stem_bn"] = ns
+        h = jax.nn.relu(h)
+        h = jax.lax.reduce_window(
+            h, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+        )
+
+        for s, nblocks in enumerate(self.blocks_per_stage):
+            for b in range(nblocks):
+                name = f"s{s}b{b}"
+                blk, bst = params[name], state[name]
+                nst = {}
+                stride = 2 if (b == 0 and s > 0) else 1
+                shortcut = h
+                y = nn.conv_apply(blk["conv1"], h, dtype=dtype)
+                y, nst["bn1"] = nn.batchnorm_apply(blk["bn1"], bst["bn1"], y, train, axis_name=axis_name)
+                y = jax.nn.relu(y)
+                y = nn.conv_apply(blk["conv2"], y, stride=stride, dtype=dtype)
+                y, nst["bn2"] = nn.batchnorm_apply(blk["bn2"], bst["bn2"], y, train, axis_name=axis_name)
+                y = jax.nn.relu(y)
+                y = nn.conv_apply(blk["conv3"], y, dtype=dtype)
+                y, nst["bn3"] = nn.batchnorm_apply(blk["bn3"], bst["bn3"], y, train, axis_name=axis_name)
+                if "proj" in blk:
+                    shortcut = nn.conv_apply(blk["proj"], h, stride=stride, dtype=dtype)
+                    shortcut, nst["proj_bn"] = nn.batchnorm_apply(
+                        blk["proj_bn"], bst["proj_bn"], shortcut, train, axis_name=axis_name
+                    )
+                h = jax.nn.relu(y + shortcut)
+                new_state[name] = nst
+
+        h = jnp.mean(h.astype(jnp.float32), axis=(1, 2))
+        logits = nn.dense_apply(params["head"], h)
+        return logits, new_state
+
+    def loss(self, params, state, batch, train: bool = True, dtype=jnp.bfloat16, axis_name=None):
+        x, y = batch
+        logits, new_state = self.apply(params, state, x, train=train, dtype=dtype, axis_name=axis_name)
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(logp, y[:, None], axis=1).squeeze(1)
+        return jnp.mean(nll), new_state
+
+
+def resnet50(num_classes: int = 1000) -> ResNet:
+    return ResNet(50, num_classes)

@@ -68,8 +68,11 @@ def broadcast(x, axis: Axis, root: int = 0):
     """Every peer gets peer ``root``'s value."""
 
     def leaf(a):
-        mask = (peer_rank(axis) == root).astype(a.dtype)
-        return jax.lax.psum(a * mask, axis)
+        # where() not mask-multiply: a NaN/Inf on a non-root peer must not
+        # poison the psum (0*NaN == NaN) — broadcast exists precisely to
+        # recover diverged replicas from root's good copy.
+        contrib = jnp.where(peer_rank(axis) == root, a, jnp.zeros_like(a))
+        return jax.lax.psum(contrib, axis)
 
     return jax.tree_util.tree_map(leaf, x)
 

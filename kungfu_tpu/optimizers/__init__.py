@@ -1,0 +1,45 @@
+"""Distributed optimizer algorithms.
+
+Parity with reference ``srcs/python/kungfu/tensorflow/optimizers``: the same
+five algorithm families, re-designed as **optax-style gradient
+transformations** that run *inside* the jitted, shard-mapped training step
+(the reference instead wrapped ``tf.Optimizer.apply_gradients`` around
+async C++ ops — on TPU the collective is part of the compiled program):
+
+* :func:`synchronous_sgd` — S-SGD: allreduce-mean gradients, then inner
+  update (reference ``sync_sgd.py:58-109``).
+* :func:`synchronous_averaging` — SMA / EA-SGD: average *weights* each
+  step, pull each replica toward the average with rate ``alpha`` while
+  applying local gradients (reference ``sma_sgd.py:45-74``).
+* :func:`adaptive_sgd` — SMA before ``change_step``, S-SGD after
+  (reference ``ada_sgd.py:26-83``).
+* :class:`PairAveragingOptimizer` — AD-PSGD gossip: pull a random peer's
+  model from its versioned store over the host channel, average 0.5/0.5,
+  apply local gradients, publish (reference ``async_sgd.py:71-142``).
+  Deliberately *not* a collective — host-side p2p.
+* :func:`monitor_gradient_noise_scale` / :func:`monitor_gradient_variance`
+  — S-SGD plus in-graph training statistics (reference
+  ``grad_noise_scale.py``, ``grad_variance.py``).
+
+All collective-based transforms take ``axis`` = mesh axis name(s)
+(``Communicator.axis``) and must be called inside ``shard_map``/``pjit``
+over that mesh.
+"""
+
+from kungfu_tpu.optimizers.sync_sgd import synchronous_sgd
+from kungfu_tpu.optimizers.sma_sgd import synchronous_averaging
+from kungfu_tpu.optimizers.ada_sgd import adaptive_sgd
+from kungfu_tpu.optimizers.async_sgd import PairAveragingOptimizer
+from kungfu_tpu.optimizers.monitors import (
+    monitor_gradient_noise_scale,
+    monitor_gradient_variance,
+)
+
+__all__ = [
+    "synchronous_sgd",
+    "synchronous_averaging",
+    "adaptive_sgd",
+    "PairAveragingOptimizer",
+    "monitor_gradient_noise_scale",
+    "monitor_gradient_variance",
+]

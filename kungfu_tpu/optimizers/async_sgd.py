@@ -1,0 +1,121 @@
+"""PairAveraging — AD-PSGD asynchronous gossip.
+
+Reference ``async_sgd.py:71-142`` + ``peer_to_peer.cpp``: each step a
+worker (1) pulls a random peer's model from that peer's in-memory
+versioned store, (2) averages it 0.5/0.5 into its own weights, (3) applies
+its local gradients, (4) publishes the new model.  No collectives, no
+global synchronization — by design.  On TPU this runs on the **host
+channel** (CPU NICs), not the ICI: gossip is deliberately not a collective,
+and pulling a ~100MB model is control-plane-scale traffic that overlaps
+with device compute.
+
+The model travels as one fused bf16/f32 buffer (reference fuses into a
+``ModelBuffer`` too, ``model_buffer.hpp:13-53``).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from kungfu_tpu.ops.fuse import defuse, fuse
+from kungfu_tpu.utils.log import get_logger
+
+_log = get_logger("pair-avg")
+
+
+class PairAveragingOptimizer:
+    """Host-driven gossip optimizer.
+
+    Usage::
+
+        opt = PairAveragingOptimizer(optax.sgd(0.1), peer)
+        state = opt.init(params)            # publishes + barrier
+        params, state = opt.step(params, grads, state)
+    """
+
+    def __init__(
+        self,
+        inner: optax.GradientTransformation,
+        peer=None,
+        name: str = "model",
+        selector: str = "random",
+        fuse_dtype=jnp.float32,
+        seed: int = 0,
+    ):
+        if peer is None:
+            from kungfu_tpu.python import init as _init
+
+            peer = _init()
+        self.inner = inner
+        self.peer = peer
+        self.name = name
+        self.selector = selector
+        self.fuse_dtype = fuse_dtype
+        self._rng = random.Random(seed + peer.rank())
+        self._rr_next = 0
+        self._spec = None
+        self._step_count = 0
+
+        def _avg(params, other_buf):
+            mine, spec = fuse(params, dtype=self.fuse_dtype)
+            merged = 0.5 * mine + 0.5 * other_buf
+            return defuse(merged, spec)
+
+        self._avg_jit = jax.jit(_avg)
+        self._update_jit = jax.jit(
+            lambda g, s, p: self.inner.update(g, s, p)
+        )
+
+    # -- store IO --------------------------------------------------------
+    def _serialize(self, params) -> bytes:
+        buf, self._spec = fuse(params, dtype=self.fuse_dtype)
+        return np.asarray(buf).tobytes()
+
+    def _deserialize_buf(self, blob: bytes):
+        return jnp.asarray(
+            np.frombuffer(blob, dtype=np.dtype(self.fuse_dtype)).copy()
+        )
+
+    def _publish(self, params) -> None:
+        self.peer.save(self.name, self._serialize(params), version=str(self._step_count))
+
+    def _select_peer(self) -> Optional[int]:
+        n, me = self.peer.size(), self.peer.rank()
+        others = [r for r in range(n) if r != me]
+        if not others:
+            return None
+        if self.selector == "roundrobin":
+            target = others[self._rr_next % len(others)]
+            self._rr_next += 1
+            return target
+        return self._rng.choice(others)
+
+    # -- optimizer surface -----------------------------------------------
+    def init(self, params) -> optax.OptState:
+        """Publish the initial model and barrier so every peer has
+        something to serve before the first pull (reference
+        ``async_sgd.py:110-120``: save fused model + barrier at step 0)."""
+        self._publish(params)
+        self.peer.barrier()
+        return self.inner.init(params)
+
+    def step(self, params, grads, state):
+        """One gossip step; returns ``(new_params, new_state)``."""
+        target = self._select_peer()
+        if target is not None:
+            blob = self.peer.request(target, self.name)
+            if blob is not None:
+                params = self._avg_jit(params, self._deserialize_buf(blob))
+            else:
+                _log.debug("peer %d had no %r yet", target, self.name)
+        updates, state = self._update_jit(grads, state, params)
+        params = optax.apply_updates(params, updates)
+        self._step_count += 1
+        self._publish(params)
+        return params, state

@@ -1,0 +1,69 @@
+"""Monitoring optimizers — S-SGD plus in-graph training statistics.
+
+Reference ``grad_noise_scale.py:41-88`` (OpenAI gradient-noise-scale
+estimator + EMA, via the C++ ``NoiseScale`` op) and
+``grad_variance.py:37-76``.  These statistics are the signals the adaptive
+policies use to pick batch/cluster size at runtime.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from kungfu_tpu import ops
+from kungfu_tpu.ops.monitor import global_noise_scale, group_all_reduce_with_variance
+from kungfu_tpu.ops.state import EMAState, ema_init, exponential_moving_average
+
+
+class GNSState(NamedTuple):
+    inner: optax.OptState
+    ema: EMAState
+    noise_scale: jnp.ndarray  # smoothed GNS estimate
+
+
+def monitor_gradient_noise_scale(
+    inner: optax.GradientTransformation,
+    axis,
+    local_batch_size: int,
+    ema_alpha: float = 0.01,
+) -> optax.GradientTransformation:
+    """S-SGD whose state additionally carries a smoothed gradient noise
+    scale (``state.noise_scale``)."""
+
+    def init(params):
+        return GNSState(inner.init(params), ema_init(), jnp.zeros((), jnp.float32))
+
+    def update(grads, state, params=None):
+        avg = ops.group_all_reduce(grads, axis, op="mean")
+        raw = global_noise_scale(grads, avg, local_batch_size, axis)
+        new_ema, smoothed = exponential_moving_average(state.ema, raw, ema_alpha)
+        updates, new_inner = inner.update(avg, state.inner, params)
+        return updates, GNSState(new_inner, new_ema, smoothed)
+
+    return optax.GradientTransformation(init, update)
+
+
+class GradVarianceState(NamedTuple):
+    inner: optax.OptState
+    variance: jnp.ndarray
+
+
+def monitor_gradient_variance(
+    inner: optax.GradientTransformation,
+    axis,
+) -> optax.GradientTransformation:
+    """S-SGD whose state carries the cross-replica gradient variance."""
+
+    def init(params):
+        return GradVarianceState(inner.init(params), jnp.zeros((), jnp.float32))
+
+    def update(grads, state, params=None):
+        avg, var = group_all_reduce_with_variance(grads, axis)
+        updates, new_inner = inner.update(avg, state.inner, params)
+        return updates, GradVarianceState(new_inner, var)
+
+    return optax.GradientTransformation(init, update)

@@ -1,0 +1,38 @@
+"""Synchronous model averaging (SMA / EA-SGD).
+
+Reference ``sma_sgd.py:45-74``: each step allreduce the *weights*, move
+each replica toward the average with rate ``alpha`` (default 0.1), then
+apply local gradients.  Tolerant of large clusters where averaging
+gradients degrades accuracy (the reference's 16-worker ImageNet result).
+"""
+
+from __future__ import annotations
+
+import jax
+import optax
+
+from kungfu_tpu import ops
+
+DEFAULT_ALPHA = 0.1  # reference sma_sgd.py
+
+
+def synchronous_averaging(
+    inner: optax.GradientTransformation,
+    axis,
+    alpha: float = DEFAULT_ALPHA,
+) -> optax.GradientTransformation:
+    def init(params):
+        return inner.init(params)
+
+    def update(grads, state, params):
+        if params is None:
+            raise ValueError("synchronous_averaging requires params")
+        avg = ops.all_reduce(params, axis, op="mean")
+        inner_updates, new_state = inner.update(grads, state, params)
+        updates = jax.tree_util.tree_map(
+            lambda u, p, a: u + alpha * (a - p).astype(u.dtype),
+            inner_updates, params, avg,
+        )
+        return updates, new_state
+
+    return optax.GradientTransformation(init, update)

@@ -1,0 +1,27 @@
+"""Synchronous SGD — allreduce gradients, then inner update."""
+
+from __future__ import annotations
+
+import optax
+
+from kungfu_tpu import ops
+
+
+def synchronous_sgd(
+    inner: optax.GradientTransformation,
+    axis,
+    average: bool = True,
+) -> optax.GradientTransformation:
+    """The S-SGD wrapper (reference ``sync_sgd.py:58-109``: group allreduce
+    then grad/np).  ``inner`` is any optax optimizer; ``axis`` the mesh
+    axis name(s).  With ``average=False`` gradients are summed (the caller
+    scales the LR instead)."""
+
+    def init(params):
+        return inner.init(params)
+
+    def update(grads, state, params=None):
+        grads = ops.group_all_reduce(grads, axis, op="mean" if average else "sum")
+        return inner.update(grads, state, params)
+
+    return optax.GradientTransformation(init, update)

@@ -16,6 +16,7 @@ CPU-backend test clusters; or a single process in single-controller mode.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 import urllib.request
@@ -40,9 +41,15 @@ class Peer:
         self._channel: Optional[HostChannel] = None
         self._comm: Optional[Communicator] = None
         self._comm_version = -1
+        self._engine = None
+        self._engine_version = -1
         self._lock = threading.RLock()
         self._started = False
         self._jax_initialized = False
+        from kungfu_tpu.store.store import VersionedStore
+
+        #: this peer's versioned model store (served to gossip peers)
+        self.store = VersionedStore()
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> None:
@@ -50,13 +57,21 @@ class Peer:
             if self._started:
                 return
             self._started = True
+            platform = os.environ.get("KF_JAX_PLATFORM")
+            if platform:
+                import jax
+
+                try:
+                    jax.config.update("jax_platforms", platform)
+                except Exception as e:  # backend may already be initialized
+                    _log.warning("cannot set jax platform %s: %s", platform, e)
             if not self.config.single_process:
                 self._channel = HostChannel(
                     self.config.self_id, token=self.cluster_version
                 )
                 from kungfu_tpu.store import install_p2p_handler
 
-                install_p2p_handler(self._channel)
+                install_p2p_handler(self._channel, self.store)
             if self.config.coordinator and self.config.num_processes > 1:
                 self._init_jax_distributed()
             log_event("peer-started")
@@ -77,6 +92,12 @@ class Peer:
             if self._channel is not None:
                 self._channel.close()
                 self._channel = None
+            if self._engine is not None:
+                self._engine.close()
+            self._engine = None
+            self._engine_version = -1
+            self._comm = None
+            self._comm_version = -1
             self._started = False
 
     # -- identity --------------------------------------------------------
@@ -117,6 +138,25 @@ class Peer:
                 self._comm_version = self.cluster_version
                 _log.info("new %r", self._comm)
             return self._comm
+
+    def engine(self):
+        """Graph-collective engine over the host channel for the current
+        membership — the multi-process data path when no shared XLA mesh
+        exists (CPU test clusters, between-mesh-epoch phases).  None in
+        single-process mode."""
+        with self._lock:
+            if self._channel is None:
+                return None
+            if self._engine is None or self._engine_version != self.cluster_version:
+                from kungfu_tpu.comm.engine import CollectiveEngine
+
+                if self._engine is not None:
+                    self._engine.close()
+                self._engine = CollectiveEngine(
+                    self._channel, self.cluster.workers, self.config.strategy
+                )
+                self._engine_version = self.cluster_version
+            return self._engine
 
     # -- sync ------------------------------------------------------------
     def barrier(self) -> None:
@@ -184,6 +224,8 @@ class Peer:
                 self.cluster_version = version
                 if self._channel is not None:
                     self._channel.set_token(version)
+                    # pooled sockets to removed peers must not leak
+                    self._channel.reset_connections()
                 self.detached = (
                     new_cluster.workers.rank(self.config.self_id) is None
                 )
@@ -208,9 +250,7 @@ class Peer:
 
     # -- p2p blob store (gossip) -----------------------------------------
     def save(self, name: str, blob: bytes, version: Optional[str] = None) -> None:
-        from kungfu_tpu.store import get_local_store
-
-        get_local_store().save(name, blob, version)
+        self.store.save(name, blob, version)
 
     def request(self, target_rank: int, name: str, version: Optional[str] = None) -> Optional[bytes]:
         """Pull a named blob from a peer's versioned store

@@ -1,0 +1,21 @@
+"""Launcher / CLI — the ``kungfu-run`` analog (``kfrun``).
+
+Parity with reference ``srcs/go/cmd/kungfu-run`` + ``srcs/go/kungfu/
+{runner,job}`` + ``srcs/go/proc`` + ``srcs/go/utils/runner/local``:
+
+* :mod:`kungfu_tpu.runner.proc` — subprocess specs with merged env,
+  per-worker log files and colored prefix streaming;
+* :mod:`kungfu_tpu.runner.job` — builds worker processes with the ``KF_*``
+  bootstrap contract (device slotting included);
+* :mod:`kungfu_tpu.runner.cli` — flag surface (``-np``, ``-H``,
+  ``-strategy``, ``-w``, ``-config-server``, ``-auto-recover``, ...);
+  dispatches SimpleRun / WatchRun (elastic) / MonitoredRun (auto-recover).
+
+Invoke as ``python -m kungfu_tpu.runner.cli -np 4 python3 train.py`` or via
+the ``kfrun`` console script.
+"""
+
+from kungfu_tpu.runner.proc import Proc, run_all
+from kungfu_tpu.runner.job import Job
+
+__all__ = ["Proc", "run_all", "Job"]

@@ -1,0 +1,141 @@
+"""``kfrun`` — the kungfu-run analog.
+
+Flag parity with reference ``srcs/go/kungfu/runner/flags.go:29-104`` (the
+subset meaningful on TPU; ``-allow-nvlink`` and NIC inference have no
+analog).  Dispatch parity with ``app/kungfu-run.go:18-116``:
+
+* default: **SimpleRun** — spawn all local workers, wait
+  (``runner/simple.go:13-21``);
+* ``-w``: **WatchRun** — elastic runner daemon that diffs worker lists on
+  membership change and spawns/kills accordingly (``runner/watch.go``);
+* ``-auto-recover``: **MonitoredRun** — heartbeat failure detector +
+  automatic relaunch (``runner/monitored.go``).
+
+Examples::
+
+    python -m kungfu_tpu.runner.cli -np 4 python3 train.py
+    python -m kungfu_tpu.runner.cli -np 2 -H 127.0.0.1:4 -strategy RING python3 train.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import List, Optional
+
+from kungfu_tpu.plan import Cluster, HostList, parse_strategy
+from kungfu_tpu.plan.hostfile import parse_hostfile
+from kungfu_tpu.plan.hostspec import DEFAULT_RUNNER_PORT
+from kungfu_tpu.plan.peer import PeerID
+from kungfu_tpu.runner.job import Job
+from kungfu_tpu.runner.proc import run_all
+from kungfu_tpu.utils.log import get_logger
+
+_log = get_logger("kfrun")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kfrun", description="launch kungfu_tpu workers"
+    )
+    p.add_argument("-np", type=int, default=1, help="total number of workers")
+    p.add_argument("-H", dest="hosts", default="", help="host spec list ip:slots,...")
+    p.add_argument("-hostfile", default="", help="MPI-style hostfile")
+    p.add_argument("-self", dest="self_host", default="127.0.0.1", help="this runner's host ip")
+    p.add_argument("-strategy", default="AUTO", help="allreduce strategy name")
+    p.add_argument("-w", dest="watch", action="store_true", help="elastic watch mode")
+    p.add_argument("-config-server", dest="config_server", default="", help="elastic config server URL")
+    p.add_argument("-builtin-config-port", dest="builtin_config_port", type=int, default=0,
+                   help="start a built-in config server on this port")
+    p.add_argument("-auto-recover", dest="auto_recover", default="",
+                   help="failure-detection period (e.g. 10s); enables MonitoredRun")
+    p.add_argument("-port-range", dest="port_range", default="10000-11000")
+    p.add_argument("-logdir", default="")
+    p.add_argument("-q", dest="quiet", action="store_true", help="suppress worker output")
+    p.add_argument("-timeout", type=float, default=0.0, help="job timeout seconds (0 = none)")
+    p.add_argument("-backend", default="cpu", choices=["cpu", "tpu"],
+                   help="worker device backend (cpu = multi-process test cluster)")
+    p.add_argument("-n-epochs-flag", dest="n_epochs_flag", default="--n-epochs",
+                   help="worker flag patched on auto-recovery restart")
+    p.add_argument("prog", help="worker program")
+    p.add_argument("args", nargs=argparse.REMAINDER, help="worker program args")
+    return p
+
+
+def parse_port_range(spec: str):
+    lo, hi = spec.split("-")
+    return int(lo), int(hi)
+
+
+def build_cluster(ns) -> Cluster:
+    if ns.hostfile:
+        hl = parse_hostfile(ns.hostfile)
+    elif ns.hosts:
+        hl = HostList.parse(ns.hosts)
+    else:
+        hl = HostList.parse(f"{ns.self_host}:{max(ns.np, 1)}")
+    return Cluster(
+        hl.gen_runner_list(DEFAULT_RUNNER_PORT),
+        hl.gen_peer_list(ns.np, parse_port_range(ns.port_range)),
+    )
+
+
+def simple_run(ns, cluster: Cluster, job: Job) -> int:
+    procs = job.create_procs(cluster, ns.self_host)
+    if not procs:
+        _log.warning("no workers for host %s", ns.self_host)
+        return 0
+    _log.info(
+        "launching %d/%d workers on %s (strategy=%s)",
+        len(procs), cluster.size(), ns.self_host, job.strategy,
+    )
+    codes = run_all(procs, quiet=ns.quiet, timeout=ns.timeout or None)
+    bad = [c for c in codes if c != 0]
+    if bad:
+        _log.error("workers failed: exit codes %s", codes)
+        return 1
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ns = build_parser().parse_args(argv)
+    strategy = parse_strategy(ns.strategy)
+    cluster = build_cluster(ns)
+
+    config_server_url = ns.config_server
+    builtin = None
+    if ns.builtin_config_port:
+        from kungfu_tpu.elastic.configserver import ConfigServer
+
+        builtin = ConfigServer(port=ns.builtin_config_port, cluster=cluster)
+        builtin.start()
+        config_server_url = f"http://127.0.0.1:{ns.builtin_config_port}/get"
+        _log.info("builtin config server at %s", config_server_url)
+
+    job = Job(
+        prog=ns.prog,
+        args=[a for a in ns.args if a != "--"],
+        strategy=strategy,
+        config_server=config_server_url,
+        log_dir=ns.logdir,
+        parent=PeerID(ns.self_host, DEFAULT_RUNNER_PORT),
+        backend=ns.backend,
+    )
+    try:
+        if ns.auto_recover:
+            from kungfu_tpu.runner.monitored import monitored_run
+
+            return monitored_run(ns, cluster, job)
+        if ns.watch:
+            from kungfu_tpu.runner.watch import watch_run
+
+            return watch_run(ns, cluster, job)
+        return simple_run(ns, cluster, job)
+    finally:
+        if builtin is not None:
+            builtin.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,83 @@
+"""Job → worker process construction.
+
+Parity with reference ``srcs/go/kungfu/job/job.go:31-72``: build one Proc
+per local worker with the full ``KF_*`` bootstrap env.  Device slotting:
+where the reference assigned ``CUDA_VISIBLE_DEVICES`` per slot
+(``cuda_visible_device.go``), the TPU build pins CPU-backend test workers
+to their own virtual device world, and TPU workers get the standard
+per-host TPU visibility (one worker process per host sees all local chips).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kungfu_tpu.plan.cluster import Cluster
+from kungfu_tpu.plan.peer import PeerID
+from kungfu_tpu.plan.strategy import Strategy
+from kungfu_tpu.runner.proc import Proc
+from kungfu_tpu.utils import envs
+
+
+@dataclass
+class Job:
+    prog: str
+    args: List[str]
+    strategy: Strategy = Strategy.AUTO
+    config_server: str = ""
+    log_dir: str = ""
+    parent: Optional[PeerID] = None
+    extra_envs: Dict[str, str] = field(default_factory=dict)
+    backend: str = "cpu"  # worker jax platform: "cpu" test clusters | "tpu"
+    job_start: float = field(default_factory=time.time)
+
+    def new_proc(self, worker: PeerID, cluster: Cluster, version: int = 0) -> Proc:
+        rank = cluster.workers.rank(worker)
+        env = {
+            envs.SELF_SPEC: str(worker),
+            envs.INIT_PEERS: str(cluster.workers),
+            envs.INIT_RUNNERS: str(cluster.runners),
+            envs.INIT_CLUSTER_VERSION: str(version),
+            envs.ALLREDUCE_STRATEGY: str(self.strategy),
+            envs.JOB_START_TIMESTAMP: f"{self.job_start:.3f}",
+            envs.PROC_START_TIMESTAMP: f"{time.time():.3f}",
+        }
+        if self.parent is not None:
+            env[envs.PARENT_ID] = str(self.parent)
+        if self.config_server:
+            env[envs.CONFIG_SERVER] = self.config_server
+        if self.backend == "cpu":
+            # each worker is its own single-device CPU world; collectives
+            # run on the host channel (CollectiveEngine).  KF_JAX_PLATFORM
+            # is applied via jax.config at kf.init() time — some
+            # environments override the JAX_PLATFORMS env var in
+            # sitecustomize, so the env var alone is not reliable.
+            env["JAX_PLATFORMS"] = "cpu"
+            env["KF_JAX_PLATFORM"] = "cpu"
+        # make the kungfu_tpu package importable in workers regardless of cwd
+        import os as _os
+
+        import kungfu_tpu as _pkg
+
+        pkg_root = _os.path.dirname(_os.path.dirname(_os.path.abspath(_pkg.__file__)))
+        existing = _os.environ.get("PYTHONPATH", "")
+        env["PYTHONPATH"] = pkg_root + (_os.pathsep + existing if existing else "")
+        env.update(self.extra_envs)
+        return Proc(
+            name=f"worker-{rank}" if rank is not None else f"worker-{worker.port}",
+            prog=self.prog,
+            args=list(self.args),
+            envs=env,
+            log_dir=self.log_dir,
+        )
+
+    def create_procs(self, cluster: Cluster, self_host: str, version: int = 0) -> List[Proc]:
+        """Procs for all workers on ``self_host``
+        (reference ``job.go:74`` CreateProcs)."""
+        return [
+            self.new_proc(w, cluster, version)
+            for w in cluster.workers
+            if w.host == self_host
+        ]

@@ -1,0 +1,126 @@
+"""Elastic watch-mode runner.
+
+Parity with reference ``runner/watch.go:23-135`` + ``runner/handler.go``:
+the runner daemon listens for ``"update"`` control messages carrying a
+Stage (version + cluster JSON) from workers mid-resize, diffs the old/new
+worker lists for *this host*, kills removed workers and spawns added ones
+with the new bootstrap env (version-fenced).  The job ends when all local
+workers have exited.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+from typing import Dict, Set
+
+from kungfu_tpu.comm.host import HostChannel
+from kungfu_tpu.plan.cluster import Cluster
+from kungfu_tpu.plan.hostspec import DEFAULT_RUNNER_PORT
+from kungfu_tpu.plan.peer import PeerID, parse_peer_id
+from kungfu_tpu.runner.job import Job
+from kungfu_tpu.runner.proc import kill_group, start_proc
+from kungfu_tpu.utils.log import get_logger
+
+_log = get_logger("watch")
+
+
+def watch_run(ns, cluster: Cluster, job: Job) -> int:
+    self_host = ns.self_host
+    chan = HostChannel(PeerID(self_host, DEFAULT_RUNNER_PORT))
+    stages: "queue.Queue[dict]" = queue.Queue()
+
+    def on_control(name: str, payload: bytes, src: str):
+        if name == "update":
+            try:
+                stages.put(json.loads(payload.decode()))
+            except ValueError as e:
+                _log.warning("bad update from %s: %s", src, e)
+        elif name == "exit":
+            stages.put({"exit": True})
+
+    chan.on_control(on_control)
+
+    running: Dict[PeerID, object] = {}
+    killed: Set[PeerID] = set()
+    version = 0
+    seen_versions = {0}
+    failures = 0
+    idx = 0
+
+    def spawn(worker: PeerID, cl: Cluster, v: int):
+        nonlocal idx
+        proc = job.new_proc(worker, cl, v)
+        _log.info("spawning %s (v%d)", proc.name, v)
+        running[worker] = start_proc(proc, idx, quiet=ns.quiet)
+        idx += 1
+
+    current = cluster
+    for w in cluster.workers.on_host(self_host):
+        spawn(w, cluster, version)
+
+    stop = False
+    try:
+        while running or not stages.empty():
+            # poll exits
+            for w, r in list(running.items()):
+                code = r.popen.poll()
+                if code is None:
+                    continue
+                del running[w]
+                if w in killed:
+                    killed.discard(w)
+                    _log.info("worker %s terminated after removal", w)
+                elif code != 0:
+                    _log.error("worker %s exited %d", w, code)
+                    failures += 1
+                else:
+                    _log.info("worker %s finished", w)
+            if failures and running:
+                for w, r in list(running.items()):
+                    kill_group(r)
+                    killed.add(w)
+            # poll membership updates
+            try:
+                stage = stages.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if stage.get("exit"):
+                stop = True
+                for w, r in list(running.items()):
+                    kill_group(r)
+                    killed.add(w)
+                continue
+            new_version = int(stage["version"])
+            new_cluster = Cluster.from_json(json.dumps(stage["cluster"]))
+            if new_version in seen_versions:
+                # duplicate update for a known version: verify consistency
+                # (reference handler.go:89-106 exits on inconsistency)
+                if new_version == version and new_cluster.workers != current.workers:
+                    _log.error("inconsistent update for version %d", new_version)
+                    return 1
+                continue
+            seen_versions.add(new_version)
+            _log.info(
+                "stage v%d: %d -> %d workers", new_version, current.size(), new_cluster.size()
+            )
+            chan.set_token(new_version)
+            old_local = set(current.workers.on_host(self_host))
+            new_local = set(new_cluster.workers.on_host(self_host))
+            for w in old_local - new_local:
+                r = running.get(w)
+                if r is not None:
+                    _log.info("killing removed worker %s", w)
+                    kill_group(r)
+                    killed.add(w)
+            for w in sorted(new_local - old_local):
+                spawn(w, new_cluster, new_version)
+            current, version = new_cluster, new_version
+    finally:
+        for w, r in list(running.items()):
+            kill_group(r)
+        chan.close()
+    if failures:
+        _log.error("%d worker(s) failed", failures)
+        return 1
+    return 0

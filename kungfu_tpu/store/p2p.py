@@ -25,15 +25,16 @@ _OK = b"\x01"
 _FAIL = b"\x00"
 
 
-def install_p2p_handler(channel: HostChannel) -> None:
-    """Make this process answer blob requests from its local store."""
+def install_p2p_handler(channel: HostChannel, store=None) -> None:
+    """Make this endpoint answer blob requests from ``store`` (default: the
+    process-global store)."""
 
     def handle(name: str, payload: bytes, src: str):
         # name = "req.<id>"; payload = json {"name":..., "version":...}
         req_id = name[len("req."):]
         try:
             req = json.loads(payload.decode())
-            blob = get_local_store().get(req["name"], req.get("version") or None)
+            blob = (store or get_local_store()).get(req["name"], req.get("version") or None)
         except (ValueError, KeyError) as e:
             _log.warning("bad p2p request from %s: %s", src, e)
             blob = None
@@ -58,11 +59,11 @@ def remote_request(
 ) -> Optional[bytes]:
     """Pull blob ``name`` from ``target``'s store; None when unavailable."""
     channel = peer.channel
-    if channel is None:
-        # single-process mode: serve from the local store directly
-        return get_local_store().get(name, version)
-    if target == peer.config.self_id:
-        return get_local_store().get(name, version)
+    own_store = getattr(peer, "store", None)
+    if channel is None or target == peer.config.self_id:
+        # single-process mode / self-request: serve from the own store
+        st = own_store if own_store is not None else get_local_store()
+        return st.get(name, version)
     req_id = f"{peer.config.self_id.port}-{next(_req_counter)}"
     body = json.dumps({"name": name, "version": version or ""}).encode()
     channel.send(target, f"req.{req_id}", body, ConnType.PEER_TO_PEER)

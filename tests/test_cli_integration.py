@@ -1,0 +1,63 @@
+"""End-to-end CLI integration: kfrun spawning real worker processes.
+
+Parity with the reference's public-API smoke test
+(``kungfu-run -np 4 ./bin/kungfu-test-public-apis``, ci.yaml:41) and the
+MNIST SLP convergence test.  Marked slow: each worker pays jax import cost
+(single CPU core in CI).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_cli(args, timeout=240):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    return subprocess.run(
+        [sys.executable, "-m", "kungfu_tpu.runner.cli"] + args,
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=env,
+    )
+
+
+@pytest.mark.slow
+class TestCLI:
+    def test_mnist_slp_np2(self):
+        r = run_cli(
+            ["-np", "2", "-timeout", "200", sys.executable,
+             "examples/mnist_slp.py", "--n-epochs", "1"]
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "OK" in r.stdout
+
+    def test_worker_failure_fails_job(self):
+        r = run_cli(
+            ["-np", "2", "-timeout", "60", sys.executable, "-c", "import sys; sys.exit(3)"]
+        )
+        assert r.returncode == 1
+
+
+class TestCLIParsing:
+    def test_parser_flags(self):
+        from kungfu_tpu.runner.cli import build_cluster, build_parser
+
+        ns = build_parser().parse_args(
+            ["-np", "4", "-H", "127.0.0.1:4", "-strategy", "RING", "prog", "a", "b"]
+        )
+        assert ns.np == 4 and ns.prog == "prog" and ns.args == ["a", "b"]
+        cluster = build_cluster(ns)
+        assert cluster.size() == 4
+
+    def test_default_host(self):
+        from kungfu_tpu.runner.cli import build_cluster, build_parser
+
+        ns = build_parser().parse_args(["-np", "2", "x"])
+        assert build_cluster(ns).size() == 2

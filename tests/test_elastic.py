@@ -1,0 +1,147 @@
+"""Elasticity tests: config server REST contract, schedules, resize
+protocol (reference test_step_based_schedule.py / test_tensorflow_resize.py
+/ run-elastic-test.sh analogs)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import urllib.request
+
+import pytest
+
+from kungfu_tpu.elastic import ConfigServer, parse_schedule, step_based_schedule
+from kungfu_tpu.elastic.schedule import total_steps
+from kungfu_tpu.plan import Cluster, HostList
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_cluster(np=2):
+    hl = HostList.parse("127.0.0.1:8")
+    return Cluster(hl.gen_runner_list(), hl.gen_peer_list(np))
+
+
+class TestSchedule:
+    def test_parse(self):
+        assert parse_schedule("1:100,2:50") == [(1, 100), (2, 50)]
+        assert total_steps("1:100,2:50") == 150
+
+    @pytest.mark.parametrize("step,size", [(0, 1), (99, 1), (100, 2), (149, 2), (500, 4)])
+    def test_lookup(self, step, size):
+        assert step_based_schedule("1:100,2:50,4:10", step) == size
+
+    def test_bad(self):
+        with pytest.raises(ValueError):
+            parse_schedule("0:10")
+        with pytest.raises(ValueError):
+            parse_schedule("")
+
+
+class TestConfigServer:
+    @pytest.fixture
+    def server(self):
+        s = ConfigServer(port=29100, cluster=make_cluster(2)).start()
+        yield s
+        try:
+            s.stop()
+        except Exception:
+            pass
+
+    def _get(self, port, path="/get"):
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+            return json.loads(r.read().decode())
+
+    def _put(self, port, body: str):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/put", data=body.encode(), method="PUT"
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return json.loads(r.read().decode())
+
+    def test_get_put_versioning(self, server):
+        doc = self._get(29100)
+        assert doc["version"] == 0
+        assert len(doc["cluster"]["workers"]) == 2
+        new = make_cluster(4)
+        out = self._put(29100, new.to_json())
+        assert out["version"] == 1
+        doc = self._get(29100)
+        assert doc["version"] == 1 and len(doc["cluster"]["workers"]) == 4
+
+    def test_put_invalid_rejected(self, server):
+        bad = json.dumps({"runners": ["a:38080"], "workers": ["b:10000"]})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._put(29100, bad)
+        assert e.value.code == 400
+        assert self._get(29100)["version"] == 0  # unchanged
+
+    def test_delete_then_404(self, server):
+        req = urllib.request.Request("http://127.0.0.1:29100/", method="DELETE")
+        urllib.request.urlopen(req, timeout=5).read()
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._get(29100)
+        assert e.value.code == 404
+
+
+class TestResizeProtocol:
+    def test_fetch_with_consensus_two_peers(self):
+        from kungfu_tpu.elastic.resize import fetch_cluster_with_consensus
+        from kungfu_tpu.peer import Peer
+        from kungfu_tpu.plan import PeerList
+        from kungfu_tpu.utils.envs import Config
+
+        server = ConfigServer(port=29101, cluster=make_cluster(2)).start()
+        try:
+            workers = PeerList.parse("127.0.0.1:26001,127.0.0.1:26002")
+            runners = PeerList.parse("127.0.0.1:38085")
+            cluster = Cluster(runners, workers)
+            peers = [
+                Peer(Config(self_id=workers[i], cluster=cluster,
+                            config_server="http://127.0.0.1:29101/get"))
+                for i in range(2)
+            ]
+            for p in peers:
+                p.start()
+            results = [None, None]
+
+            def fetch(i):
+                results[i] = fetch_cluster_with_consensus(peers[i], timeout=30)
+
+            ts = [threading.Thread(target=fetch, args=(i,)) for i in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=40)
+            assert results[0] is not None and results[1] is not None
+            assert results[0][1] == results[1][1] == 0
+            assert results[0][0] == results[1][0]
+            for p in peers:
+                p.close()
+        finally:
+            server.stop()
+
+
+@pytest.mark.slow
+class TestElasticCLI:
+    def _run(self, schedule, np, port):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        return subprocess.run(
+            [sys.executable, "-m", "kungfu_tpu.runner.cli", "-w",
+             "-builtin-config-port", str(port), "-np", str(np),
+             "-H", "127.0.0.1:4", sys.executable,
+             "examples/elastic_mnist.py", "--schedule", schedule],
+            cwd=REPO, capture_output=True, text=True, timeout=280, env=env,
+        )
+
+    def test_grow(self):
+        r = self._run("1:4,2:4", 1, 29125)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "resizes survived 1" in r.stdout
+
+    def test_shrink(self):
+        r = self._run("2:4,1:4", 2, 29126)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "sizes seen [1, 2]" in r.stdout

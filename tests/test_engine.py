@@ -1,0 +1,171 @@
+"""Graph-collective engine tests: all 8 strategies x several np, numeric
+cross-check vs numpy — parity with the reference's integration matrix
+(scripts/tests/run-integration-tests.sh: np 1..4 x all strategies)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from kungfu_tpu.comm.engine import CollectiveEngine, build_strategy_graphs
+from kungfu_tpu.comm.host import HostChannel
+from kungfu_tpu.plan import PeerID, PeerList, Strategy
+
+BASE_PORT = 25000
+_port_gen = [BASE_PORT]
+
+
+def make_cluster(n, hosts=1):
+    """n peers spread over `hosts` logical hosts (all on 127.0.0.1 but with
+    distinct host labels is not possible for real sockets, so hosts>1 uses
+    port-partitioned groups on the same ip only for graph generation)."""
+    _port_gen[0] += n + 2
+    base = _port_gen[0]
+    peers = PeerList.of(*(PeerID("127.0.0.1", base + i) for i in range(n)))
+    chans = [HostChannel(p, bind_host="127.0.0.1") for p in peers]
+    return peers, chans
+
+
+def run_all(fns, timeout=60):
+    errors, results = [], [None] * len(fns)
+
+    def wrap(i, f):
+        try:
+            results[i] = f()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    ts = [threading.Thread(target=wrap, args=(i, f)) for i, f in enumerate(fns)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=timeout)
+    if errors:
+        raise errors[0]
+    return results
+
+
+ALL_STRATEGIES = [s for s in Strategy if s != Strategy.AUTO]
+
+
+class TestStrategyGraphs:
+    @pytest.mark.parametrize("strategy", ALL_STRATEGIES)
+    @pytest.mark.parametrize("n", [1, 2, 4, 5])
+    def test_graphs_well_formed(self, strategy, n):
+        peers = PeerList.of(*(PeerID("h", 10000 + i) for i in range(n)))
+        pairs = build_strategy_graphs(strategy, peers)
+        assert pairs
+        for red, bc in pairs:
+            roots = [i for i in range(n) if bc.is_self_loop(i)]
+            assert len(roots) == 1
+
+
+class TestEngine:
+    @pytest.mark.parametrize("strategy", ALL_STRATEGIES)
+    def test_allreduce_3peers(self, strategy):
+        peers, chans = make_cluster(3)
+        try:
+            engines = [CollectiveEngine(c, peers, strategy) for c in chans]
+            data = [np.arange(10, dtype=np.float32) * (i + 1) for i in range(3)]
+            outs = run_all([lambda e=e, d=d: e.all_reduce(d) for e, d in zip(engines, data)])
+            want = sum(data)
+            for o in outs:
+                np.testing.assert_allclose(o, want, rtol=1e-6)
+        finally:
+            for c in chans:
+                c.close()
+
+    @pytest.mark.parametrize("op,npf", [("min", np.minimum), ("max", np.maximum), ("prod", np.multiply)])
+    def test_ops(self, op, npf):
+        peers, chans = make_cluster(2)
+        try:
+            engines = [CollectiveEngine(c, peers, Strategy.STAR) for c in chans]
+            data = [np.array([3.0, -1.0, 2.0], np.float32), np.array([1.0, 5.0, 2.0], np.float32)]
+            outs = run_all([lambda e=e, d=d: e.all_reduce(d, op=op) for e, d in zip(engines, data)])
+            want = npf(data[0], data[1])
+            for o in outs:
+                np.testing.assert_allclose(o, want)
+        finally:
+            for c in chans:
+                c.close()
+
+    def test_mean(self):
+        peers, chans = make_cluster(4)
+        try:
+            engines = [CollectiveEngine(c, peers, Strategy.BINARY_TREE) for c in chans]
+            data = [np.full(5, float(i), np.float32) for i in range(4)]
+            outs = run_all([lambda e=e, d=d: e.all_reduce(d, op="mean") for e, d in zip(engines, data)])
+            for o in outs:
+                np.testing.assert_allclose(o, np.full(5, 1.5), rtol=1e-6)
+        finally:
+            for c in chans:
+                c.close()
+
+    def test_chunked_multigraph(self):
+        """Buffer > 1 MiB: chunks spread across strategy pairs (RING has n
+        rotated pairs) and reassemble correctly."""
+        peers, chans = make_cluster(2)
+        try:
+            engines = [CollectiveEngine(c, peers, Strategy.RING) for c in chans]
+            rng = np.random.RandomState(0)
+            data = [rng.rand(300_000).astype(np.float32) for _ in range(2)]  # 1.2 MB
+            outs = run_all([lambda e=e, d=d: e.all_reduce(d) for e, d in zip(engines, data)])
+            want = data[0] + data[1]
+            for o in outs:
+                np.testing.assert_allclose(o, want, rtol=1e-6)
+            # both ring rotations saw traffic
+            assert sum(b for b, _ in engines[0].stats) == data[0].nbytes
+        finally:
+            for c in chans:
+                c.close()
+
+    def test_broadcast(self):
+        peers, chans = make_cluster(3)
+        try:
+            engines = [CollectiveEngine(c, peers, Strategy.STAR) for c in chans]
+            data = [np.full(4, float(i + 1), np.float32) for i in range(3)]
+            outs = run_all(
+                [lambda e=e, d=d: e.broadcast(d, root=1) for e, d in zip(engines, data)]
+            )
+            for o in outs:
+                np.testing.assert_allclose(o, np.full(4, 2.0))
+        finally:
+            for c in chans:
+                c.close()
+
+    def test_int_sum(self):
+        peers, chans = make_cluster(2)
+        try:
+            engines = [CollectiveEngine(c, peers, Strategy.TREE) for c in chans]
+            data = [np.arange(6, dtype=np.int32), np.ones(6, np.int32)]
+            outs = run_all([lambda e=e, d=d: e.all_reduce(d) for e, d in zip(engines, data)])
+            for o in outs:
+                np.testing.assert_array_equal(o, data[0] + data[1])
+        finally:
+            for c in chans:
+                c.close()
+
+    def test_throughput_stats(self):
+        peers, chans = make_cluster(2)
+        try:
+            engines = [CollectiveEngine(c, peers, Strategy.STAR) for c in chans]
+            data = np.ones(100, np.float32)
+            run_all([lambda e=e: e.all_reduce(data) for e in engines])
+            assert engines[0].throughputs()[0] > 0
+        finally:
+            for c in chans:
+                c.close()
+
+    def test_set_strategy(self):
+        peers, chans = make_cluster(2)
+        try:
+            engines = [CollectiveEngine(c, peers, Strategy.STAR) for c in chans]
+            for e in engines:
+                e.set_strategy(Strategy.RING)
+            data = [np.ones(4, np.float32), np.full(4, 2.0, np.float32)]
+            outs = run_all([lambda e=e, d=d: e.all_reduce(d) for e, d in zip(engines, data)])
+            for o in outs:
+                np.testing.assert_allclose(o, np.full(4, 3.0))
+        finally:
+            for c in chans:
+                c.close()
