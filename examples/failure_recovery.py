@@ -1,0 +1,132 @@
+"""Failure detection + auto-recovery end to end.
+
+Parity with reference ``examples/Failure_recovery_examples/
+tf2_mnist_keras.py``: workers send batch/epoch heartbeats; one worker
+deliberately dies mid-training on the first run (``--die-at-epoch``); the
+monitored runner detects it, relaunches with the remaining epochs and
+``--restart 1``; workers reload the last epoch checkpoint and finish.
+
+Run::
+
+    python -m kungfu_tpu.runner.cli -auto-recover 4s -np 2 \
+        python3 examples/failure_recovery.py --n-epochs 4 --die-at-epoch 1 \
+        --ckpt-dir /tmp/kf-ckpt
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n-epochs", type=int, default=4)
+    ap.add_argument("--die-at-epoch", type=int, default=-1)
+    ap.add_argument("--hang-at-epoch", type=int, default=-1,
+                    help="stall (begin without end) instead of crashing — "
+                         "exercises the heartbeat-timeout detection path")
+    ap.add_argument("--restart", type=int, default=0)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.2)
+    ap.add_argument("--ckpt-dir", default="/tmp/kf-tpu-ckpt")
+    args = ap.parse_args()
+
+    import kungfu_tpu as kf
+    from kungfu_tpu.checkpoint import restore_checkpoint, save_checkpoint
+    from kungfu_tpu.initializer import broadcast_parameters
+    from kungfu_tpu.models import mnist_slp
+    from kungfu_tpu.monitor import (
+        monitor_batch_begin,
+        monitor_batch_end,
+        monitor_epoch_end,
+        monitor_train_end,
+    )
+    from examples.mnist_slp import synthetic_mnist
+
+    peer = kf.init()
+    rank, size = kf.current_rank(), kf.cluster_size()
+    model = mnist_slp()
+    params = model.init(jax.random.PRNGKey(7))
+
+    start_epoch = 0
+    if not args.restart and rank == 0:
+        # fresh run: drop checkpoints from previous invocations
+        import glob
+
+        for f in glob.glob(os.path.join(args.ckpt_dir, "ckpt_*.npz")):
+            os.unlink(f)
+    if args.restart:
+        got = restore_checkpoint(args.ckpt_dir, params)
+        if got is not None:
+            params, _, meta = got
+            start_epoch = int(meta.get("epochs_done", 0))
+            print(f"worker {rank}: restarted from epoch {start_epoch}", flush=True)
+    else:
+        params = broadcast_parameters(peer=peer, params=params)
+
+    x, y = synthetic_mnist()
+    shard = np.arange(len(x)) % size == rank
+    x, y = x[shard], y[shard]
+    loss_grad = jax.jit(jax.value_and_grad(model.loss))
+    opt = optax.sgd(args.lr)
+    opt_state = opt.init(params)
+    engine = peer.engine()
+
+    steps = len(x) // args.batch_size
+    for epoch in range(args.n_epochs):
+        for i in range(steps):
+            monitor_batch_begin(rank)
+            xb = x[i * args.batch_size : (i + 1) * args.batch_size]
+            yb = y[i * args.batch_size : (i + 1) * args.batch_size]
+            loss, grads = loss_grad(params, (xb, yb))
+            if engine is not None:
+                flat, spec = kf.ops.fuse(grads)
+                red = engine.all_reduce(np.asarray(flat), op="mean")
+                grads = kf.ops.defuse(jnp.asarray(red), spec)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            monitor_batch_end(rank)
+            if (
+                args.die_at_epoch >= 0
+                and not args.restart
+                and rank == size - 1
+                and epoch == args.die_at_epoch
+                and i == steps // 2
+            ):
+                print(f"worker {rank}: simulating crash at epoch {epoch}", flush=True)
+                os._exit(17)
+            if (
+                args.hang_at_epoch >= 0
+                and not args.restart
+                and rank == size - 1
+                and epoch == args.hang_at_epoch
+                and i == steps // 2
+            ):
+                print(f"worker {rank}: simulating stall at epoch {epoch}", flush=True)
+                monitor_batch_begin(rank)  # begin that never ends
+                import time as _t
+
+                _t.sleep(3600)
+        global_epoch = start_epoch + epoch
+        monitor_epoch_end(rank, global_epoch)
+        if rank == 0:
+            save_checkpoint(
+                args.ckpt_dir, global_epoch, params,
+                meta={"epochs_done": global_epoch + 1},
+            )
+            print(f"epoch {global_epoch}: loss {float(loss):.4f}", flush=True)
+
+    monitor_train_end(rank)
+    print(f"worker {rank}: trained epochs [{start_epoch}, {start_epoch + args.n_epochs}) OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -103,8 +103,13 @@ class CollectiveEngine:
         from concurrent.futures import ThreadPoolExecutor
 
         self._pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="kf-engine")
-        # per-strategy-pair accounting: (bytes, seconds) for adaptation
+        # per-strategy-pair accounting for adaptation: cumulative
+        # (bytes, seconds), a recent window (reset on throughputs()), and
+        # the best window rate ever observed (the reference compares recent
+        # throughput against the recorded best, adaptiveStrategies.go)
         self.stats = [[0, 0.0] for _ in self._graphs]
+        self._window = [[0, 0.0] for _ in self._graphs]
+        self.best_throughputs = [0.0 for _ in self._graphs]
 
     # -- public collectives ----------------------------------------------
     def all_reduce(self, x: np.ndarray, op: str = "sum", name: str = "") -> np.ndarray:
@@ -136,6 +141,9 @@ class CollectiveEngine:
             st = self.stats[gi]
             st[0] += chunk.nbytes
             st[1] += dt
+            w = self._window[gi]
+            w[0] += chunk.nbytes
+            w[1] += dt
 
         if len(chunks) == 1:
             run_chunk(0, chunks[0])
@@ -222,10 +230,21 @@ class CollectiveEngine:
 
     # -- adaptation hooks ------------------------------------------------
     def throughputs(self) -> List[float]:
-        """Per-strategy-pair achieved GiB/s (reference ``strategy.go:17-56``)."""
-        return [
-            (b / t / 2**30) if t > 0 else 0.0 for b, t in self.stats
-        ]
+        """Per-strategy-pair achieved GiB/s over the window since the last
+        call; also updates :attr:`best_throughputs`
+        (reference ``strategy.go:17-56``)."""
+        out = []
+        for i, (b, t) in enumerate(self._window):
+            rate = (b / t / 2**30) if t > 0 else 0.0
+            out.append(rate)
+            if rate > self.best_throughputs[i]:
+                self.best_throughputs[i] = rate
+            self._window[i] = [0, 0.0]
+        return out
+
+    def total_throughputs(self) -> List[float]:
+        """Lifetime per-strategy-pair GiB/s."""
+        return [(b / t / 2**30) if t > 0 else 0.0 for b, t in self.stats]
 
     def set_strategy(self, strategy: Strategy) -> None:
         """Swap the strategy set (reference ``SetGlobalStrategy`` +
@@ -234,3 +253,5 @@ class CollectiveEngine:
         self.strategy = strategy
         self._graphs = build_strategy_graphs(strategy, self.peers)
         self.stats = [[0, 0.0] for _ in self._graphs]
+        self._window = [[0, 0.0] for _ in self._graphs]
+        self.best_throughputs = [0.0 for _ in self._graphs]

@@ -112,9 +112,11 @@ class HostChannel:
     any late stale-epoch arrival is discarded at enqueue (fencing).
     """
 
-    def __init__(self, self_id: PeerID, token: int = 0, bind_host: str = ""):
+    def __init__(self, self_id: PeerID, token: int = 0, bind_host: str = "", monitor=None):
         self.self_id = self_id
         self._token = token
+        #: optional NetMonitor recording egress/ingress byte counts
+        self.monitor = monitor
         self._queues: Dict[Tuple[int, str, str], queue.Queue] = {}
         self._qlock = threading.Lock()
         self._control_handlers = []
@@ -182,6 +184,8 @@ class HostChannel:
             return q
 
     def _dispatch(self, msg: _Msg, sock: socket.socket) -> None:
+        if self.monitor is not None:
+            self.monitor.ingress(msg.src, len(msg.payload))
         if msg.conn_type == ConnType.PING:
             try:
                 sock.sendall(_encode(self._token, ConnType.PING, str(self.self_id), msg.name, b""))
@@ -255,6 +259,10 @@ class HostChannel:
         retries: int = CONNECT_RETRIES,
     ) -> None:
         data = _encode(self._token, conn_type, str(self.self_id), name, payload)
+        if self.monitor is not None:
+            # payload bytes on both sides (ingress counts the same), so
+            # egress/ingress totals of a symmetric exchange match
+            self.monitor.egress(str(peer), len(payload))
         entry = self._pooled(peer)
         with entry[1]:
             if entry[0] is None:

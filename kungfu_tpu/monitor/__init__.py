@@ -1,0 +1,27 @@
+"""Monitoring: failure detection, heartbeats, network/throughput metrics.
+
+* :mod:`kungfu_tpu.monitor.detector` — the failure-detection server
+  (reference fork's ``srcs/go/kungfu/runner/monitorserver/monitor.go``);
+* :mod:`kungfu_tpu.monitor.signals` — worker-side heartbeat senders
+  (reference ``kungfu/cmd/__init__.py`` monitor_* + ``libkungfu-comm/send.go``);
+* :mod:`kungfu_tpu.monitor.metrics` — egress/ingress counters + HTTP
+  ``/metrics`` endpoint (reference ``srcs/go/monitor``).
+"""
+
+from kungfu_tpu.monitor.detector import DetectorServer, DetectorResults, DEFAULT_DETECTOR_PORT
+from kungfu_tpu.monitor.signals import (
+    monitor_batch_begin,
+    monitor_batch_end,
+    monitor_epoch_end,
+    monitor_train_end,
+)
+
+__all__ = [
+    "DetectorServer",
+    "DetectorResults",
+    "DEFAULT_DETECTOR_PORT",
+    "monitor_batch_begin",
+    "monitor_batch_end",
+    "monitor_epoch_end",
+    "monitor_train_end",
+]

@@ -1,0 +1,107 @@
+"""Adaptation signals: peer latencies, MST topology, interference votes.
+
+Parity with the reference's adaptive-communication machinery:
+
+* latency probing — ``GetPeerLatencies`` (``session/monitoring.go:38-64``):
+  ping round-trip times to every peer;
+* latency-derived topology — allgather the latency rows, run Prim's MST,
+  install the tree with ``set_tree`` (``topology.cpp:84-151`` +
+  ``adaptation.cpp``);
+* interference detection — per-strategy throughput accounting with a
+  0.8-of-best threshold and a cluster-wide majority vote
+  (``session/strategy.go:17-56``, ``adaptiveStrategies.go:13-121``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from kungfu_tpu.plan.graph import Graph
+from kungfu_tpu.plan.mst import minimum_spanning_tree
+from kungfu_tpu.plan.topology import gen_default_reduce_graph
+from kungfu_tpu.utils.log import get_logger
+
+_log = get_logger("adapt")
+
+INTERFERENCE_THRESHOLD = 0.8  # reference adaptiveStrategies.go
+
+
+def get_peer_latencies(peer, samples: int = 1) -> List[float]:
+    """Ping RTT (seconds) from this peer to every worker; 0.0 for self,
+    **+inf for unreachable peers** — an unreachable peer must look
+    infinitely expensive to the MST, not free, or the broadcast tree gets
+    hubbed on a dead node."""
+    channel = peer.channel
+    out: List[float] = []
+    for target in peer.cluster.workers:
+        if channel is None or target == peer.config.self_id:
+            out.append(0.0)
+            continue
+        best = None
+        for _ in range(samples):
+            t0 = time.perf_counter()
+            if channel.ping(target, timeout=5.0):
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+        out.append(best if best is not None else float("inf"))
+    return out
+
+
+def latency_matrix(peer, samples: int = 1) -> np.ndarray:
+    """Allgather every peer's latency row into the full (n, n) matrix."""
+    row = np.asarray(get_peer_latencies(peer, samples), dtype=np.float64)
+    channel, workers = peer.channel, peer.cluster.workers
+    if channel is None:
+        return row[None, :]
+    rows = channel.allgather_bytes(row.tobytes(), workers, name=f"lat.v{peer.cluster_version}")
+    return np.stack([np.frombuffer(r, dtype=np.float64) for r in rows])
+
+
+def minimum_spanning_tree_from_latencies(peer, samples: int = 1) -> List[int]:
+    """The MinimumSpanningTree op analog: measured latencies → forest array."""
+    return minimum_spanning_tree(latency_matrix(peer, samples))
+
+
+def set_tree(engine, forest: List[int]) -> None:
+    """Install an explicit broadcast tree on the engine
+    (reference ``SetTree``/``AllReduceWith``, ``adaptation.cpp:5``).
+    The caller is responsible for the cluster-wide consensus + barrier
+    around the swap (reference ``adaptation.go:8-28``)."""
+    bcast = Graph.from_forest_array(forest)
+    reduce_g = gen_default_reduce_graph(bcast)
+    engine._graphs = [(reduce_g, bcast)]
+    engine.stats = [[0, 0.0]]
+    engine._window = [[0, 0.0]]
+    engine.best_throughputs = [0.0]
+    engine.strategy = None
+    _log.info("installed explicit tree %s", forest)
+
+
+def check_interference(
+    engine,
+    reference_throughputs: Optional[List[float]] = None,
+    threshold: float = INTERFERENCE_THRESHOLD,
+) -> List[int]:
+    """Local interference suspicion: strategy-pair indices whose
+    recent-window throughput dropped below ``threshold`` x the **recorded
+    best** for that pair (reference flags a strategy under 0.8 of its
+    monitored best and then majority-votes across peers,
+    ``adaptiveStrategies.go:57-121``)."""
+    tp = engine.throughputs()  # recent window; updates best_throughputs
+    ref = reference_throughputs or engine.best_throughputs
+    return [
+        i for i, (t, r) in enumerate(zip(tp, ref))
+        if r > 0 and t > 0 and t < threshold * r
+    ]
+
+
+def majority_vote_interference(peer, suspected: bool) -> bool:
+    """Cluster-wide majority vote over local suspicion flags."""
+    engine = peer.engine()
+    if engine is None:
+        return suspected
+    votes = engine.all_reduce(np.array([1 if suspected else 0], np.int64), op="sum")
+    return int(votes[0]) * 2 > peer.size()

@@ -1,0 +1,248 @@
+"""Failure-detection server.
+
+Parity with the fork's monitor server
+(``srcs/go/kungfu/runner/monitorserver/monitor.go``, documented in
+``docs/monitor_proposal.md``):
+
+* listens on ``<host>:7756`` for worker heartbeat signals
+  (``begin``/``end``/``epoch``/``trainend`` per rank);
+* a rank is flagged **down** when a batch ``begin`` has no matching
+  ``end`` for ``stall_timeout`` seconds (default 10s, ``monitor.go:111``)
+  — or when its heartbeats stop entirely;
+* on detection, records ``min`` completed epoch across ranks (the restart
+  point) and fans ``otherdown:<minEpoch>`` out to the other hosts'
+  detectors so every MonitoredRun restarts in lockstep
+  (``monitor.go:116-167``);
+* ``trainend`` from all ranks → finish flag.
+
+Consumed by :func:`kungfu_tpu.runner.monitored.monitored_run`.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from kungfu_tpu.utils.log import get_logger
+
+_log = get_logger("detector")
+
+DEFAULT_DETECTOR_PORT = 7756  # reference monitor.go
+DEFAULT_STALL_TIMEOUT_S = 10.0
+CHECK_PERIOD_S = 1.0
+
+
+@dataclass
+class DetectorResults:
+    down_flag: bool = False
+    epoch_num: int = 0  # min completed epoch across ranks at detection time
+    finish_flag: bool = False
+
+
+@dataclass
+class _RankState:
+    last_begin: float = 0.0
+    last_end: float = 0.0
+    open_begin: bool = False
+    epochs_done: int = 0
+    finished: bool = False
+    seen: bool = False
+
+
+class DetectorServer:
+    """One per runner host.  ``peer_hosts`` are the *other* runner hosts'
+    detector addresses for the fan-out."""
+
+    def __init__(
+        self,
+        expected_ranks: int,
+        port: int = DEFAULT_DETECTOR_PORT,
+        peer_hosts: Optional[List[str]] = None,
+        stall_timeout: float = DEFAULT_STALL_TIMEOUT_S,
+        host: str = "0.0.0.0",
+        require_all_seen: bool = True,
+    ):
+        self.expected_ranks = expected_ranks
+        self.port = port
+        self.peer_hosts = peer_hosts or []
+        self.stall_timeout = stall_timeout
+        self.require_all_seen = require_all_seen
+        self.results = DetectorResults()
+        self._ranks: Dict[int, _RankState] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        srv = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                _log.debug(fmt, *args)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                try:
+                    sig = json.loads(self.rfile.read(n).decode())
+                    srv._on_signal(sig)
+                    code = 200
+                except (ValueError, KeyError) as e:
+                    _log.warning("bad signal: %s", e)
+                    code = 400
+                self.send_response(code)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def do_GET(self):
+                body = json.dumps(
+                    {
+                        "down": srv.results.down_flag,
+                        "epoch": srv.results.epoch_num,
+                        "finished": srv.results.finish_flag,
+                    }
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self._threads: List[threading.Thread] = []
+
+    # -- signal intake ---------------------------------------------------
+    def _rank(self, r: int) -> _RankState:
+        st = self._ranks.get(r)
+        if st is None:
+            st = self._ranks[r] = _RankState()
+        return st
+
+    def _on_signal(self, sig: dict) -> None:
+        kind = sig["kind"]
+        now = time.time()
+        with self._lock:
+            if kind == "otherdown":
+                # another host's detector saw a failure
+                self.results.down_flag = True
+                self.results.epoch_num = int(sig.get("epoch", 0))
+                return
+            if kind == "otherfinish":
+                self.results.finish_flag = True
+                return
+            st = self._rank(int(sig["rank"]))
+            st.seen = True
+            if kind == "begin":
+                st.last_begin, st.open_begin = now, True
+            elif kind == "end":
+                st.last_end, st.open_begin = now, False
+            elif kind == "epoch":
+                st.epochs_done = max(st.epochs_done, int(sig["epoch"]) + 1)
+            elif kind == "trainend":
+                st.finished = True
+                if all(s.finished for s in self._ranks.values()) and (
+                    len(self._ranks) >= self.expected_ranks or not self.require_all_seen
+                ):
+                    self.results.finish_flag = True
+                    self._fanout({"kind": "otherfinish"})
+            else:
+                raise KeyError(f"unknown signal kind {kind!r}")
+
+    # -- detection loop --------------------------------------------------
+    def _check_once(self) -> None:
+        now = time.time()
+        with self._lock:
+            if self.results.down_flag or self.results.finish_flag:
+                return
+            for r, st in self._ranks.items():
+                if st.finished:
+                    continue
+                stalled_in_batch = st.open_begin and now - st.last_begin > self.stall_timeout
+                # a rank that goes silent *between* batches (hung data
+                # loader, dead host) has open_begin False — give it a
+                # longer grace (3x) on total heartbeat silence
+                last_seen = max(st.last_begin, st.last_end)
+                silent = (
+                    not st.open_begin
+                    and last_seen > 0
+                    and now - last_seen > 3 * self.stall_timeout
+                )
+                if stalled_in_batch or silent:
+                    min_epoch = min(
+                        (s.epochs_done for s in self._ranks.values()), default=0
+                    )
+                    _log.warning(
+                        "rank %d down (%s for %.0fs); restart epoch %d",
+                        r,
+                        "begin without end" if stalled_in_batch else "heartbeat silence",
+                        now - (st.last_begin if stalled_in_batch else last_seen),
+                        min_epoch,
+                    )
+                    self.results.down_flag = True
+                    self.results.epoch_num = min_epoch
+                    self._fanout({"kind": "otherdown", "epoch": min_epoch})
+                    return
+
+    def _fanout(self, sig: dict) -> None:
+        for host in self.peer_hosts:
+            try:
+                post_signal(host, self.port, sig, timeout=3)
+            except OSError as e:
+                _log.warning("fanout to %s failed: %s", host, e)
+
+    def _loop(self):
+        while not self._stop.wait(CHECK_PERIOD_S):
+            self._check_once()
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "DetectorServer":
+        t1 = threading.Thread(target=self._server.serve_forever, daemon=True)
+        t2 = threading.Thread(target=self._loop, daemon=True)
+        t1.start()
+        t2.start()
+        self._threads = [t1, t2]
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._server.shutdown()
+        self._server.server_close()
+
+    def report_local_down(self) -> None:
+        """Mark a locally-observed failure (e.g. worker process exit) and
+        fan it out to the other hosts' detectors so every MonitoredRun
+        restarts in the same round."""
+        with self._lock:
+            if self.results.down_flag:
+                return
+            min_epoch = min((s.epochs_done for s in self._ranks.values()), default=0)
+            self.results.down_flag = True
+            self.results.epoch_num = min_epoch
+        self._fanout({"kind": "otherdown", "epoch": min_epoch})
+
+    def min_epoch(self) -> int:
+        """Min completed epochs across ranks seen so far (restart point for
+        failures detected via process exit rather than heartbeat stall)."""
+        with self._lock:
+            return min((s.epochs_done for s in self._ranks.values()), default=0)
+
+    def reset(self, expected_ranks: Optional[int] = None) -> None:
+        """Clear state for a relaunch round."""
+        with self._lock:
+            self._ranks.clear()
+            self.results = DetectorResults()
+            if expected_ranks is not None:
+                self.expected_ranks = expected_ranks
+
+
+def post_signal(host: str, port: int, sig: dict, timeout: float = 5.0) -> None:
+    req = urllib.request.Request(
+        f"http://{host}:{port}/signal",
+        data=json.dumps(sig).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        resp.read()

@@ -1,0 +1,151 @@
+"""Network monitoring: egress/ingress counters, rates, /metrics endpoint.
+
+Parity with reference ``srcs/go/monitor/{monitor,counters,server}.go``:
+per-remote-peer byte counters sampled into rates every
+``KF_CONFIG_MONITORING_PERIOD`` seconds (default 1s), exposed through an
+HTTP ``/metrics`` endpoint at ``worker port + 10000``
+(``peer/peer.go:92-100``) and through :meth:`NetMonitor.egress_rates`
+(the ``GetEgressRates`` API / ``EgressRates`` op analog).
+Enabled by ``KF_CONFIG_ENABLE_MONITORING``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from kungfu_tpu.utils.envs import MONITORING_PERIOD, parse_bool_env
+from kungfu_tpu.utils.log import get_logger
+
+_log = get_logger("metrics")
+
+DEFAULT_PERIOD_S = 1.0
+METRICS_PORT_OFFSET = 10000  # reference peer.go:92
+
+
+class _RateCounter:
+    __slots__ = ("total", "last_total", "rate")
+
+    def __init__(self):
+        self.total = 0
+        self.last_total = 0
+        self.rate = 0.0
+
+    def sample(self, dt: float):
+        d = self.total - self.last_total
+        self.rate = d / dt if dt > 0 else 0.0
+        self.last_total = self.total
+
+
+class NetMonitor:
+    """Byte counters per remote address, sampled into rates periodically."""
+
+    def __init__(self, period: float = DEFAULT_PERIOD_S):
+        self.period = period
+        self._egress: Dict[str, _RateCounter] = defaultdict(_RateCounter)
+        self._ingress: Dict[str, _RateCounter] = defaultdict(_RateCounter)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def egress(self, addr: str, nbytes: int) -> None:
+        with self._lock:
+            self._egress[addr].total += nbytes
+
+    def ingress(self, addr: str, nbytes: int) -> None:
+        with self._lock:
+            self._ingress[addr].total += nbytes
+
+    def _sample_loop(self):
+        t0 = time.time()
+        while not self._stop.wait(self.period):
+            now = time.time()
+            dt, t0 = now - t0, now
+            with self._lock:
+                for c in self._egress.values():
+                    c.sample(dt)
+                for c in self._ingress.values():
+                    c.sample(dt)
+
+    def start(self) -> "NetMonitor":
+        self._thread = threading.Thread(target=self._sample_loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def egress_rates(self, addrs: Optional[List[str]] = None) -> List[float]:
+        """Bytes/sec toward each addr (reference GetEgressRates)."""
+        with self._lock:
+            if addrs is None:
+                addrs = sorted(self._egress)
+            return [self._egress[a].rate if a in self._egress else 0.0 for a in addrs]
+
+    def totals(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {
+                "egress": {a: c.total for a, c in self._egress.items()},
+                "ingress": {a: c.total for a, c in self._ingress.items()},
+            }
+
+    def render_prometheus(self, extra: Optional[Dict[str, float]] = None) -> str:
+        lines = []
+        with self._lock:
+            for a, c in sorted(self._egress.items()):
+                lines.append(f'kf_egress_bytes_total{{peer="{a}"}} {c.total}')
+                lines.append(f'kf_egress_bytes_per_sec{{peer="{a}"}} {c.rate:.1f}')
+            for a, c in sorted(self._ingress.items()):
+                lines.append(f'kf_ingress_bytes_total{{peer="{a}"}} {c.total}')
+                lines.append(f'kf_ingress_bytes_per_sec{{peer="{a}"}} {c.rate:.1f}')
+        for k, v in (extra or {}).items():
+            lines.append(f"{k} {v}")
+        return "\n".join(lines) + "\n"
+
+
+class MetricsServer:
+    """HTTP ``/metrics`` endpoint (reference ``monitor/server.go``)."""
+
+    def __init__(self, monitor: NetMonitor, port: int, host: str = "0.0.0.0",
+                 extra_fn=None):
+        mon = monitor
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                _log.debug(fmt, *args)
+
+            def do_GET(self):
+                if not self.path.startswith("/metrics"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = mon.render_prometheus(extra_fn() if extra_fn else None).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.port = port
+
+    def start(self) -> "MetricsServer":
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def monitoring_period_from_env() -> float:
+    import os
+
+    try:
+        return float(os.environ.get(MONITORING_PERIOD, DEFAULT_PERIOD_S))
+    except ValueError:
+        return DEFAULT_PERIOD_S

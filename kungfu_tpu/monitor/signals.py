@@ -1,0 +1,60 @@
+"""Worker-side heartbeat signal senders.
+
+Parity with reference ``kungfu/cmd/__init__.py:11-29`` (monitor_batch_begin
+/ monitor_batch_end / monitor_epoch_end / monitor_train_end) →
+``libkungfu-comm/send.go:32-57`` (POST to the rank-0 host's detector at
+:7756).  The detector address comes from ``KF_MONITOR_ADDR`` (set by the
+monitored runner); with it unset these are no-ops, so instrumented training
+scripts run unchanged under plain ``kfrun``.
+
+Failures to deliver are swallowed by design: a dying detector must not
+take the training job down with it.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from kungfu_tpu.monitor.detector import DEFAULT_DETECTOR_PORT, post_signal
+from kungfu_tpu.utils.log import get_logger
+
+_log = get_logger("signals")
+
+MONITOR_ADDR_ENV = "KF_MONITOR_ADDR"
+
+
+def _target() -> Optional[tuple]:
+    addr = os.environ.get(MONITOR_ADDR_ENV)
+    if not addr:
+        return None
+    if ":" in addr:
+        host, port = addr.rsplit(":", 1)
+        return host, int(port)
+    return addr, DEFAULT_DETECTOR_PORT
+
+
+def _send(sig: dict) -> None:
+    target = _target()
+    if target is None:
+        return
+    try:
+        post_signal(target[0], target[1], sig, timeout=3)
+    except OSError as e:
+        _log.debug("signal %s not delivered: %s", sig.get("kind"), e)
+
+
+def monitor_batch_begin(rank: int) -> None:
+    _send({"kind": "begin", "rank": rank})
+
+
+def monitor_batch_end(rank: int) -> None:
+    _send({"kind": "end", "rank": rank})
+
+
+def monitor_epoch_end(rank: int, epoch: int) -> None:
+    _send({"kind": "epoch", "rank": rank, "epoch": epoch})
+
+
+def monitor_train_end(rank: int) -> None:
+    _send({"kind": "trainend", "rank": rank})

@@ -50,6 +50,8 @@ class Peer:
 
         #: this peer's versioned model store (served to gossip peers)
         self.store = VersionedStore()
+        self.net_monitor = None
+        self._metrics_server = None
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> None:
@@ -65,9 +67,26 @@ class Peer:
                     jax.config.update("jax_platforms", platform)
                 except Exception as e:  # backend may already be initialized
                     _log.warning("cannot set jax platform %s: %s", platform, e)
+            monitor = None
+            if envs.parse_bool_env(envs.ENABLE_MONITORING):
+                from kungfu_tpu.monitor.metrics import (
+                    METRICS_PORT_OFFSET,
+                    MetricsServer,
+                    NetMonitor,
+                    monitoring_period_from_env,
+                )
+
+                monitor = NetMonitor(monitoring_period_from_env()).start()
+                self.net_monitor = monitor
+                try:
+                    self._metrics_server = MetricsServer(
+                        monitor, self.config.self_id.port + METRICS_PORT_OFFSET
+                    ).start()
+                except OSError as e:
+                    _log.warning("metrics server not started: %s", e)
             if not self.config.single_process:
                 self._channel = HostChannel(
-                    self.config.self_id, token=self.cluster_version
+                    self.config.self_id, token=self.cluster_version, monitor=monitor
                 )
                 from kungfu_tpu.store import install_p2p_handler
 
@@ -92,6 +111,12 @@ class Peer:
             if self._channel is not None:
                 self._channel.close()
                 self._channel = None
+            if self._metrics_server is not None:
+                self._metrics_server.stop()
+                self._metrics_server = None
+            if self.net_monitor is not None:
+                self.net_monitor.stop()
+                self.net_monitor = None
             if self._engine is not None:
                 self._engine.close()
             self._engine = None
@@ -247,6 +272,41 @@ class Peer:
                 self._channel.send(runner, "update", stage, ConnType.CONTROL)
             except (TimeoutError, ConnectionError) as e:
                 _log.warning("cannot notify runner %s: %s", runner, e)
+
+    # -- monitoring / adaptation (reference peer.hpp GetPeerLatencies /
+    # CheckInterference / GetEgressRates / SetTree) ----------------------
+    def get_peer_latencies(self, samples: int = 1):
+        from kungfu_tpu.monitor.adapt import get_peer_latencies
+
+        return get_peer_latencies(self, samples)
+
+    def get_egress_rates(self):
+        if self.net_monitor is None:
+            return [0.0] * self.size()
+        return self.net_monitor.egress_rates(
+            [str(w) for w in self.cluster.workers]
+        )
+
+    def check_interference(self) -> bool:
+        from kungfu_tpu.monitor.adapt import check_interference, majority_vote_interference
+
+        engine = self.engine()
+        suspected = bool(engine and check_interference(engine))
+        return majority_vote_interference(self, suspected)
+
+    def set_tree(self, forest) -> None:
+        """Install an explicit broadcast tree after cluster-wide agreement
+        (reference SetTree: consensus on the tree digest, barrier, swap)."""
+        from kungfu_tpu.monitor.adapt import set_tree
+        from kungfu_tpu.plan.graph import Graph
+
+        digest = Graph.from_forest_array(forest).digest_bytes()
+        if not self.consensus_bytes(digest, name="set-tree"):
+            raise RuntimeError("peers disagree on the proposed tree")
+        self.barrier()
+        engine = self.engine()
+        if engine is not None:
+            set_tree(engine, forest)
 
     # -- p2p blob store (gossip) -----------------------------------------
     def save(self, name: str, blob: bytes, version: Optional[str] = None) -> None:

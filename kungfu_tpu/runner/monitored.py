@@ -1,0 +1,133 @@
+"""MonitoredRun — failure detection + automatic restart.
+
+Parity with the fork's ``runner/monitored.go:18-75``: run the job under the
+heartbeat detector; when a worker is flagged down (begin-without-end past
+the timeout, or the process dies), kill everything, rewrite ``--n-epochs``
+to the remaining count, append ``--restart 1``, and relaunch.  Workers are
+expected to checkpoint per epoch and reload on ``--restart 1`` (see
+``examples/failure_recovery.py`` and :mod:`kungfu_tpu.checkpoint`).
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from typing import List, Optional
+
+from kungfu_tpu.monitor.detector import DEFAULT_DETECTOR_PORT, DetectorServer
+from kungfu_tpu.monitor.signals import MONITOR_ADDR_ENV
+from kungfu_tpu.plan.cluster import Cluster
+from kungfu_tpu.runner.job import Job
+from kungfu_tpu.runner.proc import kill_group, start_proc
+from kungfu_tpu.utils.log import get_logger
+
+_log = get_logger("monitored")
+
+MAX_RESTARTS = 16
+
+
+def parse_period(spec: str) -> float:
+    """'10s' / '2m' / plain seconds."""
+    m = re.fullmatch(r"(\d+(?:\.\d+)?)(s|m|ms)?", spec.strip())
+    if not m:
+        raise ValueError(f"bad period {spec!r}")
+    v = float(m.group(1))
+    unit = m.group(2) or "s"
+    return v * {"s": 1.0, "m": 60.0, "ms": 0.001}[unit]
+
+
+def patch_args(args: List[str], remaining_epochs: int, flag: str = "--n-epochs") -> List[str]:
+    """Rewrite the epochs flag and mark the restart
+    (reference ``monitored.go:52-66``)."""
+    out = list(args)
+    for i, a in enumerate(out):
+        if a == flag and i + 1 < len(out):
+            out[i + 1] = str(remaining_epochs)
+            break
+        if a.startswith(flag + "="):
+            out[i] = f"{flag}={remaining_epochs}"
+            break
+    else:
+        out += [flag, str(remaining_epochs)]
+    if "--restart" not in " ".join(out):
+        out += ["--restart", "1"]
+    return out
+
+
+def find_epochs(args: List[str], flag: str = "--n-epochs") -> Optional[int]:
+    for i, a in enumerate(args):
+        if a == flag and i + 1 < len(args):
+            return int(args[i + 1])
+        if a.startswith(flag + "="):
+            return int(a.split("=", 1)[1])
+    return None
+
+
+def monitored_run(ns, cluster: Cluster, job: Job) -> int:
+    period = parse_period(ns.auto_recover)
+    self_host = ns.self_host
+    hosts = cluster.runners.hosts()
+    main_host = hosts[0]
+    peer_hosts = [h for h in hosts if h != self_host]
+    detector = DetectorServer(
+        expected_ranks=cluster.size(),
+        peer_hosts=peer_hosts,
+        stall_timeout=period,
+    ).start()
+    job.extra_envs[MONITOR_ADDR_ENV] = f"{main_host}:{DEFAULT_DETECTOR_PORT}"
+
+    total_epochs = find_epochs(job.args, ns.n_epochs_flag)
+    args0 = list(job.args)
+    restarts = 0
+    epochs_done_total = 0  # cumulative across restart rounds
+    try:
+        while True:
+            detector.reset(cluster.size())
+            procs = job.create_procs(cluster, self_host)
+            running = [start_proc(p, i, quiet=ns.quiet) for i, p in enumerate(procs)]
+            _log.info(
+                "round %d: %d workers (remaining args: %s)",
+                restarts, len(running), " ".join(job.args),
+            )
+            while True:
+                time.sleep(0.2)
+                codes = [r.popen.poll() for r in running]
+                if detector.results.finish_flag or all(c == 0 for c in codes):
+                    _log.info("training finished")
+                    return 0
+                if any(c is not None and c != 0 for c in codes):
+                    # local exit-code failure: other hosts' detectors only see
+                    # heartbeat stalls, so fan the failure out explicitly to
+                    # keep multi-host restart rounds in lockstep
+                    detector.report_local_down()
+                    break
+                if detector.results.down_flag:
+                    break
+            for r in running:
+                kill_group(r)
+            for r in running:
+                try:
+                    r.popen.wait(timeout=10)
+                except Exception:  # noqa: BLE001
+                    pass
+            restarts += 1
+            if restarts > MAX_RESTARTS:
+                _log.error("giving up after %d restarts", MAX_RESTARTS)
+                return 1
+            # workers report *global* (cumulative) epoch numbers across
+            # restarts, so the detector's min-epoch is cumulative too —
+            # take the max, never add (adding double-counts on a second
+            # failure and under-trains the job)
+            done = detector.results.epoch_num or detector.min_epoch()
+            epochs_done_total = max(epochs_done_total, done)
+            if total_epochs is not None:
+                remaining = max(total_epochs - epochs_done_total, 1)
+                job.args = patch_args(args0, remaining, ns.n_epochs_flag)
+            else:
+                job.args = patch_args(args0, 1, ns.n_epochs_flag)
+            _log.warning(
+                "worker failure detected (%d epochs completed); restarting with %s",
+                epochs_done_total, " ".join(job.args),
+            )
+    finally:
+        detector.stop()

@@ -1,0 +1,139 @@
+"""Failure detection + auto-recovery tests (fork subsystem parity)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kungfu_tpu.checkpoint import (
+    latest_step,
+    prune_checkpoints,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from kungfu_tpu.monitor.detector import DetectorServer, post_signal
+from kungfu_tpu.runner.monitored import find_epochs, parse_period, patch_args
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestArgPatching:
+    def test_patch(self):
+        args = ["train.py", "--n-epochs", "10", "--lr", "0.1"]
+        out = patch_args(args, 7)
+        assert out == ["train.py", "--n-epochs", "7", "--lr", "0.1", "--restart", "1"]
+
+    def test_patch_eq_form(self):
+        out = patch_args(["t.py", "--n-epochs=10"], 3)
+        assert "--n-epochs=3" in out and "--restart" in out
+
+    def test_patch_missing_appends(self):
+        out = patch_args(["t.py"], 5)
+        assert out[-4:] == ["--n-epochs", "5", "--restart", "1"]
+
+    def test_find_epochs(self):
+        assert find_epochs(["x", "--n-epochs", "12"]) == 12
+        assert find_epochs(["x", "--n-epochs=3"]) == 3
+        assert find_epochs(["x"]) is None
+
+    def test_parse_period(self):
+        assert parse_period("10s") == 10.0
+        assert parse_period("2m") == 120.0
+        assert parse_period("500ms") == 0.5
+        with pytest.raises(ValueError):
+            parse_period("abc")
+
+
+class TestDetector:
+    @pytest.fixture
+    def detector(self):
+        d = DetectorServer(expected_ranks=2, port=27756, stall_timeout=1.0).start()
+        yield d
+        d.stop()
+
+    def test_stall_detection(self, detector):
+        post_signal("127.0.0.1", 27756, {"kind": "epoch", "rank": 0, "epoch": 0})
+        post_signal("127.0.0.1", 27756, {"kind": "epoch", "rank": 1, "epoch": 1})
+        post_signal("127.0.0.1", 27756, {"kind": "begin", "rank": 1})
+        # rank 1 never sends end -> down after ~1s, min epoch = 1 (rank0 done 1)
+        deadline = time.time() + 10
+        while not detector.results.down_flag and time.time() < deadline:
+            time.sleep(0.2)
+        assert detector.results.down_flag
+        assert detector.results.epoch_num == 1
+        assert detector.min_epoch() == 1
+
+    def test_begin_end_cycle_no_false_positive(self, detector):
+        for _ in range(3):
+            post_signal("127.0.0.1", 27756, {"kind": "begin", "rank": 0})
+            time.sleep(0.1)
+            post_signal("127.0.0.1", 27756, {"kind": "end", "rank": 0})
+        time.sleep(2.0)
+        assert not detector.results.down_flag
+
+    def test_finish_flag(self, detector):
+        post_signal("127.0.0.1", 27756, {"kind": "trainend", "rank": 0})
+        assert not detector.results.finish_flag  # only 1 of 2 ranks
+        post_signal("127.0.0.1", 27756, {"kind": "trainend", "rank": 1})
+        assert detector.results.finish_flag
+
+    def test_otherdown_fanout_intake(self, detector):
+        post_signal("127.0.0.1", 27756, {"kind": "otherdown", "epoch": 3})
+        assert detector.results.down_flag and detector.results.epoch_num == 3
+
+    def test_status_endpoint(self, detector):
+        with urllib.request.urlopen("http://127.0.0.1:27756/", timeout=5) as r:
+            doc = json.loads(r.read().decode())
+        assert set(doc) == {"down", "epoch", "finished"}
+
+    def test_reset(self, detector):
+        post_signal("127.0.0.1", 27756, {"kind": "otherdown", "epoch": 3})
+        detector.reset()
+        assert not detector.results.down_flag
+        assert detector.min_epoch() == 0
+
+
+class TestCheckpoint:
+    def test_roundtrip(self, tmp_path):
+        tree = {"w": np.arange(6, dtype=np.float32).reshape(2, 3), "b": np.ones(4)}
+        save_checkpoint(str(tmp_path), 3, tree, meta={"epochs_done": 2})
+        like = {"w": np.zeros((2, 3), np.float32), "b": np.zeros(4)}
+        out, step, meta = restore_checkpoint(str(tmp_path), like)
+        assert step == 3 and meta == {"epochs_done": 2}
+        np.testing.assert_allclose(out["w"], tree["w"])
+
+    def test_latest_and_prune(self, tmp_path):
+        tree = {"x": np.zeros(2)}
+        for s in range(5):
+            save_checkpoint(str(tmp_path), s, tree)
+        assert latest_step(str(tmp_path)) == 4
+        prune_checkpoints(str(tmp_path), keep=2)
+        assert latest_step(str(tmp_path)) == 4
+        assert restore_checkpoint(str(tmp_path), tree, step=4) is not None
+        with pytest.raises(FileNotFoundError):
+            restore_checkpoint(str(tmp_path), tree, step=0)
+
+    def test_restore_empty_dir(self, tmp_path):
+        assert restore_checkpoint(str(tmp_path), {"x": np.zeros(1)}) is None
+
+
+@pytest.mark.slow
+class TestAutoRecoveryCLI:
+    def test_crash_recovery(self, tmp_path):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        r = subprocess.run(
+            [sys.executable, "-m", "kungfu_tpu.runner.cli", "-auto-recover", "4s",
+             "-np", "2", sys.executable, "examples/failure_recovery.py",
+             "--n-epochs", "3", "--die-at-epoch", "1",
+             "--ckpt-dir", str(tmp_path)],
+            cwd=REPO, capture_output=True, text=True, timeout=350, env=env,
+        )
+        assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
+        assert "restarted from epoch 1" in r.stdout
+        assert "trained epochs [1, 3) OK" in r.stdout

@@ -1,0 +1,164 @@
+"""Monitoring/adaptation tests: counters, /metrics, latencies, MST,
+set_tree, interference (reference test_tensorflow_throughput_monitoring.py
+/ test_set_tree.py analogs)."""
+
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kungfu_tpu.monitor.metrics import MetricsServer, NetMonitor
+from kungfu_tpu.plan.mst import minimum_spanning_tree
+
+
+class TestNetMonitor:
+    def test_counters_and_rates(self):
+        m = NetMonitor(period=0.1).start()
+        try:
+            for _ in range(10):
+                m.egress("a:1", 1000)
+                m.ingress("b:2", 500)
+            time.sleep(0.3)
+            totals = m.totals()
+            assert totals["egress"]["a:1"] == 10000
+            assert totals["ingress"]["b:2"] == 5000
+            assert m.egress_rates(["a:1"])[0] >= 0
+            assert m.egress_rates(["missing:9"]) == [0.0]
+        finally:
+            m.stop()
+
+    def test_metrics_endpoint(self):
+        m = NetMonitor(period=0.1).start()
+        s = MetricsServer(m, port=28123).start()
+        try:
+            m.egress("peer:1", 2048)
+            with urllib.request.urlopen("http://127.0.0.1:28123/metrics", timeout=5) as r:
+                text = r.read().decode()
+            assert 'kf_egress_bytes_total{peer="peer:1"} 2048' in text
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen("http://127.0.0.1:28123/nope", timeout=5)
+        finally:
+            s.stop()
+            m.stop()
+
+
+class TestMST:
+    def test_chain(self):
+        # latencies force a chain 0-1-2
+        w = np.array([[0, 1, 10], [1, 0, 1], [10, 1, 0]], float)
+        f = minimum_spanning_tree(w)
+        assert f[0] == 0 and f[1] == 0 and f[2] == 1
+
+    def test_star(self):
+        w = np.array([[0, 1, 1, 1], [1, 0, 9, 9], [1, 9, 0, 9], [1, 9, 9, 0]], float)
+        assert minimum_spanning_tree(w) == [0, 0, 0, 0]
+
+    def test_asymmetric_symmetrized(self):
+        w = np.array([[0, 2], [4, 0]], float)
+        assert minimum_spanning_tree(w) == [0, 0]
+
+    def test_bad_shape(self):
+        with pytest.raises(ValueError):
+            minimum_spanning_tree(np.zeros((2, 3)))
+
+
+class TestAdaptIntegration:
+    @pytest.fixture
+    def peers(self):
+        from kungfu_tpu.peer import Peer
+        from kungfu_tpu.plan import Cluster, PeerList
+        from kungfu_tpu.utils.envs import Config
+
+        workers = PeerList.parse("127.0.0.1:27301,127.0.0.1:27302,127.0.0.1:27303")
+        runners = PeerList.parse("127.0.0.1:38087")
+        cluster = Cluster(runners, workers)
+        ps = [Peer(Config(self_id=w, cluster=cluster)) for w in workers]
+        for p in ps:
+            p.start()
+        yield ps
+        for p in ps:
+            p.close()
+
+    def run_all(self, fns, timeout=60):
+        errs, results = [], [None] * len(fns)
+
+        def wrap(i, f):
+            try:
+                results[i] = f()
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=wrap, args=(i, f)) for i, f in enumerate(fns)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=timeout)
+        if errs:
+            raise errs[0]
+        return results
+
+    def test_latencies(self, peers):
+        lats = peers[0].get_peer_latencies()
+        assert len(lats) == 3
+        assert lats[0] == 0.0  # self
+        assert lats[1] > 0 and lats[2] > 0
+
+    def test_latency_matrix_and_mst(self, peers):
+        from kungfu_tpu.monitor.adapt import latency_matrix
+
+        mats = self.run_all([lambda p=p: latency_matrix(p) for p in peers])
+        for m in mats:
+            assert m.shape == (3, 3)
+        f = minimum_spanning_tree(mats[0])
+        assert len(f) == 3 and f[0] == 0
+
+    def test_set_tree_then_allreduce(self, peers):
+        chain = [0, 0, 1]  # explicit chain topology
+
+        def one(p, val):
+            p.set_tree(chain)
+            out = p.engine().all_reduce(np.full(4, val, np.float32))
+            return out
+
+        outs = self.run_all([lambda p=p, v=v: one(p, float(v)) for v, p in enumerate(peers)])
+        for o in outs:
+            np.testing.assert_allclose(o, np.full(4, 3.0))  # 0+1+2
+
+    def test_interference_vote(self, peers):
+        # no throughput data -> no interference
+        outs = self.run_all([lambda p=p: p.check_interference() for p in peers])
+        assert outs == [False, False, False]
+
+    def test_egress_rates_with_monitoring(self):
+        import os
+
+        from kungfu_tpu.peer import Peer
+        from kungfu_tpu.plan import Cluster, PeerList
+        from kungfu_tpu.utils.envs import Config
+
+        os.environ["KF_CONFIG_ENABLE_MONITORING"] = "true"
+        try:
+            workers = PeerList.parse("127.0.0.1:27311,127.0.0.1:27312")
+            cluster = Cluster(PeerList.parse("127.0.0.1:38088"), workers)
+            ps = [Peer(Config(self_id=w, cluster=cluster)) for w in workers]
+            for p in ps:
+                p.start()
+            try:
+                engines = [p.engine() for p in ps]
+                data = np.ones(1000, np.float32)
+                self.run_all([lambda e=e: e.all_reduce(data) for e in engines])
+                totals = ps[0].net_monitor.totals()
+                assert sum(totals["egress"].values()) > 0
+                assert len(ps[0].get_egress_rates()) == 2
+                # /metrics endpoint is live at port+10000
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{27311 + 10000}/metrics", timeout=5
+                ) as r:
+                    assert b"kf_egress_bytes_total" in r.read()
+            finally:
+                for p in ps:
+                    p.close()
+        finally:
+            os.environ.pop("KF_CONFIG_ENABLE_MONITORING", None)
