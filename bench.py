@@ -1,0 +1,106 @@
+#!/usr/bin/env python3
+"""Headline benchmark — prints ONE JSON line for the driver.
+
+Metric (per BASELINE.json): ResNet-50 training throughput in images/sec on
+the available chip, via the framework's synchronous-SGD path (the analog of
+reference ``benchmarks/system/benchmark_kungfu.py --kf-optimizer=sync-sgd
+--model=ResNet50 --batch-size=64``).
+
+``vs_baseline`` compares against the reference's per-worker target — NCCL
+on 8x V100 ResNet-50 synchronous throughput, ~360 images/sec/GPU (the
+per-worker rate behind reference README.md:201-213's 16xV100 scalability
+plot; see BASELINE.md).
+
+Runs single-process on whatever backend JAX has (one real TPU chip under
+the driver; CPU locally).  Use --quick for a reduced-shape smoke run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+BASELINE_IMG_PER_SEC_PER_WORKER = 360.0
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--image-size", type=int, default=None)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--quick", action="store_true")
+    args = p.parse_args()
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    # CPU fallback keeps the harness runnable anywhere; the recorded number
+    # is only meaningful on TPU.
+    batch = args.batch_size or (64 if on_tpu else 8)
+    img = args.image_size or (224 if on_tpu else 64)
+    if args.quick:
+        batch, img, args.steps = 8, 64, 5
+
+    from kungfu_tpu.models.resnet import ResNet
+    from kungfu_tpu.optimizers import synchronous_sgd  # noqa: F401 (API parity)
+
+    model = ResNet(50, num_classes=1000)
+    params, bn_state = model.init(jax.random.PRNGKey(0))
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = tx.init(params)
+
+    def loss_fn(params, bn_state, images, labels):
+        logits, new_state = model.apply(params, bn_state, images, train=True)
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+        return nll, new_state
+
+    @jax.jit
+    def train_step(params, bn_state, opt_state, images, labels):
+        (loss, new_bn), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, bn_state, images, labels
+        )
+        updates, new_opt = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_bn, new_opt, loss
+
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(
+        rng.standard_normal((batch, img, img, 3), dtype=np.float32), dtype=jnp.bfloat16
+    )
+    labels = jnp.asarray(rng.integers(0, 1000, size=(batch,)), dtype=jnp.int32)
+
+    for _ in range(args.warmup):
+        params, bn_state, opt_state, loss = train_step(
+            params, bn_state, opt_state, images, labels
+        )
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        params, bn_state, opt_state, loss = train_step(
+            params, bn_state, opt_state, images, labels
+        )
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = batch * args.steps / dt
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_images_per_sec_per_chip",
+                "value": round(img_per_sec, 2),
+                "unit": "images/sec",
+                "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC_PER_WORKER, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

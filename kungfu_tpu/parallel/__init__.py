@@ -1,0 +1,37 @@
+"""Model parallelism over the TPU mesh: dp / pp / sp / tp (+ ep over dp).
+
+This subsystem goes beyond the reference's data-parallel-only scope
+(SURVEY §2.4) — it is the TPU-first answer to "the same scale": tensor
+parallelism, pipeline parallelism, sequence/context parallelism with ring
+attention, and expert parallelism, all composed in a single
+``shard_map``-compiled training step.
+"""
+
+from kungfu_tpu.parallel.mesh import AXES, AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP, MeshPlan
+from kungfu_tpu.parallel.moe import moe_apply, moe_init
+from kungfu_tpu.parallel.ring import make_ring_attn, ring_attention
+from kungfu_tpu.parallel.tp import (
+    column_dense,
+    row_dense,
+    tp_region_enter,
+    tp_region_exit,
+)
+from kungfu_tpu.parallel.train import ShardedTrainer
+
+__all__ = [
+    "AXES",
+    "AXIS_DP",
+    "AXIS_PP",
+    "AXIS_SP",
+    "AXIS_TP",
+    "MeshPlan",
+    "ShardedTrainer",
+    "column_dense",
+    "row_dense",
+    "make_ring_attn",
+    "moe_apply",
+    "moe_init",
+    "ring_attention",
+    "tp_region_enter",
+    "tp_region_exit",
+]

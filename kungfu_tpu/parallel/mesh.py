@@ -1,0 +1,87 @@
+"""Mesh plans: named parallel axes over the TPU device grid.
+
+This is where the framework goes past the reference's capability set
+(reference is data-parallel only, SURVEY §2.4): a :class:`MeshPlan`
+factorizes the device count into four named axes —
+
+* ``dp``  — data parallelism (gradient psum; the reference's allreduce axis).
+  Expert parallelism rides this axis: MoE expert shards live one-per-dp-rank
+  and tokens move via ``all_to_all`` over it (``ep`` is an alias of ``dp``).
+* ``pp``  — pipeline parallelism (layer stages; activations flow stage to
+  stage via ``ppermute``).
+* ``sp``  — sequence/context parallelism (activations sharded on the
+  sequence dim; ring attention rotates K/V blocks over this axis).
+* ``tp``  — tensor parallelism (Megatron-style column/row sharded matmuls
+  with paired fwd/bwd psums).
+
+On hardware, axis order maps onto the ICI torus: the innermost axes (tp,
+sp) carry the most frequent/latency-sensitive collectives, so they should
+map to the shortest ICI rings; dp is outermost (one psum per step, can ride
+DCN across slices).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DP = "dp"
+AXIS_PP = "pp"
+AXIS_SP = "sp"
+AXIS_TP = "tp"
+AXES = (AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP)
+
+
+def _prime_factors(n: int):
+    out, p = [], 2
+    while p * p <= n:
+        while n % p == 0:
+            out.append(p)
+            n //= p
+        p += 1
+    if n > 1:
+        out.append(n)
+    return out
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    """Static factorization of the device count into parallel axes."""
+
+    dp: int = 1
+    pp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.pp * self.sp * self.tp
+
+    @property
+    def ep(self) -> int:
+        """Expert parallelism degree (MoE experts are sharded over dp)."""
+        return self.dp
+
+    @classmethod
+    def auto(cls, n_devices: int) -> "MeshPlan":
+        """Spread prime factors round-robin over (dp, tp, sp, pp) — largest
+        factors first so e.g. 8 → dp=2, tp=2, sp=2 and 16 adds pp=2."""
+        sizes = {AXIS_DP: 1, AXIS_TP: 1, AXIS_SP: 1, AXIS_PP: 1}
+        order = (AXIS_DP, AXIS_TP, AXIS_SP, AXIS_PP)
+        for i, f in enumerate(sorted(_prime_factors(n_devices), reverse=True)):
+            sizes[order[i % len(order)]] *= f
+        return cls(dp=sizes[AXIS_DP], pp=sizes[AXIS_PP], sp=sizes[AXIS_SP], tp=sizes[AXIS_TP])
+
+    def build_mesh(self, devices: Optional[Sequence] = None) -> Mesh:
+        devs = list(devices) if devices is not None else list(jax.devices())
+        if len(devs) < self.size:
+            raise ValueError(f"plan needs {self.size} devices, have {len(devs)}")
+        grid = np.asarray(devs[: self.size]).reshape(self.dp, self.pp, self.sp, self.tp)
+        return Mesh(grid, AXES)
+
+    def __str__(self):
+        return f"MeshPlan(dp={self.dp}, pp={self.pp}, sp={self.sp}, tp={self.tp})"

@@ -1,0 +1,91 @@
+"""Ring attention: exact attention over a sequence-sharded mesh axis.
+
+Long-context support the TPU way (the reference has no attention at all,
+SURVEY §5.7; this is the framework's sequence/context-parallel subsystem):
+Q stays local, K/V blocks rotate around the ``sp`` ring via
+``lax.ppermute`` while a streaming (online-softmax) accumulator folds each
+block in — memory per device is O(S/sp), traffic rides the ICI ring, and
+compute/communication overlap is XLA's job (each round's matmul hides the
+next block's permute).
+
+Differentiable: the backward pass is autodiff through the scan — ppermute
+transposes to the inverse rotation, so cotangents counter-rotate around the
+same ring (this *is* the ring-attention backward schedule).
+
+Must run inside ``shard_map`` with ``axis`` a live mesh axis name; with
+``sp == 1`` it degenerates to one masked flash-style block and is the
+single-device attention path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def ring_attention(q, k, v, causal: bool = True, axis: str = "sp"):
+    """q, k, v: [B, H, S_local, D] (sequence axis sharded over ``axis``).
+
+    Returns [B, H, S_local, D] — the exact softmax attention output as if
+    the full sequence were on one device.
+    """
+    n_sp = jax.lax.axis_size(axis)
+    my_blk = jax.lax.axis_index(axis)
+    B, H, S, D = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    qf = q.astype(jnp.float32) * scale
+
+    q_pos = my_blk * S + jnp.arange(S)  # global positions of local queries
+
+    def fold(carry, _):
+        kv, blk, m, l, acc = carry
+        kb, vb = kv
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qf, kb.astype(jnp.float32))
+        if causal:
+            k_pos = blk * S + jnp.arange(S)
+            mask = q_pos[:, None] >= k_pos[None, :]
+        else:
+            mask = jnp.ones((S, S), dtype=bool)
+        m_new = jnp.maximum(m, jnp.max(jnp.where(mask, logits, -jnp.inf), axis=-1))
+        # clamp so fully-masked rounds (future blocks under causal) keep
+        # m finite and contribute exactly zero
+        m_new = jnp.maximum(m_new, -1e30)
+        p = jnp.where(mask, jnp.exp(logits - m_new[..., None]), 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vb.astype(jnp.float32)
+        )
+        # rotate K/V: receive the next block from the ring neighbour
+        perm = [((j + 1) % n_sp, j) for j in range(n_sp)]
+        kv = jax.tree_util.tree_map(
+            lambda t: jax.lax.ppermute(t, axis, perm), (kb, vb)
+        )
+        return (kv, (blk + 1) % n_sp, m_new, l, acc), None
+
+    def vary(x):
+        # mark the accumulators as varying over the ring axis so the scan
+        # carry type matches (jax>=0.9 varying-manual-axes typing)
+        return jax.lax.pcast(x, (axis,), to="varying")
+
+    m0 = vary(jnp.full((B, H, S), -jnp.inf, jnp.float32))
+    l0 = vary(jnp.zeros((B, H, S), jnp.float32))
+    acc0 = vary(jnp.zeros((B, H, S, D), jnp.float32))
+    (_, _, _, l, acc), _ = jax.lax.scan(
+        fold, ((k, v), my_blk, m0, l0, acc0), None, length=n_sp
+    )
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def make_ring_attn(axis: str = "sp"):
+    """Adapter matching the ``attn_fn(q, k, v, causal)`` slot of
+    :meth:`kungfu_tpu.models.transformer.Transformer.apply`."""
+
+    def attn(q, k, v, causal):
+        return ring_attention(q, k, v, causal=causal, axis=axis)
+
+    return attn

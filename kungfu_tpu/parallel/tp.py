@@ -1,0 +1,75 @@
+"""Tensor parallelism: Megatron-style column/row sharded matmuls.
+
+Activations are replicated over the ``tp`` axis; weights are sharded on
+one contraction side.  Correct gradients with replicated-activation compute
+require the classic paired pseudo-collectives (Megatron's *f*/*g*):
+
+* :func:`tp_region_enter` — identity forward, **psum backward** — placed
+  where a replicated activation enters a tp-sharded block, so the partial
+  cotangents each tp rank produces are summed back into the full gradient;
+* :func:`tp_region_exit` — **psum forward**, identity backward — the
+  row-parallel output reduction (each rank holds a partial product).
+
+Under jit these lower to single ICI all-reduces on the tp ring (the analog
+of the reference's intra-host "local" collectives, session/strategy.go).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_region_enter(x, axis: str):
+    return x
+
+
+def _enter_fwd(x, axis):
+    return x, None
+
+
+def _enter_bwd(axis, _, g):
+    return (jax.lax.psum(g, axis),)
+
+
+tp_region_enter.defvjp(_enter_fwd, _enter_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_region_exit(x, axis: str):
+    return jax.lax.psum(x, axis)
+
+
+def _exit_fwd(x, axis):
+    return jax.lax.psum(x, axis), None
+
+
+def _exit_bwd(axis, _, g):
+    return (g,)
+
+
+tp_region_exit.defvjp(_exit_fwd, _exit_bwd)
+
+
+def column_dense(p, x, dtype=None):
+    """x @ w_shard — weight sharded on the OUTPUT dim; result is the local
+    feature shard.  ``p = {"w": [in, out/tp], "b": [out/tp]?}``."""
+    w = p["w"].astype(dtype) if dtype else p["w"]
+    y = x @ w
+    if "b" in p:
+        y = y + (p["b"].astype(dtype) if dtype else p["b"])
+    return y
+
+
+def row_dense(p, x, axis: str, dtype=None):
+    """x_shard @ w_shard with psum — weight sharded on the INPUT dim, input
+    is the local feature shard, output is fully reduced & replicated.
+    Bias is replicated and added once, after the reduction."""
+    w = p["w"].astype(dtype) if dtype else p["w"]
+    y = tp_region_exit(x @ w, axis)
+    if "b" in p:
+        y = y + (p["b"].astype(dtype) if dtype else p["b"])
+    return y

@@ -1,0 +1,384 @@
+"""The 4-D sharded training step: dp x pp x sp x tp (+ EP over dp).
+
+One ``shard_map`` over the :class:`~kungfu_tpu.parallel.mesh.MeshPlan`
+mesh computes per-device gradients with every cross-device flow explicit:
+
+* **dp** — gradient psum (the reference's allreduce, done as one XLA
+  collective instead of the Go graph engine);
+* **pp** — GPipe-style microbatch pipeline: a ``lax.scan`` over
+  ``n_micro + pp - 1`` ticks, activations hopping stages via ``ppermute``
+  (autodiff reverses the hops, giving the backward pipeline for free);
+* **sp** — sequence sharding with ring attention
+  (:mod:`kungfu_tpu.parallel.ring`);
+* **tp** — Megatron column/row matmuls (:mod:`kungfu_tpu.parallel.tp`);
+* **ep=dp** — optional switch-MoE FFNs with ``all_to_all`` token exchange
+  (:mod:`kungfu_tpu.parallel.moe`).
+
+Gradient synchronization is explicit and per-parameter-kind (see
+:func:`sync_grads`): autodiff inside ``shard_map`` yields each rank's
+d(own loss term)/d(own shard); collective transposes (ppermute, all_to_all,
+and the tp custom-vjp pair) already route *sharded*-param flows, while
+*replicated* params need the trailing psum — exactly the split the
+reference handles with its group allreduce after local backprop
+(``sync_sgd.py:58-109``), generalized to four axes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kungfu_tpu.models import nn
+from kungfu_tpu.models.transformer import TransformerConfig, _rope
+from kungfu_tpu.parallel import tp as tpmod
+from kungfu_tpu.parallel.mesh import AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP, MeshPlan
+from kungfu_tpu.parallel.moe import moe_apply
+from kungfu_tpu.parallel.ring import ring_attention
+
+MOE_AUX_COEF = 0.01
+
+# parameter kinds → (psum axes, replication denominator axes)
+_KIND_AXES = {
+    # embed / ln_f / head: replicated everywhere; grads live on one pp stage
+    "replicated": ((AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP), (AXIS_DP, AXIS_SP, AXIS_TP)),
+    # per-layer params replicated over dp/sp/tp (layernorms, gate)
+    "dense_layer": ((AXIS_DP, AXIS_SP, AXIS_TP), (AXIS_DP, AXIS_SP, AXIS_TP)),
+    # tp-sharded weights: tp flows handled by the custom-vjp pair
+    "tp_sharded": ((AXIS_DP, AXIS_SP), (AXIS_DP, AXIS_SP)),
+    # expert weights: dp flows handled by all_to_all transpose
+    "expert": ((AXIS_SP, AXIS_TP), (AXIS_DP, AXIS_SP, AXIS_TP)),
+}
+
+
+def _axis_prod(plan: MeshPlan, axes) -> int:
+    sizes = {AXIS_DP: plan.dp, AXIS_PP: plan.pp, AXIS_SP: plan.sp, AXIS_TP: plan.tp}
+    out = 1
+    for a in axes:
+        out *= sizes[a]
+    return out
+
+
+class ShardedTrainer:
+    """Owns the mesh, the sharded parameter layout, and the jitted step."""
+
+    def __init__(
+        self,
+        cfg: TransformerConfig,
+        plan: MeshPlan,
+        n_experts: int = 0,
+        n_micro: Optional[int] = None,
+        tx: Optional[optax.GradientTransformation] = None,
+        devices=None,
+        capacity_factor: float = 1.25,
+    ):
+        if cfg.pos != "rope":
+            raise NotImplementedError("sharded trainer supports rope positions")
+        if cfg.n_layers % plan.pp:
+            raise ValueError(f"n_layers {cfg.n_layers} % pp {plan.pp} != 0")
+        if cfg.n_heads % plan.tp:
+            raise ValueError(f"n_heads {cfg.n_heads} % tp {plan.tp} != 0")
+        if cfg.d_ff % plan.tp:
+            raise ValueError(f"d_ff {cfg.d_ff} % tp {plan.tp} != 0")
+        if n_experts and n_experts % plan.ep:
+            raise ValueError(f"n_experts {n_experts} % ep {plan.ep} != 0")
+        self.cfg = cfg
+        self.plan = plan
+        self.n_experts = n_experts
+        self.capacity_factor = capacity_factor
+        self.n_micro = n_micro or plan.pp
+        self.tx = tx or optax.sgd(0.01)
+        self.mesh = plan.build_mesh(devices)
+        self.param_specs, self.param_kinds = self._layout()
+        self._step_fn = None
+
+    # -- parameter layout -------------------------------------------------
+    def _layout(self):
+        """(PartitionSpec tree, kind tree) for the stacked param pytree."""
+        cfg, moe = self.cfg, self.n_experts > 0
+
+        def dup(spec_kind):
+            return spec_kind
+
+        layer = {
+            "ln1": {"scale": (P(AXIS_PP, None), "dense_layer"),
+                    "bias": (P(AXIS_PP, None), "dense_layer")},
+            "ln2": {"scale": (P(AXIS_PP, None), "dense_layer"),
+                    "bias": (P(AXIS_PP, None), "dense_layer")},
+            "wq": {"w": (P(AXIS_PP, None, AXIS_TP), "tp_sharded"),
+                   "b": (P(AXIS_PP, AXIS_TP), "tp_sharded")},
+            "wk": {"w": (P(AXIS_PP, None, AXIS_TP), "tp_sharded"),
+                   "b": (P(AXIS_PP, AXIS_TP), "tp_sharded")},
+            "wv": {"w": (P(AXIS_PP, None, AXIS_TP), "tp_sharded"),
+                   "b": (P(AXIS_PP, AXIS_TP), "tp_sharded")},
+            "wo": {"w": (P(AXIS_PP, AXIS_TP, None), "tp_sharded"),
+                   "b": (P(AXIS_PP, None), "dense_layer")},
+        }
+        if moe:
+            layer["gate"] = {"w": (P(AXIS_PP, None, None), "dense_layer")}
+            layer["w_in"] = (P(AXIS_PP, AXIS_DP, None, None), "expert")
+            layer["w_out"] = (P(AXIS_PP, AXIS_DP, None, None), "expert")
+        else:
+            layer["ffn_in"] = {"w": (P(AXIS_PP, None, AXIS_TP), "tp_sharded"),
+                               "b": (P(AXIS_PP, AXIS_TP), "tp_sharded")}
+            layer["ffn_out"] = {"w": (P(AXIS_PP, AXIS_TP, None), "tp_sharded"),
+                                "b": (P(AXIS_PP, None), "dense_layer")}
+        tree = {
+            "embed": {"table": (P(None, None), "replicated")},
+            "layers": layer,
+            "ln_f": {"scale": (P(None), "replicated"), "bias": (P(None), "replicated")},
+            "head": {"w": (P(None, None), "replicated")},
+        }
+        is_leaf = lambda x: isinstance(x, tuple) and len(x) == 2 and isinstance(x[1], str)
+        specs = jax.tree_util.tree_map(lambda t: t[0], tree, is_leaf=is_leaf)
+        kinds = jax.tree_util.tree_map(lambda t: t[1], tree, is_leaf=is_leaf)
+        return specs, kinds
+
+    # -- init --------------------------------------------------------------
+    def init(self, key) -> Dict[str, Any]:
+        cfg = self.cfg
+        params: Dict[str, Any] = {}
+        key, k = jax.random.split(key)
+        params["embed"] = nn.embedding_init(k, cfg.vocab_size, cfg.d_model)
+        per_layer = []
+        for _ in range(cfg.n_layers):
+            key, *ks = jax.random.split(key, 8)
+            lp = {
+                "ln1": nn.layernorm_init(cfg.d_model),
+                "wq": nn.dense_init(ks[0], cfg.d_model, cfg.d_model),
+                "wk": nn.dense_init(ks[1], cfg.d_model, cfg.d_model),
+                "wv": nn.dense_init(ks[2], cfg.d_model, cfg.d_model),
+                "wo": nn.dense_init(ks[3], cfg.d_model, cfg.d_model),
+                "ln2": nn.layernorm_init(cfg.d_model),
+            }
+            if self.n_experts:
+                lp["gate"] = {"w": nn.normal(ks[4], (cfg.d_model, self.n_experts))}
+                lp["w_in"] = nn.glorot_uniform(ks[5], (self.n_experts, cfg.d_model, cfg.d_ff))
+                lp["w_out"] = nn.glorot_uniform(ks[6], (self.n_experts, cfg.d_ff, cfg.d_model))
+            else:
+                lp["ffn_in"] = nn.dense_init(ks[4], cfg.d_model, cfg.d_ff)
+                lp["ffn_out"] = nn.dense_init(ks[5], cfg.d_ff, cfg.d_model)
+            per_layer.append(lp)
+        params["layers"] = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *per_layer
+        )
+        params["ln_f"] = nn.layernorm_init(cfg.d_model)
+        key, k = jax.random.split(key)
+        params["head"] = nn.dense_init(k, cfg.d_model, cfg.vocab_size, use_bias=False)
+        params = self.shard_params(params)
+        opt_state = self.tx.init(params)
+        return {"params": params, "opt_state": opt_state, "step": jnp.zeros((), jnp.int32)}
+
+    def shard_params(self, params):
+        """Place a (replicated/host) param pytree onto the mesh layout."""
+        return jax.tree_util.tree_map(
+            lambda x, spec: jax.device_put(x, NamedSharding(self.mesh, spec)),
+            params,
+            self.param_specs,
+        )
+
+    def from_transformer_params(self, tparams):
+        """Pack per-layer ``Transformer.init`` params (dense FFN only) into
+        the stacked sharded layout — used to cross-check against the
+        unsharded model."""
+        assert not self.n_experts
+        L = self.cfg.n_layers
+        stacked = {
+            "embed": tparams["embed"],
+            "layers": jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *[tparams[f"layer_{i}"] for i in range(L)]
+            ),
+            "ln_f": tparams["ln_f"],
+            "head": tparams["head"],
+        }
+        return self.shard_params(stacked)
+
+    # -- the per-device math ----------------------------------------------
+    def _block(self, lp, h, positions):
+        """One transformer layer on local shards.  h: [B_mb, S_loc, D]
+        replicated over tp; returns (h', aux)."""
+        cfg, plan = self.cfg, self.plan
+        dt = cfg.compute_dtype
+        H_loc = cfg.n_heads // plan.tp
+
+        x = nn.layernorm_apply(lp["ln1"], h)
+        x = tpmod.tp_region_enter(x, AXIS_TP)
+        q = tpmod.column_dense(lp["wq"], x, dtype=dt)
+        k = tpmod.column_dense(lp["wk"], x, dtype=dt)
+        v = tpmod.column_dense(lp["wv"], x, dtype=dt)
+
+        def heads(t):
+            B, S, _ = t.shape
+            return t.reshape(B, S, H_loc, cfg.head_dim).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        q, k = _rope(q, k, positions)
+        o = ring_attention(q, k, v, causal=cfg.causal, axis=AXIS_SP)
+        B, _, S, _ = o.shape
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, H_loc * cfg.head_dim)
+        h = h + tpmod.row_dense(lp["wo"], o, AXIS_TP, dtype=dt)
+
+        x = nn.layernorm_apply(lp["ln2"], h)
+        if self.n_experts:
+            y, aux = moe_apply(
+                {"gate": lp["gate"], "w_in": lp["w_in"], "w_out": lp["w_out"]},
+                x,
+                axis=AXIS_DP if plan.ep > 1 else None,
+                n_experts_global=self.n_experts,
+                capacity_factor=self.capacity_factor,
+                dtype=dt,
+            )
+        else:
+            x = tpmod.tp_region_enter(x, AXIS_TP)
+            y = nn.gelu(tpmod.column_dense(lp["ffn_in"], x, dtype=dt))
+            y = tpmod.row_dense(lp["ffn_out"], y, AXIS_TP, dtype=dt)
+            aux = jnp.zeros((), jnp.float32)
+        return h + y, aux
+
+    def _local_loss(self, lparams, ids, targets):
+        """Per-device loss term.  ids/targets: [B_loc, S_loc] local shards.
+        Returns (own_term, nll_for_report, aux_for_report)."""
+        cfg, plan = self.cfg, self.plan
+        n_micro = self.n_micro
+        Pp = plan.pp
+        B_loc, S_loc = ids.shape
+        assert B_loc % n_micro == 0, (B_loc, n_micro)
+        B_mb = B_loc // n_micro
+
+        sp_idx = jax.lax.axis_index(AXIS_SP)
+        pp_idx = jax.lax.axis_index(AXIS_PP)
+        pos = sp_idx * S_loc + jnp.arange(S_loc)
+        positions = jnp.broadcast_to(pos, (B_mb, S_loc))
+
+        ids_mb = ids.reshape(n_micro, B_mb, S_loc)
+        tgt_mb = targets.reshape(n_micro, B_mb, S_loc)
+        h0 = nn.embedding_apply(lparams["embed"], ids_mb, dtype=cfg.compute_dtype)
+
+        T = n_micro + Pp - 1
+        if T > n_micro:
+            pad = jnp.zeros((Pp - 1,) + h0.shape[1:], h0.dtype)
+            h0 = jnp.concatenate([h0, pad], axis=0)
+
+        def stage_fn(x):
+            def layer_step(h, lp):
+                h2, aux = self._block(lp, h, positions)
+                return h2, aux
+
+            h, auxs = jax.lax.scan(layer_step, x, lparams["layers"])
+            return h, jnp.sum(auxs)
+
+        perm = [(j, j + 1) for j in range(Pp - 1)]
+
+        def tick(buf, x_t):
+            inp = jnp.where(pp_idx == 0, x_t, buf)
+            out, aux = stage_fn(inp)
+            nxt = jax.lax.ppermute(out, AXIS_PP, perm) if Pp > 1 else out
+            return nxt, (out, aux)
+
+        buf0 = jnp.zeros(h0.shape[1:], h0.dtype)
+        _, (outs, auxs) = jax.lax.scan(tick, buf0, h0)
+
+        # microbatch m leaves the last stage at tick m + Pp - 1
+        valid_outs = outs[Pp - 1 : Pp - 1 + n_micro]
+        hf = nn.layernorm_apply(lparams["ln_f"], valid_outs)
+        logits = nn.dense_apply(lparams["head"], hf).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(logp, tgt_mb[..., None], axis=-1).mean()
+        nll_term = jnp.where(pp_idx == Pp - 1, nll, 0.0)
+
+        # aux from ticks where this stage processed a real microbatch
+        t_idx = jnp.arange(T)
+        valid = (t_idx >= pp_idx) & (t_idx < pp_idx + n_micro)
+        aux_term = jnp.sum(auxs * valid) / n_micro
+
+        own = nll_term + MOE_AUX_COEF * aux_term
+        return own, (nll_term, aux_term)
+
+    def sync_grads(self, grads):
+        plan = self.plan
+
+        def f(g, kind):
+            axes, denom_axes = _KIND_AXES[kind]
+            return jax.lax.psum(g, axes) / _axis_prod(plan, denom_axes)
+
+        return jax.tree_util.tree_map(f, grads, self.param_kinds)
+
+    # -- jitted step -------------------------------------------------------
+    def _build_step(self):
+        plan = self.plan
+        pspecs = self.param_specs
+        batch_spec = P(AXIS_DP, AXIS_SP)
+
+        def per_device(lparams, ids, targets):
+            grad_fn = jax.value_and_grad(self._local_loss, has_aux=True)
+            (own, (nll, aux)), grads = grad_fn(lparams, ids, targets)
+            grads = self.sync_grads(grads)
+            # report: gather the stage-masked terms into global means
+            nll = jax.lax.pmean(
+                jax.lax.psum(nll, AXIS_PP), (AXIS_DP, AXIS_SP, AXIS_TP)
+            )
+            aux = jax.lax.pmean(
+                jax.lax.psum(aux, AXIS_PP), (AXIS_DP, AXIS_SP, AXIS_TP)
+            )
+            return grads, nll, aux
+
+        sharded = shard_map(
+            per_device,
+            mesh=self.mesh,
+            in_specs=(pspecs, batch_spec, batch_spec),
+            out_specs=(pspecs, P(), P()),
+            check_vma=False,
+        )
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(state, batch):
+            ids, targets = batch
+            grads, nll, aux = sharded(state["params"], ids, targets)
+            updates, opt_state = self.tx.update(grads, state["opt_state"], state["params"])
+            params = optax.apply_updates(state["params"], updates)
+            return (
+                {"params": params, "opt_state": opt_state, "step": state["step"] + 1},
+                nll + MOE_AUX_COEF * aux,
+            )
+
+        return step
+
+    def step(self, state, batch) -> Tuple[Dict[str, Any], jnp.ndarray]:
+        """One full training step; batch = (ids, targets) global [B, S]."""
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        ids, targets = batch
+        bspec = NamedSharding(self.mesh, P(AXIS_DP, AXIS_SP))
+        ids = jax.device_put(jnp.asarray(ids), bspec)
+        targets = jax.device_put(jnp.asarray(targets), bspec)
+        return self._step_fn(state, (ids, targets))
+
+    # -- losses without update (for tests) ---------------------------------
+    def loss(self, state, batch) -> jnp.ndarray:
+        """Global loss (nll + aux) without updating — test/eval helper."""
+        pspecs = self.param_specs
+
+        def per_device(lparams, ids, targets):
+            _, (nll, aux) = self._local_loss(lparams, ids, targets)
+            nll = jax.lax.pmean(jax.lax.psum(nll, AXIS_PP), (AXIS_DP, AXIS_SP, AXIS_TP))
+            aux = jax.lax.pmean(jax.lax.psum(aux, AXIS_PP), (AXIS_DP, AXIS_SP, AXIS_TP))
+            return nll + MOE_AUX_COEF * aux
+
+        f = shard_map(
+            per_device,
+            mesh=self.mesh,
+            in_specs=(pspecs, P(AXIS_DP, AXIS_SP), P(AXIS_DP, AXIS_SP)),
+            out_specs=P(),
+            check_vma=False,
+        )
+        ids, targets = batch
+        bspec = NamedSharding(self.mesh, P(AXIS_DP, AXIS_SP))
+        ids = jax.device_put(jnp.asarray(ids), bspec)
+        targets = jax.device_put(jnp.asarray(targets), bspec)
+        return jax.jit(f)(state["params"], ids, targets)
