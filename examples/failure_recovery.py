@@ -52,6 +52,7 @@ def main():
 
     peer = kf.init()
     rank, size = kf.current_rank(), kf.cluster_size()
+    engine = peer.engine()
     model = mnist_slp()
     params = model.init(jax.random.PRNGKey(7))
 
@@ -68,8 +69,15 @@ def main():
             params, _, meta = got
             start_epoch = int(meta.get("epochs_done", 0))
             print(f"worker {rank}: restarted from epoch {start_epoch}", flush=True)
-    else:
-        params = broadcast_parameters(peer=peer, params=params)
+        # only rank 0 writes checkpoints, and ckpt_dir may not be shared
+        # across hosts — re-sync both the restored params and the resume
+        # epoch from rank 0 so ranks without a local checkpoint don't
+        # silently continue from fresh-init weights
+        if engine is not None:
+            start_epoch = int(
+                engine.broadcast(np.array([start_epoch], np.int64))[0]
+            )
+    params = broadcast_parameters(peer=peer, params=params)
 
     x, y = synthetic_mnist()
     shard = np.arange(len(x)) % size == rank
@@ -77,7 +85,6 @@ def main():
     loss_grad = jax.jit(jax.value_and_grad(model.loss))
     opt = optax.sgd(args.lr)
     opt_state = opt.init(params)
-    engine = peer.engine()
 
     steps = len(x) // args.batch_size
     for epoch in range(args.n_epochs):

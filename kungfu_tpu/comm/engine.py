@@ -100,6 +100,7 @@ class CollectiveEngine:
         self._graphs = build_strategy_graphs(strategy, peers)
         self._seq = 0
         self._lock = threading.Lock()
+        self._stats_lock = threading.Lock()  # guards stats/_window swaps
         from concurrent.futures import ThreadPoolExecutor
 
         self._pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="kf-engine")
@@ -112,9 +113,13 @@ class CollectiveEngine:
         self.best_throughputs = [0.0 for _ in self._graphs]
 
     # -- public collectives ----------------------------------------------
-    def all_reduce(self, x: np.ndarray, op: str = "sum", name: str = "") -> np.ndarray:
+    def all_reduce(
+        self, x: np.ndarray, op: str = "sum", name: str = "", record: bool = True
+    ) -> np.ndarray:
         """Chunked graph allreduce (reference ``allreduce.go:11`` +
-        ``runStrategies``)."""
+        ``runStrategies``).  ``record=False`` keeps control-plane traffic
+        (e.g. interference votes) out of the throughput window so the
+        adaptation signal only sees data-plane transfers."""
         if op not in _REDUCERS and op != "mean":
             raise ValueError(f"op {op!r}")
         eff_op = "sum" if op == "mean" else op
@@ -138,12 +143,14 @@ class CollectiveEngine:
                 errs.append(e)
                 return
             dt = time.perf_counter() - t0
-            st = self.stats[gi]
-            st[0] += chunk.nbytes
-            st[1] += dt
-            w = self._window[gi]
-            w[0] += chunk.nbytes
-            w[1] += dt
+            if record:
+                with self._stats_lock:
+                    st = self.stats[gi]
+                    st[0] += chunk.nbytes
+                    st[1] += dt
+                    w = self._window[gi]
+                    w[0] += chunk.nbytes
+                    w[1] += dt
 
         if len(chunks) == 1:
             run_chunk(0, chunks[0])
@@ -234,17 +241,20 @@ class CollectiveEngine:
         call; also updates :attr:`best_throughputs`
         (reference ``strategy.go:17-56``)."""
         out = []
-        for i, (b, t) in enumerate(self._window):
-            rate = (b / t / 2**30) if t > 0 else 0.0
-            out.append(rate)
-            if rate > self.best_throughputs[i]:
-                self.best_throughputs[i] = rate
-            self._window[i] = [0, 0.0]
+        with self._stats_lock:
+            for i, (b, t) in enumerate(self._window):
+                rate = (b / t / 2**30) if t > 0 else 0.0
+                out.append(rate)
+                if rate > self.best_throughputs[i]:
+                    self.best_throughputs[i] = rate
+                self._window[i][0] = 0
+                self._window[i][1] = 0.0
         return out
 
     def total_throughputs(self) -> List[float]:
         """Lifetime per-strategy-pair GiB/s."""
-        return [(b / t / 2**30) if t > 0 else 0.0 for b, t in self.stats]
+        with self._stats_lock:
+            return [(b / t / 2**30) if t > 0 else 0.0 for b, t in self.stats]
 
     def set_strategy(self, strategy: Strategy) -> None:
         """Swap the strategy set (reference ``SetGlobalStrategy`` +
@@ -252,6 +262,7 @@ class CollectiveEngine:
         consensus fencing around the swap)."""
         self.strategy = strategy
         self._graphs = build_strategy_graphs(strategy, self.peers)
-        self.stats = [[0, 0.0] for _ in self._graphs]
-        self._window = [[0, 0.0] for _ in self._graphs]
-        self.best_throughputs = [0.0 for _ in self._graphs]
+        with self._stats_lock:
+            self.stats = [[0, 0.0] for _ in self._graphs]
+            self._window = [[0, 0.0] for _ in self._graphs]
+            self.best_throughputs = [0.0 for _ in self._graphs]

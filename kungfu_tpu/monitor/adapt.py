@@ -72,10 +72,11 @@ def set_tree(engine, forest: List[int]) -> None:
     around the swap (reference ``adaptation.go:8-28``)."""
     bcast = Graph.from_forest_array(forest)
     reduce_g = gen_default_reduce_graph(bcast)
-    engine._graphs = [(reduce_g, bcast)]
-    engine.stats = [[0, 0.0]]
-    engine._window = [[0, 0.0]]
-    engine.best_throughputs = [0.0]
+    with engine._stats_lock:
+        engine._graphs = [(reduce_g, bcast)]
+        engine.stats = [[0, 0.0]]
+        engine._window = [[0, 0.0]]
+        engine.best_throughputs = [0.0]
     engine.strategy = None
     _log.info("installed explicit tree %s", forest)
 
@@ -103,5 +104,10 @@ def majority_vote_interference(peer, suspected: bool) -> bool:
     engine = peer.engine()
     if engine is None:
         return suspected
-    votes = engine.all_reduce(np.array([1 if suspected else 0], np.int64), op="sum")
+    # record=False: the 8-byte vote must not land in the throughput window
+    # it is judging, or the next check compares a tiny-transfer rate
+    # against the data-plane best and flags phantom interference
+    votes = engine.all_reduce(
+        np.array([1 if suspected else 0], np.int64), op="sum", record=False
+    )
     return int(votes[0]) * 2 > peer.size()

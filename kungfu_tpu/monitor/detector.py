@@ -84,9 +84,10 @@ class DetectorServer:
 
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", "0"))
+                fanout = None
                 try:
                     sig = json.loads(self.rfile.read(n).decode())
-                    srv._on_signal(sig)
+                    fanout = srv._on_signal(sig)
                     code = 200
                 except (ValueError, KeyError) as e:
                     _log.warning("bad signal: %s", e)
@@ -95,6 +96,9 @@ class DetectorServer:
                 self.send_header("Content-Length", "2")
                 self.end_headers()
                 self.wfile.write(b"{}")
+                if fanout is not None:
+                    # after the response, without srv._lock held
+                    srv._fanout(fanout)
 
             def do_GET(self):
                 body = json.dumps(
@@ -120,18 +124,26 @@ class DetectorServer:
             st = self._ranks[r] = _RankState()
         return st
 
-    def _on_signal(self, sig: dict) -> None:
+    def _on_signal(self, sig: dict) -> Optional[dict]:
+        """Handle one signal; returns a fan-out payload for the caller to
+        post AFTER releasing the lock (a blocked peer must never stall
+        heartbeat intake)."""
         kind = sig["kind"]
         now = time.time()
         with self._lock:
             if kind == "otherdown":
-                # another host's detector saw a failure
+                # another host's detector saw a failure; epoch < 0 means the
+                # sender had no rank state (non-main host) — fall back to
+                # what this host knows
                 self.results.down_flag = True
-                self.results.epoch_num = int(sig.get("epoch", 0))
-                return
+                epoch = int(sig.get("epoch", -1))
+                if epoch < 0:
+                    epoch = min((s.epochs_done for s in self._ranks.values()), default=0)
+                self.results.epoch_num = epoch
+                return None
             if kind == "otherfinish":
                 self.results.finish_flag = True
-                return
+                return None
             st = self._rank(int(sig["rank"]))
             st.seen = True
             if kind == "begin":
@@ -146,13 +158,15 @@ class DetectorServer:
                     len(self._ranks) >= self.expected_ranks or not self.require_all_seen
                 ):
                     self.results.finish_flag = True
-                    self._fanout({"kind": "otherfinish"})
+                    return {"kind": "otherfinish"}
             else:
                 raise KeyError(f"unknown signal kind {kind!r}")
+        return None
 
     # -- detection loop --------------------------------------------------
     def _check_once(self) -> None:
         now = time.time()
+        fanout = None
         with self._lock:
             if self.results.down_flag or self.results.finish_flag:
                 return
@@ -182,15 +196,27 @@ class DetectorServer:
                     )
                     self.results.down_flag = True
                     self.results.epoch_num = min_epoch
-                    self._fanout({"kind": "otherdown", "epoch": min_epoch})
-                    return
+                    fanout = {"kind": "otherdown", "epoch": min_epoch}
+                    break
+        if fanout is not None:
+            self._fanout(fanout)
 
-    def _fanout(self, sig: dict) -> None:
+    def _fanout(self, sig: dict, attempts: int = 3) -> None:
+        """Post to every peer host's detector, outside any lock; a few
+        retries with backoff — a lost fan-out strands the receiving host in
+        the old round forever, so it is worth insisting."""
         for host in self.peer_hosts:
-            try:
-                post_signal(host, self.port, sig, timeout=3)
-            except OSError as e:
-                _log.warning("fanout to %s failed: %s", host, e)
+            for i in range(attempts):
+                try:
+                    post_signal(host, self.port, sig, timeout=3)
+                    break
+                except OSError as e:
+                    if i == attempts - 1:
+                        _log.warning(
+                            "fanout to %s failed after %d attempts: %s", host, attempts, e
+                        )
+                    else:
+                        time.sleep(0.5 * (i + 1))
 
     def _loop(self):
         while not self._stop.wait(CHECK_PERIOD_S):
@@ -213,13 +239,19 @@ class DetectorServer:
     def report_local_down(self) -> None:
         """Mark a locally-observed failure (e.g. worker process exit) and
         fan it out to the other hosts' detectors so every MonitoredRun
-        restarts in the same round."""
+        restarts in the same round.  A host with no rank state (only the
+        main host receives heartbeats) sends epoch=-1 = "unknown" so
+        receivers fall back to their own accounting instead of restarting
+        from epoch 0."""
         with self._lock:
             if self.results.down_flag:
                 return
-            min_epoch = min((s.epochs_done for s in self._ranks.values()), default=0)
+            if self._ranks:
+                min_epoch = min(s.epochs_done for s in self._ranks.values())
+            else:
+                min_epoch = -1
             self.results.down_flag = True
-            self.results.epoch_num = min_epoch
+            self.results.epoch_num = max(min_epoch, 0)
         self._fanout({"kind": "otherdown", "epoch": min_epoch})
 
     def min_epoch(self) -> int:
@@ -235,6 +267,14 @@ class DetectorServer:
             self.results = DetectorResults()
             if expected_ranks is not None:
                 self.expected_ranks = expected_ranks
+
+
+def query_detector(host: str, port: int = DEFAULT_DETECTOR_PORT, timeout: float = 3.0) -> dict:
+    """GET a detector's current results — used by non-main hosts to fetch
+    the authoritative restart epoch from the main host (the only detector
+    that receives worker heartbeats)."""
+    with urllib.request.urlopen(f"http://{host}:{port}/", timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
 
 
 def post_signal(host: str, port: int, sig: dict, timeout: float = 5.0) -> None:

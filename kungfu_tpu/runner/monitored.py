@@ -14,7 +14,11 @@ import re
 import time
 from typing import List, Optional
 
-from kungfu_tpu.monitor.detector import DEFAULT_DETECTOR_PORT, DetectorServer
+from kungfu_tpu.monitor.detector import (
+    DEFAULT_DETECTOR_PORT,
+    DetectorServer,
+    query_detector,
+)
 from kungfu_tpu.monitor.signals import MONITOR_ADDR_ENV
 from kungfu_tpu.plan.cluster import Cluster
 from kungfu_tpu.runner.job import Job
@@ -49,7 +53,16 @@ def patch_args(args: List[str], remaining_epochs: int, flag: str = "--n-epochs")
             break
     else:
         out += [flag, str(remaining_epochs)]
-    if "--restart" not in " ".join(out):
+    # force --restart 1, overriding an explicit --restart 0 from the
+    # original command line (a surviving 0 would skip checkpoint restore)
+    for i, a in enumerate(out):
+        if a == "--restart" and i + 1 < len(out):
+            out[i + 1] = "1"
+            break
+        if a.startswith("--restart="):
+            out[i] = "--restart=1"
+            break
+    else:
         out += ["--restart", "1"]
     return out
 
@@ -61,6 +74,31 @@ def find_epochs(args: List[str], flag: str = "--n-epochs") -> Optional[int]:
         if a.startswith(flag + "="):
             return int(a.split("=", 1)[1])
     return None
+
+
+def _resolve_done_epochs(detector, self_host: str, main_host: str) -> int:
+    """Completed-epoch count for the restart round.  Only the main host's
+    detector receives heartbeats, so it is the authority; non-main hosts
+    query it (retrying briefly — its down flag may lag a moment) and only
+    fall back to the fan-out epoch if it is unreachable.  Every host must
+    compute the SAME number or ranks relaunch with different --n-epochs
+    and the job deadlocks in collectives."""
+    if self_host == main_host:
+        return detector.results.epoch_num or detector.min_epoch()
+    deadline = time.time() + 10.0
+    while time.time() < deadline:
+        try:
+            res = query_detector(main_host, detector.port)
+            if res.get("down") or res.get("finished"):
+                return int(res.get("epoch", 0))
+        except OSError:
+            pass
+        time.sleep(0.5)
+    _log.warning(
+        "could not fetch authoritative epoch from %s; using fan-out value %d",
+        main_host, detector.results.epoch_num,
+    )
+    return detector.results.epoch_num
 
 
 def monitored_run(ns, cluster: Cluster, job: Job) -> int:
@@ -118,7 +156,7 @@ def monitored_run(ns, cluster: Cluster, job: Job) -> int:
             # restarts, so the detector's min-epoch is cumulative too —
             # take the max, never add (adding double-counts on a second
             # failure and under-trains the job)
-            done = detector.results.epoch_num or detector.min_epoch()
+            done = _resolve_done_epochs(detector, self_host, main_host)
             epochs_done_total = max(epochs_done_total, done)
             if total_epochs is not None:
                 remaining = max(total_epochs - epochs_done_total, 1)

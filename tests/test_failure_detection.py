@@ -32,6 +32,13 @@ class TestArgPatching:
         out = patch_args(["t.py", "--n-epochs=10"], 3)
         assert "--n-epochs=3" in out and "--restart" in out
 
+    def test_patch_overrides_explicit_restart_0(self):
+        out = patch_args(["t.py", "--n-epochs", "10", "--restart", "0"], 4)
+        i = out.index("--restart")
+        assert out[i + 1] == "1"
+        out = patch_args(["t.py", "--n-epochs=10", "--restart=0"], 4)
+        assert "--restart=1" in out and "--restart=0" not in out
+
     def test_patch_missing_appends(self):
         out = patch_args(["t.py"], 5)
         assert out[-4:] == ["--n-epochs", "5", "--restart", "1"]
@@ -85,6 +92,22 @@ class TestDetector:
     def test_otherdown_fanout_intake(self, detector):
         post_signal("127.0.0.1", 27756, {"kind": "otherdown", "epoch": 3})
         assert detector.results.down_flag and detector.results.epoch_num == 3
+
+    def test_otherdown_unknown_epoch_uses_local_state(self, detector):
+        """epoch=-1 ("sender had no rank state") must fall back to this
+        host's own accounting, not restart from epoch 0."""
+        post_signal("127.0.0.1", 27756, {"kind": "epoch", "rank": 0, "epoch": 4})
+        post_signal("127.0.0.1", 27756, {"kind": "epoch", "rank": 1, "epoch": 5})
+        post_signal("127.0.0.1", 27756, {"kind": "otherdown", "epoch": -1})
+        assert detector.results.down_flag
+        assert detector.results.epoch_num == 5
+
+    def test_report_local_down_without_state_sends_unknown(self, detector):
+        """A host that never saw a heartbeat reports epoch 'unknown', and
+        its local flag is clamped to 0."""
+        detector.report_local_down()
+        assert detector.results.down_flag
+        assert detector.results.epoch_num == 0
 
     def test_status_endpoint(self, detector):
         with urllib.request.urlopen("http://127.0.0.1:27756/", timeout=5) as r:
