@@ -14,8 +14,9 @@ reference; the TPU hot path remains :mod:`kungfu_tpu.comm.device`.  This is
 also where strategy adaptation is observable: each engine call records
 per-strategy throughput (see :mod:`kungfu_tpu.monitor`).
 
-Reduction math runs in numpy (SIMD via its vectorized kernels); the C++
-native module can take over the reduce inner loop later without API change.
+The reduce inner loop runs in the native C++ module
+(:mod:`kungfu_tpu.native`, the ``std_transform_2`` analog) with a numpy
+fallback when the native build is unavailable.
 """
 
 from __future__ import annotations
@@ -26,6 +27,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from kungfu_tpu import native
 from kungfu_tpu.comm.host import ConnType, HostChannel
 from kungfu_tpu.plan import (
     Strategy,
@@ -46,12 +48,7 @@ _log = get_logger("engine")
 
 CHUNK_SIZE = 1 << 20  # 1 MiB, reference session.go:292-316
 
-_REDUCERS = {
-    "sum": np.add,
-    "min": np.minimum,
-    "max": np.maximum,
-    "prod": np.multiply,
-}
+REDUCE_OPS = frozenset(native._NP_REDUCERS)  # single source of op names
 
 
 def build_strategy_graphs(
@@ -120,7 +117,7 @@ class CollectiveEngine:
         ``runStrategies``).  ``record=False`` keeps control-plane traffic
         (e.g. interference votes) out of the throughput window so the
         adaptation signal only sees data-plane transfers."""
-        if op not in _REDUCERS and op != "mean":
+        if op not in REDUCE_OPS and op != "mean":
             raise ValueError(f"op {op!r}")
         eff_op = "sum" if op == "mean" else op
         x = np.ascontiguousarray(x)
@@ -199,13 +196,13 @@ class CollectiveEngine:
         reduce stage — recv from graph prevs, accumulate, send to nexts;
         broadcast stage — recv final value, forward to nexts."""
         me = self.rank
-        reducer = _REDUCERS[op]
         acc = chunk.copy() if reduce_g.is_self_loop(me) else None
 
-        # reduce stage: wait for all prevs, accumulate
+        # reduce stage: wait for all prevs, accumulate (native C++ kernel,
+        # numpy fallback — kungfu_tpu/native/reduce.cpp)
         for prev in reduce_g.prevs(me):
             data = np.frombuffer(self._recv(prev, tag + ".r"), dtype=chunk.dtype)
-            acc = data.copy() if acc is None else reducer(acc, data, out=acc)
+            acc = data.copy() if acc is None else native.transform2(acc, data, op)
         if acc is None:
             acc = chunk.copy()
         for nxt in reduce_g.nexts(me):

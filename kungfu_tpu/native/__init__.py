@@ -1,0 +1,152 @@
+"""ctypes loader for the native host-runtime kernels.
+
+The data-plane inner loop of the host collective engine — accumulate a
+received chunk into the local buffer — runs in C++
+(:file:`reduce.cpp`, the analog of reference ``base/op.cpp``
+``std_transform_2`` + ``f16.c``), loaded here via ctypes (no pybind11 in
+this environment).  The library is built lazily with ``make`` on first
+use; when no toolchain or prebuilt ``.so`` is available every entry point
+falls back to numpy, so the framework never hard-depends on the native
+build (set ``KF_TPU_NO_NATIVE=1`` to force the fallback).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_HERE, "libkfnative.so")
+
+_DTYPE_CODES = {
+    np.dtype(np.uint8): 0,
+    np.dtype(np.int8): 1,
+    np.dtype(np.int16): 2,
+    np.dtype(np.int32): 3,
+    np.dtype(np.int64): 4,
+    np.dtype(np.uint16): 5,
+    np.dtype(np.uint32): 6,
+    np.dtype(np.uint64): 7,
+    np.dtype(np.float16): 8,
+    np.dtype(np.float32): 9,
+    np.dtype(np.float64): 10,
+}
+# ml_dtypes bfloat16 (the jax/TPU dtype) when available
+try:  # pragma: no cover - environment dependent
+    import ml_dtypes
+
+    _DTYPE_CODES[np.dtype(ml_dtypes.bfloat16)] = 11
+except ImportError:  # pragma: no cover
+    pass
+
+_OP_CODES = {"sum": 0, "min": 1, "max": 2, "prod": 3}
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-C", _HERE, "-s"],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return os.path.exists(_LIB_PATH)
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The native library, building it on first call; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:  # lock-free fast path: per-chunk callers
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("KF_TPU_NO_NATIVE") == "1":
+            return None
+        # make is dependency-aware, so always run it: a stale .so after a
+        # reduce.cpp edit must be rebuilt, not silently loaded
+        if not _build() and not os.path.exists(_LIB_PATH):
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        lib.kf_transform2.restype = ctypes.c_int
+        lib.kf_transform2.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int32,
+        ]
+        lib.kf_scale_add_f32.restype = ctypes.c_int
+        lib.kf_scale_add_f32.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_float,
+        ]
+        lib.kf_scale_add_f64.restype = ctypes.c_int
+        lib.kf_scale_add_f64.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_double,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+_NP_REDUCERS = {
+    "sum": np.add,
+    "min": np.minimum,
+    "max": np.maximum,
+    "prod": np.multiply,
+}
+
+
+def transform2(dst: np.ndarray, src: np.ndarray, op: str) -> np.ndarray:
+    """dst <- dst OP src in place (reference ``Transform2``,
+    ``base/op.go:19-36``).  Arrays must be contiguous, same shape+dtype."""
+    if dst.shape != src.shape or dst.dtype != src.dtype:
+        raise ValueError(f"shape/dtype mismatch {dst.shape}/{dst.dtype} vs {src.shape}/{src.dtype}")
+    lib = load()
+    code = _DTYPE_CODES.get(dst.dtype)
+    if (
+        lib is not None
+        and code is not None
+        and dst.flags.c_contiguous
+        and src.flags.c_contiguous
+        and op in _OP_CODES
+    ):
+        rc = lib.kf_transform2(
+            dst.ctypes.data, src.ctypes.data, dst.size, code, _OP_CODES[op]
+        )
+        if rc == 0:
+            return dst
+    _NP_REDUCERS[op](dst, src, out=dst)
+    return dst
+
+
+def scale_add(y: np.ndarray, x: np.ndarray, alpha: float) -> np.ndarray:
+    """y <- (1-alpha)*y + alpha*x in place (the SMA update)."""
+    if y.shape != x.shape or y.dtype != x.dtype:
+        raise ValueError("shape/dtype mismatch")
+    lib = load()
+    if lib is not None and y.flags.c_contiguous and x.flags.c_contiguous:
+        if y.dtype == np.float32:
+            if lib.kf_scale_add_f32(y.ctypes.data, x.ctypes.data, y.size, alpha) == 0:
+                return y
+        elif y.dtype == np.float64:
+            if lib.kf_scale_add_f64(y.ctypes.data, x.ctypes.data, y.size, alpha) == 0:
+                return y
+    y *= 1.0 - alpha
+    y += alpha * np.asarray(x)
+    return y
