@@ -48,7 +48,7 @@ _log = get_logger("engine")
 
 CHUNK_SIZE = 1 << 20  # 1 MiB, reference session.go:292-316
 
-REDUCE_OPS = frozenset(native._NP_REDUCERS)  # single source of op names
+REDUCE_OPS = native.REDUCE_OPS  # single source of op names
 
 
 def build_strategy_graphs(

@@ -52,13 +52,25 @@ _tried = False
 
 
 def _build() -> bool:
+    env = dict(os.environ)
+    march = env.get("KF_NATIVE_MARCH")
+    make_args = ["make", "-C", _HERE, "-s"]
+    if march:
+        make_args.append(f"ARCHFLAGS=-march={march}")
+    # cross-process build lock: N local workers race on first use; losers
+    # must wait for the winner's atomic rename, not observe a half-built .so
+    lock_path = os.path.join(_HERE, ".build.lock")
     try:
-        subprocess.run(
-            ["make", "-C", _HERE, "-s"],
-            check=True,
-            capture_output=True,
-            timeout=120,
-        )
+        import fcntl
+
+        with open(lock_path, "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            try:
+                subprocess.run(
+                    make_args, check=True, capture_output=True, timeout=120
+                )
+            finally:
+                fcntl.flock(lockf, fcntl.LOCK_UN)
         return os.path.exists(_LIB_PATH)
     except (OSError, subprocess.SubprocessError):
         return False
@@ -88,14 +100,6 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_int32, ctypes.c_int32,
         ]
-        lib.kf_scale_add_f32.restype = ctypes.c_int
-        lib.kf_scale_add_f32.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_float,
-        ]
-        lib.kf_scale_add_f64.restype = ctypes.c_int
-        lib.kf_scale_add_f64.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_double,
-        ]
         _lib = lib
         return _lib
 
@@ -110,6 +114,10 @@ _NP_REDUCERS = {
     "max": np.maximum,
     "prod": np.multiply,
 }
+
+#: public set of supported reduce-op names (consumed by the collective
+#: engine; keep in sync with _OP_CODES / reduce.cpp)
+REDUCE_OPS = frozenset(_NP_REDUCERS)
 
 
 def transform2(dst: np.ndarray, src: np.ndarray, op: str) -> np.ndarray:
@@ -135,18 +143,3 @@ def transform2(dst: np.ndarray, src: np.ndarray, op: str) -> np.ndarray:
     return dst
 
 
-def scale_add(y: np.ndarray, x: np.ndarray, alpha: float) -> np.ndarray:
-    """y <- (1-alpha)*y + alpha*x in place (the SMA update)."""
-    if y.shape != x.shape or y.dtype != x.dtype:
-        raise ValueError("shape/dtype mismatch")
-    lib = load()
-    if lib is not None and y.flags.c_contiguous and x.flags.c_contiguous:
-        if y.dtype == np.float32:
-            if lib.kf_scale_add_f32(y.ctypes.data, x.ctypes.data, y.size, alpha) == 0:
-                return y
-        elif y.dtype == np.float64:
-            if lib.kf_scale_add_f64(y.ctypes.data, x.ctypes.data, y.size, alpha) == 0:
-                return y
-    y *= 1.0 - alpha
-    y += alpha * np.asarray(x)
-    return y

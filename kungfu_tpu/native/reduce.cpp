@@ -7,7 +7,8 @@
 // added as a first-class dtype (it is the TPU wire format for gradients).
 //
 // SIMD comes from compiler auto-vectorization of the tight typed loops
-// (-O3 -march=native); f16/bf16 widen to f32, reduce, and narrow back with
+// (-O3; portable codegen by default — see Makefile ARCHFLAGS for the
+// -march=native opt-in); f16/bf16 widen to f32, reduce, and narrow back with
 // round-to-nearest-even, matching XLA's conversion semantics.
 
 #include <cstddef>
@@ -190,20 +191,6 @@ int kf_transform2(void* dst, const void* src, int64_t n, int32_t dtype,
           static_cast<uint16_t*>(dst), static_cast<const uint16_t*>(src), m, op);
   }
   return -1;
-}
-
-// y <- (1-alpha)*y + alpha*x  (the SMA/EA-SGD inner update,
-// reference sma_sgd.py:45-74, done natively for fused model buffers)
-int kf_scale_add_f32(float* y, const float* x, int64_t n, float alpha) {
-  float beta = 1.0f - alpha;
-  for (int64_t i = 0; i < n; ++i) y[i] = beta * y[i] + alpha * x[i];
-  return 0;
-}
-
-int kf_scale_add_f64(double* y, const double* x, int64_t n, double alpha) {
-  double beta = 1.0 - alpha;
-  for (int64_t i = 0; i < n; ++i) y[i] = beta * y[i] + alpha * x[i];
-  return 0;
 }
 
 int kf_version() { return 1; }

@@ -1,0 +1,26 @@
+"""PyTorch binding — parity with reference ``srcs/python/kungfu/torch``.
+
+The reference exposes a small torch surface (``kungfu/torch/__init__.py``,
+``torch/optimizers/sync_sgd.py:6-32``, ``torch/ops/{collective,clib}.py``):
+a ``SynchronousSGDOptimizer`` that dynamically subclasses any torch
+optimizer to allreduce gradients before ``step()``, ``broadcast_parameters``
+for rank-0 initialization, and a dtype-keyed op dispatch table.
+
+Here the collectives run over the framework's host-side graph-collective
+engine (:mod:`kungfu_tpu.comm.engine` — the multi-process CPU data path;
+torch tensors never touch the TPU mesh, exactly as the reference's torch
+path never touches TF).  Async variants stage through a thread pool and
+return handles awaited by :func:`wait_all_handles`, mirroring the
+reference's CUDA ``HandlerManager`` (``ops/cuda/collective.cpp:20-90``).
+"""
+
+from kungfu_tpu.torch.ops.collective import (  # noqa: F401
+    all_reduce,
+    all_reduce_async,
+    broadcast,
+    broadcast_parameters,
+    wait_all_handles,
+)
+from kungfu_tpu.torch.optimizers.sync_sgd import (  # noqa: F401
+    SynchronousSGDOptimizer,
+)

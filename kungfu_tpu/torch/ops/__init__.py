@@ -1,0 +1,7 @@
+from kungfu_tpu.torch.ops.collective import (  # noqa: F401
+    all_reduce,
+    all_reduce_async,
+    broadcast,
+    broadcast_parameters,
+    wait_all_handles,
+)

@@ -1,0 +1,61 @@
+"""Dtype-keyed dispatch between torch tensors and the host engine.
+
+Parity with reference ``kungfu/torch/ops/clib.py:10-35`` — a per-dtype op
+dispatch table.  Each supported torch dtype maps to a ``(to_np, from_np)``
+converter pair; dtypes without a numpy representation (bfloat16) stage
+through float32 on the host, which is exact for the reduce ops we support
+(bf16 is a truncated f32).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import torch
+
+
+def _identity_pair(np_dtype):
+    def to_np(t: "torch.Tensor") -> np.ndarray:
+        return np.ascontiguousarray(t.detach().cpu().numpy())
+
+    def from_np(a: np.ndarray, like: "torch.Tensor") -> "torch.Tensor":
+        return torch.from_numpy(np.ascontiguousarray(a)).to(like.dtype)
+
+    return to_np, from_np
+
+
+def _via_f32_pair():
+    def to_np(t: "torch.Tensor") -> np.ndarray:
+        return np.ascontiguousarray(t.detach().float().cpu().numpy())
+
+    def from_np(a: np.ndarray, like: "torch.Tensor") -> "torch.Tensor":
+        return torch.from_numpy(np.ascontiguousarray(a)).to(like.dtype)
+
+    return to_np, from_np
+
+
+#: torch dtype -> (tensor->ndarray, ndarray->tensor) converters.
+CONVERTERS = {
+    torch.float16: _identity_pair(np.float16),
+    torch.bfloat16: _via_f32_pair(),
+    torch.float32: _identity_pair(np.float32),
+    torch.float64: _identity_pair(np.float64),
+    torch.uint8: _identity_pair(np.uint8),
+    torch.int8: _identity_pair(np.int8),
+    torch.int32: _identity_pair(np.int32),
+    torch.int64: _identity_pair(np.int64),
+}
+
+SUPPORTED_DTYPES = frozenset(CONVERTERS)
+
+
+def to_numpy(t: "torch.Tensor") -> np.ndarray:
+    try:
+        to_np, _ = CONVERTERS[t.dtype]
+    except KeyError:
+        raise TypeError(f"unsupported torch dtype {t.dtype}") from None
+    return to_np(t)
+
+
+def from_numpy(a: np.ndarray, like: "torch.Tensor") -> "torch.Tensor":
+    _, from_np = CONVERTERS[like.dtype]
+    return from_np(a, like)

@@ -1,0 +1,139 @@
+"""Torch collective ops over the host graph-collective engine.
+
+Parity with reference ``kungfu/torch/ops/collective.py`` (all_reduce,
+broadcast_parameters, ``collective.py:40-45``) and the async-handle flow of
+``srcs/cpp/src/torch/ops/cuda/collective.cpp:20-90`` (launch → handle →
+``wait_all_handles``), here staged through a thread pool instead of CUDA
+streams.
+
+All functions take an optional ``engine``; by default they use the global
+peer's engine (``kungfu_tpu.python``).  In single-process mode (no engine)
+every collective is the identity, so scripts run unchanged under
+``python`` and ``kfrun -np N``.
+
+Naming: collectives rendezvous by name across ranks, so async submissions
+must be named at *call* time (thread-pool execution order is not
+deterministic).  Each op gets ``torch.<round>.<seq>`` — callers must issue
+the same op sequence on every rank, the same contract as the reference.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Iterable, List, Optional, Tuple, Union
+
+import torch
+
+from kungfu_tpu.torch.ops import clib
+
+_pool: Optional[ThreadPoolExecutor] = None
+_pool_lock = threading.Lock()
+_seq_lock = threading.Lock()
+_seq = [0]
+
+
+def _next_name(kind: str) -> str:
+    with _seq_lock:
+        n = _seq[0]
+        _seq[0] += 1
+    return f"torch.{kind}.{n}"
+
+
+def _get_pool() -> ThreadPoolExecutor:
+    global _pool
+    with _pool_lock:
+        if _pool is None:
+            _pool = ThreadPoolExecutor(max_workers=4, thread_name_prefix="kf-torch")
+        return _pool
+
+
+def _default_engine():
+    from kungfu_tpu import python
+
+    try:
+        peer = python._peer()
+    except RuntimeError:
+        return None
+    return peer.engine()
+
+
+def all_reduce(
+    t: "torch.Tensor", op: str = "mean", engine=None, name: str = ""
+) -> "torch.Tensor":
+    """Synchronous allreduce; returns a new tensor of the same dtype."""
+    engine = engine if engine is not None else _default_engine()
+    if engine is None:
+        return t.clone()
+    a = clib.to_numpy(t)
+    out = engine.all_reduce(a, op=op, name=name or _next_name("ar"))
+    return clib.from_numpy(out, t).reshape(t.shape)
+
+
+Handle = Tuple[Future, "torch.Tensor"]
+
+
+def all_reduce_async(
+    t: "torch.Tensor", op: str = "mean", engine=None, name: str = ""
+) -> Handle:
+    """Launch an allreduce; returns a handle for :func:`wait_all_handles`.
+
+    The result is copied **into** ``t`` when awaited (in-place semantics,
+    matching the reference's gradient sync)."""
+    engine = engine if engine is not None else _default_engine()
+    nm = name or _next_name("ar")
+    if engine is None:
+        f: Future = Future()
+        f.set_result(None)
+        return (f, t)
+    a = clib.to_numpy(t)
+    fut = _get_pool().submit(engine.all_reduce, a, op, nm)
+    return (fut, t)
+
+
+def wait_all_handles(handles: Iterable[Handle]) -> None:
+    """Await async collectives, copying each result into its tensor
+    (reference ``wait_all_handles``, ops/cuda/helper.cpp)."""
+    for fut, t in handles:
+        out = fut.result()
+        if out is not None:
+            with torch.no_grad():
+                t.copy_(clib.from_numpy(out, t).reshape(t.shape))
+
+
+def broadcast(
+    t: "torch.Tensor", root: int = 0, engine=None, name: str = ""
+) -> "torch.Tensor":
+    engine = engine if engine is not None else _default_engine()
+    if engine is None:
+        return t.clone()
+    a = clib.to_numpy(t)
+    out = engine.broadcast(a, root=root, name=name or _next_name("bc"))
+    return clib.from_numpy(out, t).reshape(t.shape)
+
+
+def broadcast_parameters(
+    params: Union[dict, Iterable["torch.Tensor"]], root: int = 0, engine=None
+) -> None:
+    """Broadcast rank ``root``'s parameters into every rank's tensors
+    in place (reference ``torch/ops/collective.py:40-45``).
+
+    ``params`` may be a ``state_dict``-style mapping or an iterable of
+    tensors; iteration order must agree across ranks."""
+    engine = engine if engine is not None else _default_engine()
+    if engine is None:
+        return
+    items: List[Tuple[str, "torch.Tensor"]]
+    if isinstance(params, dict):
+        items = [(str(k), v) for k, v in params.items()]
+    else:
+        items = [(str(i), p) for i, p in enumerate(params)]
+    # deterministic per-key names (reference keys collectives by tensor
+    # name); per-(src,name) FIFO queues make cross-round reuse safe
+    for key, t in items:
+        if not torch.is_tensor(t):
+            continue
+        a = clib.to_numpy(t)
+        out = engine.broadcast(a, root=root, name=f"torch.bp.{key}")
+        with torch.no_grad():
+            t.copy_(clib.from_numpy(out, t).reshape(t.shape))

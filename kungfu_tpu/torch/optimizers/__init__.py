@@ -1,0 +1,3 @@
+from kungfu_tpu.torch.optimizers.sync_sgd import (  # noqa: F401
+    SynchronousSGDOptimizer,
+)

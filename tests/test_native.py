@@ -20,7 +20,11 @@ DTYPES = [np.float32, np.float64, np.float16, np.int32, np.int64, np.int16,
 
 @pytest.fixture(scope="module")
 def lib_available():
-    return native.available()
+    # without the native lib every cross-check would vacuously compare
+    # numpy against numpy; test_native_build_available still fails loudly
+    if not native.available():
+        pytest.skip("native library unavailable — cross-check would be vacuous")
+    return True
 
 
 @pytest.mark.parametrize("dtype", DTYPES, ids=lambda d: np.dtype(d).name)
@@ -74,24 +78,12 @@ def test_transform2_inplace_and_mismatch():
         native.transform2(a, b.astype(np.float64), "sum")
 
 
-@pytest.mark.parametrize("dtype", [np.float32, np.float64])
-def test_scale_add(dtype):
-    rng = np.random.default_rng(2)
-    y = rng.standard_normal(1000).astype(dtype)
-    x = rng.standard_normal(1000).astype(dtype)
-    ref = (0.9 * y + 0.1 * x).astype(dtype)
-    got = native.scale_add(y.copy(), x, 0.1)
-    np.testing.assert_allclose(got, ref, rtol=1e-5)
-
-
 def test_numpy_fallback(monkeypatch):
     """With the native lib disabled, transform2 must still be correct."""
     monkeypatch.setattr(native, "load", lambda: None)
     a = np.arange(16, dtype=np.float32)
     b = np.ones(16, dtype=np.float32)
     np.testing.assert_array_equal(native.transform2(a.copy(), b, "sum"), a + 1)
-    y = native.scale_add(np.ones(4, np.float32), np.zeros(4, np.float32), 0.25)
-    np.testing.assert_allclose(y, 0.75)
 
 
 def test_native_build_available():
