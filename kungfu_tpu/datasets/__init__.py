@@ -1,0 +1,1 @@
+from kungfu_tpu.datasets.adaptor import ElasticDataset  # noqa: F401

@@ -52,8 +52,7 @@ _tried = False
 
 
 def _build() -> bool:
-    env = dict(os.environ)
-    march = env.get("KF_NATIVE_MARCH")
+    march = os.environ.get("KF_NATIVE_MARCH")
     make_args = ["make", "-C", _HERE, "-s"]
     if march:
         make_args.append(f"ARCHFLAGS=-march={march}")
@@ -72,7 +71,7 @@ def _build() -> bool:
             finally:
                 fcntl.flock(lockf, fcntl.LOCK_UN)
         return os.path.exists(_LIB_PATH)
-    except (OSError, subprocess.SubprocessError):
+    except (ImportError, OSError, subprocess.SubprocessError):
         return False
 
 

@@ -1,0 +1,17 @@
+"""Adaptation-policy subsystem.
+
+Parity with reference ``kungfu/tensorflow/policy/{base_policy,policy_hook}.py``
+(SURVEY §2.3): a ``BasePolicy`` interface with before/after train/epoch/step
+callbacks, driven by a :class:`PolicyRunner` that maintains the named
+training globals the reference keeps as TF variables
+(``kungfu/tensorflow/variables.py`` — batch size, trained samples, gradient
+noise scale) and executes the policies' resize/stop intents through the
+elastic protocol.
+"""
+
+from kungfu_tpu.policy.base import BasePolicy, PolicyContext  # noqa: F401
+from kungfu_tpu.policy.policies import (  # noqa: F401
+    GNSResizePolicy,
+    ScheduledSizePolicy,
+)
+from kungfu_tpu.policy.runner import PolicyRunner  # noqa: F401

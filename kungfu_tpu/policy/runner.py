@@ -1,0 +1,116 @@
+"""Drive policies around a training loop and execute their intents.
+
+The PolicyHook analog (reference ``policy/policy_hook.py:8-77``): wraps a
+set of :class:`BasePolicy` objects, maintains the named training globals
+(batch size, trained samples, GNS), and on ``after_step`` executes any
+resize intent through the elastic protocol — propose to the config
+server, run the consensus resize, re-broadcast parameters, stop if
+detached (reference ``policy_hook.py:69-70``).
+
+Single-process mode (no channel / no config server) degrades to running
+the callbacks only, so policy-instrumented loops work unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Tuple
+
+import numpy as np
+
+from kungfu_tpu.initializer import broadcast_parameters
+from kungfu_tpu.policy.base import BasePolicy, PolicyContext
+from kungfu_tpu.utils.log import get_logger, log_event
+
+_log = get_logger("policy")
+
+
+class PolicyRunner:
+    def __init__(
+        self,
+        policies: Iterable[BasePolicy],
+        peer=None,
+        batch_size: int = 0,
+    ):
+        self.policies = list(policies)
+        self.peer = peer
+        self.ctx = PolicyContext(
+            batch_size=batch_size,
+            cluster_size=peer.size() if peer is not None else 1,
+        )
+
+    # -- lifecycle callbacks (reference before/after train/epoch) --------
+    def before_train(self) -> None:
+        for p in self.policies:
+            p.before_train(self.ctx)
+
+    def after_train(self) -> None:
+        for p in self.policies:
+            p.after_train(self.ctx)
+
+    def before_epoch(self) -> None:
+        for p in self.policies:
+            p.before_epoch(self.ctx)
+        self.ctx.epoch += 1
+
+    def after_epoch(self) -> None:
+        for p in self.policies:
+            p.after_epoch(self.ctx)
+
+    def before_step(self) -> None:
+        for p in self.policies:
+            p.before_step(self.ctx)
+
+    # -- the per-step driver ---------------------------------------------
+    def after_step(
+        self,
+        params=None,
+        gradient_noise_scale: Optional[float] = None,
+        gradient_variance: Optional[float] = None,
+        **metrics: float,
+    ) -> Tuple[object, bool]:
+        """Run after each optimizer step.  Returns ``(params, stop)``;
+        ``params`` are re-broadcast from rank 0 when membership changed."""
+        ctx = self.ctx
+        ctx.step += 1
+        ctx.trained_samples += ctx.batch_size * ctx.cluster_size
+        if gradient_noise_scale is not None:
+            ctx.gradient_noise_scale = float(gradient_noise_scale)
+        if gradient_variance is not None:
+            ctx.gradient_variance = float(gradient_variance)
+        ctx.metrics.update(metrics)
+
+        for p in self.policies:
+            p.after_step(ctx)
+
+        stop = ctx.stop_requested
+        target, ctx.requested_size, ctx.stop_requested = (
+            ctx.requested_size, None, False,
+        )
+        if target is None or self.peer is None:
+            return params, stop
+
+        peer = self.peer
+        if target == peer.size():
+            return params, stop
+        if not peer.config.config_server:
+            _log.warning("policy requested size %d but no config server", target)
+            return params, stop
+        log_event(f"policy-resize-{peer.size()}->{target}-at-step-{ctx.step}")
+        peer.propose_new_size(target)
+        changed = peer.resize_cluster_from_url()
+        if changed:
+            if peer.detached:
+                log_event("policy-detached-stopping")
+                return params, True
+            ctx.cluster_size = peer.size()
+            if params is not None:
+                params = broadcast_parameters(params, peer)
+            ctx.step = self._sync_step(ctx.step)
+        return params, stop
+
+    def _sync_step(self, step: int) -> int:
+        engine = self.peer.engine() if self.peer is not None else None
+        if engine is None:
+            return step
+        out = engine.all_reduce(np.array([step], np.int64), op="max")
+        return int(out[0])

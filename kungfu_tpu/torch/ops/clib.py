@@ -13,7 +13,7 @@ import numpy as np
 import torch
 
 
-def _identity_pair(np_dtype):
+def _identity_pair():
     def to_np(t: "torch.Tensor") -> np.ndarray:
         return np.ascontiguousarray(t.detach().cpu().numpy())
 
@@ -34,15 +34,17 @@ def _via_f32_pair():
 
 
 #: torch dtype -> (tensor->ndarray, ndarray->tensor) converters.
+#: numpy mirrors these dtypes 1:1 (torch->numpy is exact via .numpy());
+#: only bfloat16 lacks a numpy type and stages through float32.
 CONVERTERS = {
-    torch.float16: _identity_pair(np.float16),
     torch.bfloat16: _via_f32_pair(),
-    torch.float32: _identity_pair(np.float32),
-    torch.float64: _identity_pair(np.float64),
-    torch.uint8: _identity_pair(np.uint8),
-    torch.int8: _identity_pair(np.int8),
-    torch.int32: _identity_pair(np.int32),
-    torch.int64: _identity_pair(np.int64),
+    **{
+        dt: _identity_pair()
+        for dt in (
+            torch.float16, torch.float32, torch.float64,
+            torch.uint8, torch.int8, torch.int32, torch.int64,
+        )
+    },
 }
 
 SUPPORTED_DTYPES = frozenset(CONVERTERS)

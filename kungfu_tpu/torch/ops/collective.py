@@ -20,15 +20,13 @@ the same op sequence on every rank, the same contract as the reference.
 from __future__ import annotations
 
 import threading
-from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import Future
 from typing import Iterable, List, Optional, Tuple, Union
 
 import torch
 
 from kungfu_tpu.torch.ops import clib
 
-_pool: Optional[ThreadPoolExecutor] = None
-_pool_lock = threading.Lock()
 _seq_lock = threading.Lock()
 _seq = [0]
 
@@ -40,12 +38,20 @@ def _next_name(kind: str) -> str:
     return f"torch.{kind}.{n}"
 
 
-def _get_pool() -> ThreadPoolExecutor:
-    global _pool
-    with _pool_lock:
-        if _pool is None:
-            _pool = ThreadPoolExecutor(max_workers=4, thread_name_prefix="kf-torch")
-        return _pool
+def _spawn(fn, *args) -> Future:
+    # one thread per outstanding op, NOT a bounded shared pool: collectives
+    # block on remote ranks, so a fixed pool shared by several in-process
+    # engines can fill with waiters and starve the rank they wait for
+    fut: Future = Future()
+
+    def run():
+        try:
+            fut.set_result(fn(*args))
+        except BaseException as e:  # noqa: BLE001
+            fut.set_exception(e)
+
+    threading.Thread(target=run, daemon=True, name="kf-torch-ar").start()
+    return fut
 
 
 def _default_engine():
@@ -58,11 +64,19 @@ def _default_engine():
     return peer.engine()
 
 
+def _check_op_dtype(t: "torch.Tensor", op: str) -> None:
+    if op == "mean" and not t.dtype.is_floating_point:
+        raise TypeError(
+            f"op='mean' on {t.dtype} would silently truncate; use op='sum'"
+        )
+
+
 def all_reduce(
     t: "torch.Tensor", op: str = "mean", engine=None, name: str = ""
 ) -> "torch.Tensor":
     """Synchronous allreduce; returns a new tensor of the same dtype."""
     engine = engine if engine is not None else _default_engine()
+    _check_op_dtype(t, op)
     if engine is None:
         return t.clone()
     a = clib.to_numpy(t)
@@ -82,12 +96,13 @@ def all_reduce_async(
     matching the reference's gradient sync)."""
     engine = engine if engine is not None else _default_engine()
     nm = name or _next_name("ar")
+    _check_op_dtype(t, op)
     if engine is None:
         f: Future = Future()
         f.set_result(None)
         return (f, t)
     a = clib.to_numpy(t)
-    fut = _get_pool().submit(engine.all_reduce, a, op, nm)
+    fut = _spawn(engine.all_reduce, a, op, nm)
     return (fut, t)
 
 

@@ -1,0 +1,101 @@
+"""Elastic dataset adaptor tests — parity with the reference's dataset
+adaptor integration test (tests/python/integration, datasets/adaptor.py)."""
+
+import numpy as np
+import pytest
+
+from kungfu_tpu.datasets import ElasticDataset
+
+
+def collect(ds, n):
+    return [ds.next_batch() for _ in range(n)]
+
+
+class TestSharding:
+    def test_disjoint_and_complete_cover(self):
+        x = np.arange(64)
+        seen = []
+        for rank in range(4):
+            ds = ElasticDataset([x], batch_size=4, rank=rank, size=4, seed=1)
+            for (b,) in collect(ds, ds.batches_per_epoch()):
+                seen.extend(b.tolist())
+        assert sorted(seen) == list(range(64))
+
+    def test_ranks_agree_on_permutation(self):
+        x = np.arange(32)
+        d0 = ElasticDataset([x], batch_size=4, rank=0, size=2, seed=9)
+        d1 = ElasticDataset([x], batch_size=4, rank=1, size=2, seed=9)
+        (b0,) = d0.next_batch()
+        (b1,) = d1.next_batch()
+        assert set(b0) & set(b1) == set()
+
+    def test_multiple_arrays_stay_aligned(self):
+        x = np.arange(40)
+        y = np.arange(40) * 10
+        ds = ElasticDataset([x, y], batch_size=5, seed=3)
+        bx, by = ds.next_batch()
+        np.testing.assert_array_equal(by, bx * 10)
+
+    def test_short_tail_dropped(self):
+        ds = ElasticDataset([np.arange(10)], batch_size=3, rank=0, size=1)
+        assert ds.batches_per_epoch() == 3
+
+    def test_too_small_raises(self):
+        ds = ElasticDataset([np.arange(3)], batch_size=4)
+        with pytest.raises(ValueError):
+            ds.next_batch()
+
+
+class TestElasticResume:
+    def test_resize_continues_stream(self):
+        """Grow 1→2 mid-epoch: the union of what both shapes consumed has
+        no overlap with what the old shape consumed after the boundary."""
+        x = np.arange(64)
+        ds = ElasticDataset([x], batch_size=4, rank=0, size=1, seed=5)
+        first = [ds.next_batch()[0] for _ in range(4)]  # 16 samples at np=1
+        consumed = ds.consumed
+        # resize to 2 workers; both resume from the same global offset
+        a = ElasticDataset([x], batch_size=4, rank=0, size=2, seed=5)
+        b = ElasticDataset([x], batch_size=4, rank=1, size=2, seed=5)
+        a.skip(consumed)
+        b.skip(consumed)
+        nxt = np.concatenate([a.next_batch()[0], b.next_batch()[0]])
+        already = np.concatenate(first)
+        assert set(nxt) & set(already) == set()
+
+    def test_skip_resumes_exactly(self):
+        x = np.arange(48)
+        ds = ElasticDataset([x], batch_size=4, seed=2)
+        collect(ds, 3)
+        mark = ds.consumed
+        (expected,) = ds.next_batch()
+        ds2 = ElasticDataset([x], batch_size=4, seed=2)
+        ds2.skip(mark)
+        (got,) = ds2.next_batch()
+        np.testing.assert_array_equal(got, expected)
+
+    def test_unaligned_skip_rounds_up(self):
+        x = np.arange(64)
+        ds = ElasticDataset([x], batch_size=4, rank=0, size=2, seed=0)
+        ds.skip(13)  # global batch is 8 → realigns to 16
+        ds.next_batch()
+        assert ds.consumed == 24
+
+    def test_epoch_reshuffles(self):
+        x = np.arange(16)
+        ds = ElasticDataset([x], batch_size=16, seed=4)
+        (e0,) = ds.next_batch()
+        (e1,) = ds.next_batch()
+        assert not np.array_equal(e0, e1)
+        assert sorted(e0) == sorted(e1)
+
+    def test_epochs_iterator(self):
+        x = np.arange(24)
+        ds = ElasticDataset([x], batch_size=6, seed=0)
+        batches = list(ds.epochs(2))
+        assert len(batches) == 8  # 4 per epoch x 2
+
+    def test_no_shuffle_identity_order(self):
+        x = np.arange(12)
+        ds = ElasticDataset([x], batch_size=4, shuffle=False)
+        np.testing.assert_array_equal(ds.next_batch()[0], [0, 1, 2, 3])

@@ -1,0 +1,123 @@
+"""Policy subsystem tests — parity with reference
+tests/python/unit/test_tensorflow_policy.py (policy scheduling) plus the
+GNS-driven resize heuristic."""
+
+from kungfu_tpu.policy import (
+    BasePolicy,
+    GNSResizePolicy,
+    PolicyContext,
+    PolicyRunner,
+    ScheduledSizePolicy,
+)
+
+
+class Recorder(BasePolicy):
+    def __init__(self):
+        self.calls = []
+
+    def before_train(self, ctx):
+        self.calls.append("before_train")
+
+    def after_train(self, ctx):
+        self.calls.append("after_train")
+
+    def before_epoch(self, ctx):
+        self.calls.append("before_epoch")
+
+    def after_epoch(self, ctx):
+        self.calls.append("after_epoch")
+
+    def before_step(self, ctx):
+        self.calls.append("before_step")
+
+    def after_step(self, ctx):
+        self.calls.append("after_step")
+
+
+class TestLifecycle:
+    def test_callback_order_and_globals(self):
+        rec = Recorder()
+        r = PolicyRunner([rec], batch_size=32)
+        r.before_train()
+        r.before_epoch()
+        for _ in range(3):
+            r.before_step()
+            params, stop = r.after_step(params={"w": 1})
+            assert not stop
+        r.after_epoch()
+        r.after_train()
+        assert rec.calls == (
+            ["before_train", "before_epoch"]
+            + ["before_step", "after_step"] * 3
+            + ["after_epoch", "after_train"]
+        )
+        assert r.ctx.step == 3
+        assert r.ctx.trained_samples == 3 * 32  # cluster_size 1
+        assert r.ctx.epoch == 1
+
+    def test_stop_request(self):
+        class Stopper(BasePolicy):
+            def after_step(self, ctx):
+                if ctx.step >= 2:
+                    ctx.request_stop()
+
+        r = PolicyRunner([Stopper()])
+        assert r.after_step()[1] is False
+        assert r.after_step()[1] is True
+
+    def test_resize_intent_without_peer_is_noop(self):
+        r = PolicyRunner([ScheduledSizePolicy("1:1,4:100")])
+        params, stop = r.after_step(params=None)
+        assert not stop
+        assert r.ctx.requested_size is None  # consumed
+
+
+class TestScheduledSizePolicy:
+    def test_requests_schedule_size(self):
+        p = ScheduledSizePolicy("1:2,2:2,4:10")
+        ctx = PolicyContext(cluster_size=1)
+        ctx.step = 1
+        p.after_step(ctx)
+        assert ctx.requested_size is None  # still in 1-phase
+        ctx.step = 3
+        p.after_step(ctx)
+        assert ctx.requested_size == 2
+
+
+class TestGNSResizePolicy:
+    def test_grows_when_gns_large(self):
+        p = GNSResizePolicy(max_size=16)
+        ctx = PolicyContext(batch_size=64, cluster_size=2)
+        ctx.step = 100
+        ctx.gradient_noise_scale = 512.0  # → want 8 workers
+        p.after_step(ctx)
+        assert ctx.requested_size == 8
+
+    def test_hysteresis_band_holds(self):
+        p = GNSResizePolicy()
+        ctx = PolicyContext(batch_size=64, cluster_size=8)
+        ctx.gradient_noise_scale = 64.0 * 9  # want 9, within 50% of 8
+        p.after_step(ctx)
+        assert ctx.requested_size is None
+
+    def test_no_signal_no_action(self):
+        p = GNSResizePolicy()
+        ctx = PolicyContext(batch_size=64, cluster_size=4)
+        p.after_step(ctx)
+        assert ctx.requested_size is None
+
+    def test_cooldown(self):
+        p = GNSResizePolicy(cooldown_steps=10, max_size=64)
+        ctx = PolicyContext(batch_size=32, cluster_size=2)
+        ctx.step = 1
+        ctx.gradient_noise_scale = 32.0 * 16
+        p.after_step(ctx)
+        assert ctx.requested_size == 16
+        ctx.requested_size = None
+        ctx.cluster_size = 2  # resize did not happen (e.g. no server)
+        ctx.step = 5  # within cooldown
+        p.after_step(ctx)
+        assert ctx.requested_size is None
+        ctx.step = 12
+        p.after_step(ctx)
+        assert ctx.requested_size == 16

@@ -134,6 +134,35 @@ class TestMultiEngine:
         finally:
             close_all(engines, chans)
 
+    def test_async_three_ranks_many_grads(self):
+        """Regression: a bounded shared thread pool deadlocked when
+        ranks x grads exceeded the pool size (blocked waiters starved the
+        rank they waited for)."""
+        engines, chans = make_engines(3)
+        try:
+            def worker(e, val):
+                grads = [torch.full((4,), val + i) for i in range(3)]
+                handles = [
+                    collective.all_reduce_async(g, op="sum", engine=e, name=f"m{i}")
+                    for i, g in enumerate(grads)
+                ]
+                collective.wait_all_handles(handles)
+                return grads
+
+            outs = run_all(
+                [lambda e=e, v=float(r) : worker(e, v) for r, e in enumerate(engines)],
+                timeout=30,
+            )
+            for grads in outs:
+                for i, g in enumerate(grads):
+                    assert torch.allclose(g, torch.full((4,), 3.0 + 3 * i))
+        finally:
+            close_all(engines, chans)
+
+    def test_int_mean_rejected(self):
+        with pytest.raises(TypeError):
+            collective.all_reduce(torch.ones(3, dtype=torch.int64), op="mean")
+
     def test_broadcast_parameters(self):
         engines, chans = make_engines(2)
         try:
