@@ -1,0 +1,97 @@
+#!/usr/bin/env python3
+"""Elastic adaptation (resize) latency benchmark.
+
+Parity with reference ``benchmarks/adaptation`` (docker-compose elastic
+schedule driving resize through the config server; the resize-time
+profiler of ``experimental/hook/elastic.py:11-48``): measures the cost of
+a cluster transition the TPU way — for each size in the schedule, build
+the new mesh epoch (Communicator), re-jit the training step, and
+re-broadcast parameters, timing each phase.
+
+    python benchmarks/adaptation.py --schedule 1,2,4,8 --cpu-mesh 8
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
+import json
+import time
+
+
+def main(argv=None) -> dict:
+    p = argparse.ArgumentParser()
+    p.add_argument("--schedule", default="1,2,4,8",
+                   help="comma-separated cluster sizes to transition through")
+    p.add_argument("--param-mib", type=float, default=16.0,
+                   help="model size re-broadcast on each transition")
+    p.add_argument("--quick", action="store_true")
+    p.add_argument("--cpu-mesh", type=int, default=0, metavar="N")
+    args = p.parse_args(argv)
+    if args.quick:
+        args.schedule, args.param_mib = "1,2,4", 1.0
+
+    import jax
+
+    if args.cpu_mesh:
+        jax.config.update("jax_num_cpu_devices", args.cpu_mesh)
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kungfu_tpu.comm.device import Communicator
+
+    sizes = [int(s) for s in args.schedule.split(",")]
+    n_devs = len(jax.devices())
+    sizes = [s for s in sizes if s <= n_devs]
+    n_params = int(args.param_mib * (1 << 20) / 4)
+    params = jnp.asarray(
+        np.random.default_rng(0).standard_normal(n_params), jnp.float32
+    )
+
+    transitions = []
+    prev = None
+    for size in sizes:
+        t0 = time.perf_counter()
+        comm = Communicator(devices=jax.devices()[:size], local_size=size)
+        t_mesh = time.perf_counter() - t0
+
+        # re-jit: first collective on the new epoch compiles the program
+        stacked = jnp.broadcast_to(params[None], (size, n_params))
+        t0 = time.perf_counter()
+        jax.block_until_ready(comm.broadcast(stacked, root=0))
+        t_compile_bcast = time.perf_counter() - t0
+
+        # steady-state step on the new epoch (post-compile)
+        t0 = time.perf_counter()
+        jax.block_until_ready(comm.all_reduce(stacked))
+        t_step = time.perf_counter() - t0
+
+        transitions.append(
+            {
+                "from": prev,
+                "to": size,
+                "mesh_s": round(t_mesh, 4),
+                "rebroadcast_s": round(t_compile_bcast, 4),
+                "post_step_s": round(t_step, 4),
+            }
+        )
+        prev = size
+    total = sum(t["mesh_s"] + t["rebroadcast_s"] for t in transitions[1:])
+    result = {
+        "metric": "resize_transition_latency",
+        "value": round(total / max(1, len(transitions) - 1), 4),
+        "unit": "s/transition",
+        "transitions": transitions,
+        "param_mib": args.param_mib,
+    }
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main()

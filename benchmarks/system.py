@@ -1,0 +1,169 @@
+#!/usr/bin/env python3
+"""Synthetic training-throughput benchmark (img/sec or tokens/sec).
+
+Parity with reference ``benchmarks/system/benchmark_kungfu.py`` (Horovod-
+style synthetic data, ``--kf-optimizer=sync-sgd --model=ResNet50
+--batch-size=64``): drives the framework's real models + distributed
+optimizers on synthetic batches over all local devices (data-parallel
+mesh), reporting samples/sec.
+
+    python benchmarks/system.py --model resnet50 --optimizer sync-sgd
+    python benchmarks/system.py --model transformer --optimizer gns --quick
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def build_model(name: str, quick: bool):
+    if name == "resnet50":
+        from kungfu_tpu.models.resnet import ResNet
+
+        img = 64 if quick else 224
+        model = ResNet(depth=50, num_classes=1000)
+
+        def make_batch(rng, batch):
+            x = rng.standard_normal((batch, img, img, 3)).astype(np.float32)
+            y = rng.integers(0, 1000, size=(batch,))
+            return jnp.asarray(x), jnp.asarray(y)
+
+        # BN running stats ride in the tree with zero grads (train mode
+        # uses batch stats); their EMA update is skipped — irrelevant to
+        # a throughput measurement, keeps the loss a pure fn of (tree, batch)
+        def loss_fn(tree, batch):
+            x, y = batch
+            logits, _ = model.apply(tree["params"], tree["bn"], x, train=True)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+        params, bn = model.init(jax.random.PRNGKey(0))
+        return {"params": params, "bn": bn}, loss_fn, make_batch
+
+    if name == "transformer":
+        from kungfu_tpu.models.transformer import Transformer, TransformerConfig
+
+        cfg = (
+            TransformerConfig(vocab_size=1000, d_model=128, n_layers=2,
+                              n_heads=4, d_ff=256, max_seq=128)
+            if quick
+            else TransformerConfig(vocab_size=32128, d_model=768, n_layers=12,
+                                   n_heads=12, d_ff=3072, max_seq=512)
+        )
+        model = Transformer(cfg)
+
+        def make_batch(rng, batch):
+            ids = rng.integers(0, cfg.vocab_size, size=(batch, cfg.max_seq))
+            return jnp.asarray(ids, jnp.int32), jnp.asarray(ids, jnp.int32)
+
+        def loss_fn(params, batch):
+            ids, tgt = batch
+            logits = model.apply(params, ids)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, tgt).mean()
+
+        params = model.init(jax.random.PRNGKey(0))
+        return params, loss_fn, make_batch
+
+    raise ValueError(f"unknown model {name!r}")
+
+
+def build_optimizer(name: str, axis, batch: int):
+    from kungfu_tpu.optimizers import (
+        monitor_gradient_noise_scale,
+        monitor_gradient_variance,
+        synchronous_averaging,
+        synchronous_sgd,
+    )
+
+    inner = optax.sgd(1e-3, momentum=0.9)
+    if name == "sync-sgd":
+        return synchronous_sgd(inner, axis), True
+    if name == "sma":
+        return synchronous_averaging(inner, axis, alpha=0.1), False
+    if name == "gns":
+        return monitor_gradient_noise_scale(inner, axis, local_batch_size=batch), True
+    if name == "variance":
+        return monitor_gradient_variance(inner, axis), True
+    raise ValueError(f"unknown optimizer {name!r}")
+
+
+def main(argv=None) -> dict:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50",
+                   choices=["resnet50", "transformer"])
+    p.add_argument("--optimizer", default="sync-sgd",
+                   choices=["sync-sgd", "sma", "gns", "variance"])
+    p.add_argument("--batch-size", type=int, default=0, help="per-device")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--quick", action="store_true")
+    p.add_argument("--cpu-mesh", type=int, default=0, metavar="N",
+                   help="force an N-device virtual CPU mesh (test/CI mode)")
+    args = p.parse_args(argv)
+
+    if args.cpu_mesh:
+        # before any backend init; env vars are too late when jax is preloaded
+        jax.config.update("jax_num_cpu_devices", args.cpu_mesh)
+        jax.config.update("jax_platforms", "cpu")
+
+    from kungfu_tpu.comm.device import Communicator
+    from kungfu_tpu.parallel.train import dp_train_step, stack_for_replicas
+
+    comm = Communicator()
+    n = comm.size
+    on_tpu = jax.devices()[0].platform == "tpu"
+    batch = args.batch_size or (64 if on_tpu else 4)
+    if args.quick:
+        args.steps, args.warmup, batch = 5, 1, 2
+
+    params, loss_fn, make_batch = build_model(args.model, args.quick or not on_tpu)
+    tx, replicated = build_optimizer(args.optimizer, comm.axis, batch)
+    step = dp_train_step(loss_fn, tx, comm, replicated_params=replicated)
+    opt_state = tx.init(params)
+    if not replicated:
+        params = stack_for_replicas(params, n)
+        opt_state = stack_for_replicas(opt_state, n)
+
+    rng = np.random.default_rng(0)
+    global_batch = batch * n
+    batch0 = make_batch(rng, global_batch)
+    params, opt_state, loss = step(params, opt_state, batch0)  # compile
+    jax.block_until_ready(loss)
+
+    times = []
+    for i in range(args.warmup + args.steps):
+        b = make_batch(rng, global_batch)
+        t0 = time.perf_counter()
+        params, opt_state, loss = step(params, opt_state, b)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        if i >= args.warmup:
+            times.append(dt)
+
+    sps = global_batch * len(times) / sum(times)
+    unit = "images/sec" if args.model == "resnet50" else "sequences/sec"
+    result = {
+        "metric": f"{args.model}_{args.optimizer}_throughput",
+        "value": round(sps, 2),
+        "unit": unit,
+        "np": n,
+        "global_batch": global_batch,
+        "final_loss": float(loss),
+    }
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -101,6 +101,7 @@ class CollectiveEngine:
         from concurrent.futures import ThreadPoolExecutor
 
         self._pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="kf-engine")
+        self._async_pool: Optional[ThreadPoolExecutor] = None
         # per-strategy-pair accounting for adaptation: cumulative
         # (bytes, seconds), a recent window (reset on throughputs()), and
         # the best window rate ever observed (the reference compares recent
@@ -227,10 +228,28 @@ class CollectiveEngine:
             self._send(nxt, tag + ".b", buf.tobytes())
         return buf
 
+    def async_pool(self):
+        """Per-engine executor for caller-level async collectives (torch
+        binding et al.).  Per-engine — never shared across in-process
+        engines — and FIFO with the caller's deterministic submission
+        order, so equal-sized pools run identical op prefixes on every
+        rank and cannot cross-starve.  Distinct from ``_pool`` (the chunk
+        pool) so a blocked caller-level op cannot occupy a chunk slot."""
+        with self._lock:
+            if self._async_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._async_pool = ThreadPoolExecutor(
+                    max_workers=8, thread_name_prefix="kf-engine-async"
+                )
+            return self._async_pool
+
     def close(self) -> None:
-        """Shut the chunk worker pool down (engines are rebuilt per mesh
+        """Shut the worker pools down (engines are rebuilt per mesh
         epoch; leaking 8 threads per epoch would grow unboundedly)."""
         self._pool.shutdown(wait=False)
+        if self._async_pool is not None:
+            self._async_pool.shutdown(wait=False)
 
     # -- adaptation hooks ------------------------------------------------
     def throughputs(self) -> List[float]:

@@ -16,7 +16,7 @@ from kungfu_tpu.parallel.tp import (
     tp_region_enter,
     tp_region_exit,
 )
-from kungfu_tpu.parallel.train import ShardedTrainer
+from kungfu_tpu.parallel.train import ShardedTrainer, dp_train_step
 
 __all__ = [
     "AXES",

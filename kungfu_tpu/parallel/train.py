@@ -382,3 +382,63 @@ class ShardedTrainer:
         ids = jax.device_put(jnp.asarray(ids), bspec)
         targets = jax.device_put(jnp.asarray(targets), bspec)
         return jax.jit(f)(state["params"], ids, targets)
+
+
+def dp_train_step(loss_fn, tx, comm, replicated_params: bool = True):
+    """Pure data-parallel training step over a
+    :class:`~kungfu_tpu.comm.device.Communicator` mesh.
+
+    The DP-only analog of :class:`ShardedTrainer` (and of the reference's
+    whole training model — S-SGD over gradient buffers): ``loss_fn(params,
+    batch) -> scalar`` runs per device on the batch shard, ``tx`` is any
+    :mod:`kungfu_tpu.optimizers` transform bound to ``comm.axis`` (it does
+    the gradient/weight collective).
+
+    ``replicated_params=True`` (S-SGD/GNS/variance: psummed grads keep
+    params identical) holds one replicated copy.  ``False`` (SMA/
+    AdaptiveSGD: each replica owns diverging weights) expects params and
+    opt_state **stacked** on a leading ``comm.size`` axis.
+
+    Returns ``step(params, opt_state, batch) -> (params, opt_state, loss)``
+    jitted over the mesh; ``batch`` leading axis must be divisible by
+    ``comm.size``.
+    """
+    mesh, axis = comm.mesh, comm.axis
+    pspec = P() if replicated_params else P(axis)
+
+    def per_device(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, new_state = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_state, jax.lax.pmean(loss, axis)
+
+    def per_device_stacked(params, opt_state, batch):
+        # strip/restore the per-replica leading axis around the same body
+        squeeze = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
+        unsqueeze = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
+        p, s, l = per_device(squeeze(params), squeeze(opt_state), batch)
+        return unsqueeze(p), unsqueeze(s), l
+
+    def batch_spec(x):
+        return P(axis) if hasattr(x, "ndim") and x.ndim > 0 else P()
+
+    def step(params, opt_state, batch):
+        bspecs = jax.tree_util.tree_map(batch_spec, batch)
+        f = shard_map(
+            per_device if replicated_params else per_device_stacked,
+            mesh=mesh,
+            in_specs=(pspec, pspec, bspecs),
+            out_specs=(pspec, pspec, P()),
+            check_vma=False,
+        )
+        return f(params, opt_state, batch)
+
+    return jax.jit(step)
+
+
+def stack_for_replicas(tree, n: int):
+    """Tile a pytree onto a leading replica axis (for
+    ``dp_train_step(replicated_params=False)``)."""
+    return jax.tree_util.tree_map(
+        lambda a: jnp.broadcast_to(a, (n,) + jnp.shape(a)), tree
+    )

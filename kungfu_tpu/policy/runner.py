@@ -15,8 +15,7 @@ from __future__ import annotations
 
 from typing import Iterable, Optional, Tuple
 
-import numpy as np
-
+from kungfu_tpu.elastic.hooks import sync_step
 from kungfu_tpu.initializer import broadcast_parameters
 from kungfu_tpu.policy.base import BasePolicy, PolicyContext
 from kungfu_tpu.utils.log import get_logger, log_event
@@ -71,6 +70,11 @@ class PolicyRunner:
         """Run after each optimizer step.  Returns ``(params, stop)``;
         ``params`` are re-broadcast from rank 0 when membership changed."""
         ctx = self.ctx
+        # cluster-wide step re-sync FIRST (same ordering as elastic_step:
+        # this is each step's one engine control op, and it aligns a
+        # joiner's local step 0 with the survivors before policies run)
+        if self.peer is not None:
+            ctx.step = sync_step(self.peer, ctx.step)
         ctx.step += 1
         ctx.trained_samples += ctx.batch_size * ctx.cluster_size
         if gradient_noise_scale is not None:
@@ -104,13 +108,10 @@ class PolicyRunner:
                 return params, True
             ctx.cluster_size = peer.size()
             if params is not None:
+                # host-channel broadcast only — NO engine collective after a
+                # resize (kungfu_tpu/elastic/hooks.py alignment invariant: the
+                # new epoch's first engine op must be the next step's gradient
+                # allreduce on every member; step alignment happens at the top
+                # of the next after_step via sync_step)
                 params = broadcast_parameters(params, peer)
-            ctx.step = self._sync_step(ctx.step)
         return params, stop
-
-    def _sync_step(self, step: int) -> int:
-        engine = self.peer.engine() if self.peer is not None else None
-        if engine is None:
-            return step
-        out = engine.all_reduce(np.array([step], np.int64), op="max")
-        return int(out[0])

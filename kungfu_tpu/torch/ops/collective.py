@@ -38,20 +38,6 @@ def _next_name(kind: str) -> str:
     return f"torch.{kind}.{n}"
 
 
-def _spawn(fn, *args) -> Future:
-    # one thread per outstanding op, NOT a bounded shared pool: collectives
-    # block on remote ranks, so a fixed pool shared by several in-process
-    # engines can fill with waiters and starve the rank they wait for
-    fut: Future = Future()
-
-    def run():
-        try:
-            fut.set_result(fn(*args))
-        except BaseException as e:  # noqa: BLE001
-            fut.set_exception(e)
-
-    threading.Thread(target=run, daemon=True, name="kf-torch-ar").start()
-    return fut
 
 
 def _default_engine():
@@ -102,7 +88,10 @@ def all_reduce_async(
         f.set_result(None)
         return (f, t)
     a = clib.to_numpy(t)
-    fut = _spawn(engine.all_reduce, a, op, nm)
+    # the engine's per-engine async pool: reused threads, and never shared
+    # across in-process engines (a bounded pool shared by several engines
+    # can fill with waiters and starve the rank they wait for)
+    fut = engine.async_pool().submit(engine.all_reduce, a, op, nm)
     return (fut, t)
 
 

@@ -1,0 +1,54 @@
+"""Benchmark-harness smoke tests (the reference runs its benches in CI:
+ci.yaml adaptation bench step, monitor bench)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_bench(script, *args, timeout=300):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", script), "--quick", *args],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO, env=env,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def test_bus_bandwidth_formula():
+    sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+    from allreduce import bus_bandwidth
+
+    assert bus_bandwidth(1 << 30, 2, 1.0) == pytest.approx(1.0)
+    assert bus_bandwidth(1 << 30, 4, 0.5) == pytest.approx(3.0)
+
+
+@pytest.mark.slow
+class TestHarnesses:
+    def test_allreduce_host(self):
+        out = run_bench("allreduce.py", "--backend", "host", "--np", "2")
+        assert out["metric"] == "allreduce_bus_bandwidth"
+        assert out["value"] > 0
+
+    def test_allreduce_device(self):
+        out = run_bench("allreduce.py", "--cpu-mesh", "4")
+        assert out["np"] == 4
+        assert out["value"] > 0
+
+    def test_system_transformer(self):
+        out = run_bench("system.py", "--model", "transformer",
+                        "--optimizer", "sync-sgd", "--cpu-mesh", "2")
+        assert out["value"] > 0
+        assert out["final_loss"] > 0
+
+    def test_adaptation(self):
+        out = run_bench("adaptation.py", "--cpu-mesh", "4")
+        assert out["metric"] == "resize_transition_latency"
+        assert len(out["transitions"]) >= 2
