@@ -24,13 +24,23 @@ Wire format (little-endian), one message per connection:
     magic u32 | token u32 | conn_type u8 | src_len u16 | src utf8
     | name_len u16 | name utf8 | payload_len u32 | payload
 
-A future C++ transport (kungfu_tpu/native) can replace the socket loop
-behind the same API.
+Two interoperable backends implement the same wire format and API:
+
+* :class:`NativeHostChannel` — the accept loop, framed decode, rendezvous
+  queues, fencing, and pooled sender run in **C++ threads**
+  (:file:`kungfu_tpu/native/transport.cpp`), the analog of the
+  reference's native Go transport;
+* :class:`PyHostChannel` — pure-Python sockets, always available.
+
+:func:`HostChannel` is the factory; select with ``KF_TPU_HOST_TRANSPORT``
+(``native`` | ``python`` | ``auto``, default auto = native when the
+toolchain/.so is available).
 """
 
 from __future__ import annotations
 
 import enum
+import os
 import queue
 import socket
 import socketserver
@@ -104,8 +114,71 @@ def _decode(sock: socket.socket) -> _Msg:
     return _Msg(token, conn_type, src, name, payload)
 
 
-class HostChannel:
-    """Per-process message endpoint.
+class _ChannelOps:
+    """Control-plane collectives shared by both backends (star-rooted at
+    rank 0: fine for control traffic — small payloads, infrequent; the
+    device plane handles bulk data)."""
+
+    def wait(self, peer: PeerID, timeout: float = 120.0) -> None:
+        """Poll-ping until the peer is up (reference ``client.go:47-59``)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.ping(peer):
+                return
+            time.sleep(CONNECT_RETRY_PERIOD_S)
+        raise TimeoutError(f"peer {peer} not up after {timeout}s")
+
+    def _rank(self, peers: PeerList) -> int:
+        r = peers.rank(self.self_id)
+        if r is None:
+            raise RuntimeError(f"{self.self_id} not in {peers}")
+        return r
+
+    def gather_bytes(self, data: bytes, peers: PeerList, name: str) -> Optional[List[bytes]]:
+        """Root (rank 0) returns all peers' payloads in rank order."""
+        rank = self._rank(peers)
+        if rank == 0:
+            out = [data]
+            for p in list(peers)[1:]:
+                out.append(self.recv(p, name))
+            return out
+        self.send(peers[0], name, data)
+        return None
+
+    def broadcast_bytes(self, data: Optional[bytes], peers: PeerList, name: str) -> bytes:
+        rank = self._rank(peers)
+        if rank == 0:
+            assert data is not None
+            for p in list(peers)[1:]:
+                self.send(p, name, data)
+            return data
+        return self.recv(peers[0], name)
+
+    def allgather_bytes(self, data: bytes, peers: PeerList, name: str) -> List[bytes]:
+        gathered = self.gather_bytes(data, peers, name + ".g")
+        if self._rank(peers) == 0:
+            blob = _pack_list(gathered)
+        else:
+            blob = None
+        return _unpack_list(self.broadcast_bytes(blob, peers, name + ".b"))
+
+    def barrier(self, peers: PeerList, name: str = "barrier") -> None:
+        self.gather_bytes(b"", peers, name + ".in")
+        self.broadcast_bytes(b"" if self._rank(peers) == 0 else None, peers, name + ".out")
+
+    def consensus_bytes(self, data: bytes, peers: PeerList, name: str = "consensus") -> bool:
+        """True iff all peers supplied identical bytes
+        (control-plane analog of ``session.go:124-155``)."""
+        gathered = self.gather_bytes(data, peers, name + ".g")
+        if self._rank(peers) == 0:
+            ok = all(g == gathered[0] for g in gathered)
+            self.broadcast_bytes(b"\x01" if ok else b"\x00", peers, name + ".b")
+            return ok
+        return self.broadcast_bytes(None, peers, name + ".b") == b"\x01"
+
+
+class PyHostChannel(_ChannelOps):
+    """Pure-Python backend.
 
     ``token`` is the cluster version; bump it with :meth:`set_token` on
     membership change — COLLECTIVE queues of older epochs are purged and
@@ -117,7 +190,7 @@ class HostChannel:
         self._token = token
         #: optional NetMonitor recording egress/ingress byte counts
         self.monitor = monitor
-        self._queues: Dict[Tuple[int, str, str], queue.Queue] = {}
+        self._queues: Dict[Tuple[int, str, str, int], queue.Queue] = {}
         self._qlock = threading.Lock()
         self._control_handlers = []
         self._p2p_handlers = []
@@ -314,65 +387,130 @@ class HostChannel:
         except (OSError, ValueError, ConnectionError):
             return False
 
-    def wait(self, peer: PeerID, timeout: float = 120.0) -> None:
-        """Poll-ping until the peer is up (reference ``client.go:47-59``)."""
-        deadline = time.time() + timeout
-        while time.time() < deadline:
-            if self.ping(peer):
+
+class NativeHostChannel(_ChannelOps):
+    """C++ backend: same API and wire format, served by native threads
+    (:file:`kungfu_tpu/native/transport.cpp`).  Python is entered only
+    for registered control/p2p handlers and monitor accounting."""
+
+    def __init__(self, self_id: PeerID, token: int = 0, bind_host: str = "", monitor=None):
+        from kungfu_tpu.native.transport import NativeTransport
+
+        self.self_id = self_id
+        self.monitor = monitor
+        self._t = NativeTransport(
+            str(self_id), self_id.port, bind_host=bind_host, token=token
+        )
+        self._control_handlers = []
+        self._p2p_handlers = []
+        self._t.set_control_handler(self._run_handlers(self._control_handlers))
+        self._t.set_p2p_handler(self._run_handlers(self._p2p_handlers))
+        self._ingress_seen: Dict[str, int] = {}
+        self._ingress_stop = threading.Event()
+        self._ingress_thread: Optional[threading.Thread] = None
+        if monitor is not None:
+            # the C++ side counts ingress bytes; feed deltas to the
+            # NetMonitor at its own sampling granularity
+            self._ingress_thread = threading.Thread(
+                target=self._ingress_poll, daemon=True
+            )
+            self._ingress_thread.start()
+
+    @staticmethod
+    def _run_handlers(handlers):
+        def run(name: str, payload: bytes, src: str) -> bool:
+            if not handlers:
+                return False  # fall through to the C++ rendezvous queue
+            for h in list(handlers):
+                h(name, payload, src)
+            return True
+
+        return run
+
+    def _ingress_poll(self) -> None:
+        while not self._ingress_stop.wait(0.5):
+            try:
+                totals = self._t.ingress_totals()
+            except Exception:  # noqa: BLE001 - channel torn down mid-poll
                 return
-            time.sleep(CONNECT_RETRY_PERIOD_S)
-        raise TimeoutError(f"peer {peer} not up after {timeout}s")
+            for src, total in totals.items():
+                delta = total - self._ingress_seen.get(src, 0)
+                if delta > 0:
+                    self._ingress_seen[src] = total
+                    self.monitor.ingress(src, delta)
 
-    # -- control-plane collectives over a peer list ----------------------
-    # Star-rooted at rank 0: fine for control traffic (small payloads,
-    # infrequent); the device plane handles bulk data.
-    def _rank(self, peers: PeerList) -> int:
-        r = peers.rank(self.self_id)
-        if r is None:
-            raise RuntimeError(f"{self.self_id} not in {peers}")
-        return r
+    # -- lifecycle -------------------------------------------------------
+    def close(self) -> None:
+        self._ingress_stop.set()
+        if self._ingress_thread is not None:
+            # the poll thread must be out of the native handle before the
+            # C++ channel is freed (a poll on a freed handle is a segfault,
+            # not an exception)
+            self._ingress_thread.join(timeout=5)
+            if self._ingress_thread.is_alive():
+                # monitor sink wedged: leaking the native channel beats a
+                # guaranteed segfault in the still-running poll thread
+                _log.warning("ingress poll thread stuck; leaking native channel")
+                return
+            self._ingress_thread = None
+        self._t.close()
 
-    def gather_bytes(self, data: bytes, peers: PeerList, name: str) -> Optional[List[bytes]]:
-        """Root (rank 0) returns all peers' payloads in rank order."""
-        rank = self._rank(peers)
-        if rank == 0:
-            out = [data]
-            for p in list(peers)[1:]:
-                out.append(self.recv(p, name))
-            return out
-        self.send(peers[0], name, data)
-        return None
+    def set_token(self, token: int) -> None:
+        self._t.set_token(token)
 
-    def broadcast_bytes(self, data: Optional[bytes], peers: PeerList, name: str) -> bytes:
-        rank = self._rank(peers)
-        if rank == 0:
-            assert data is not None
-            for p in list(peers)[1:]:
-                self.send(p, name, data)
-            return data
-        return self.recv(peers[0], name)
+    @property
+    def token(self) -> int:
+        return self._t.token
 
-    def allgather_bytes(self, data: bytes, peers: PeerList, name: str) -> List[bytes]:
-        gathered = self.gather_bytes(data, peers, name + ".g")
-        if self._rank(peers) == 0:
-            blob = _pack_list(gathered)
-        else:
-            blob = None
-        return _unpack_list(self.broadcast_bytes(blob, peers, name + ".b"))
+    def on_control(self, handler) -> None:
+        self._control_handlers.append(handler)
 
-    def barrier(self, peers: PeerList, name: str = "barrier") -> None:
-        self.gather_bytes(b"", peers, name + ".in")
-        self.broadcast_bytes(b"" if self._rank(peers) == 0 else None, peers, name + ".out")
+    def on_p2p_request(self, handler) -> None:
+        self._p2p_handlers.append(handler)
 
-    def consensus_bytes(self, data: bytes, peers: PeerList, name: str = "consensus") -> bool:
-        """True iff all peers supplied identical bytes
-        (control-plane analog of ``session.go:124-155``)."""
-        gathered = self.gather_bytes(data, peers, name + ".g")
-        if self._rank(peers) == 0:
-            ok = all(g == gathered[0] for g in gathered)
-            self.broadcast_bytes(b"\x01" if ok else b"\x00", peers, name + ".b")
-            return ok
-        return self.broadcast_bytes(None, peers, name + ".b") == b"\x01"
+    # -- client side -----------------------------------------------------
+    def send(
+        self,
+        peer: PeerID,
+        name: str,
+        payload: bytes,
+        conn_type: ConnType = ConnType.COLLECTIVE,
+        retries: int = CONNECT_RETRIES,
+    ) -> None:
+        if self.monitor is not None:
+            self.monitor.egress(str(peer), len(payload))
+        self._t.send(str(peer), name, payload, int(conn_type), retries)
+
+    def recv(
+        self, src: PeerID, name: str, conn_type: ConnType = ConnType.COLLECTIVE,
+        timeout: Optional[float] = 60.0,
+    ) -> bytes:
+        return self._t.recv(str(src), name, int(conn_type), timeout)
+
+    def ping(self, peer: PeerID, timeout: float = 10.0) -> bool:
+        return self._t.ping(str(peer), timeout)
+
+    def reset_connections(self) -> None:
+        self._t.reset_connections()
+
+
+def _backend() -> str:
+    mode = os.environ.get("KF_TPU_HOST_TRANSPORT", "auto").lower()
+    if mode in ("native", "python"):
+        return mode
+    from kungfu_tpu.native import transport as _nt
+
+    return "native" if _nt.available() else "python"
+
+
+def HostChannel(self_id: PeerID, token: int = 0, bind_host: str = "", monitor=None):
+    """Factory: the native (C++) channel when available, else Python."""
+    if _backend() == "native":
+        try:
+            return NativeHostChannel(self_id, token=token, bind_host=bind_host, monitor=monitor)
+        except RuntimeError:  # toolchain raced away; stay functional
+            _log.warning("native transport unavailable, using python backend")
+    return PyHostChannel(self_id, token=token, bind_host=bind_host, monitor=monitor)
 
 
 def _pack_list(items: List[bytes]) -> bytes:

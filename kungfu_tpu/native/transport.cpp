@@ -1,0 +1,638 @@
+// Native host-side message transport — the C++ rchannel equivalent.
+//
+// Wire-compatible with kungfu_tpu/comm/host.py (little-endian framing:
+//   magic u32 | token u32 | conn_type u8 | src_len u16 | src
+//   | name_len u16 | name | payload_len u32 | payload
+// ), so a native channel and a Python channel interoperate freely.
+// This is the TPU build's analog of the reference's Go transport
+// (srcs/go/rchannel/{connection,client,server,handler}): typed named
+// messages over TCP, rendezvous-by-name receive queues keyed by the
+// cluster-version token (fencing, connection.go:28-47,77-87), pooled
+// per-peer sender connections (client/connection_pool.go), 500x200ms
+// connect retries (config.go:16-18), and ping echo (handler/ping.go).
+//
+// Exposed as a flat C API consumed via ctypes (no pybind11 in this
+// environment); see kungfu_tpu/native/transport.py.
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x4B465450;  // "KFTP"
+constexpr int kConnPing = 1;
+constexpr int kConnControl = 2;
+constexpr int kConnCollective = 3;
+constexpr int kConnPeerToPeer = 4;
+
+// callback: return 0 if consumed, nonzero to fall through to the queue
+using msg_cb = int (*)(const char *name, const uint8_t *payload,
+                       uint32_t len, const char *src);
+
+struct Msg {
+    uint32_t token = 0;
+    uint8_t conn_type = 0;
+    std::string src;
+    std::string name;
+    std::string payload;
+};
+
+bool read_exact(int fd, void *buf, size_t n) {
+    auto *p = static_cast<char *>(buf);
+    while (n > 0) {
+        ssize_t r = ::read(fd, p, n);
+        if (r <= 0) { return false; }
+        p += r;
+        n -= static_cast<size_t>(r);
+    }
+    return true;
+}
+
+bool write_all(int fd, const void *buf, size_t n) {
+    const auto *p = static_cast<const char *>(buf);
+    while (n > 0) {
+        ssize_t r = ::write(fd, p, n);
+        if (r <= 0) { return false; }
+        p += r;
+        n -= static_cast<size_t>(r);
+    }
+    return true;
+}
+
+void put_u16(std::string &out, uint16_t v) {
+    char b[2] = {static_cast<char>(v & 0xff), static_cast<char>(v >> 8)};
+    out.append(b, 2);
+}
+
+void put_u32(std::string &out, uint32_t v) {
+    char b[4];
+    for (int i = 0; i < 4; ++i) { b[i] = static_cast<char>((v >> (8 * i)) & 0xff); }
+    out.append(b, 4);
+}
+
+uint16_t get_u16(const uint8_t *p) {
+    return static_cast<uint16_t>(p[0] | (p[1] << 8));
+}
+
+uint32_t get_u32(const uint8_t *p) {
+    return static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
+           (static_cast<uint32_t>(p[2]) << 16) |
+           (static_cast<uint32_t>(p[3]) << 24);
+}
+
+std::string encode_msg(uint32_t token, uint8_t conn_type, const std::string &src,
+                       const std::string &name, const uint8_t *payload,
+                       uint32_t payload_len) {
+    std::string out;
+    out.reserve(17 + src.size() + name.size() + payload_len);
+    put_u32(out, kMagic);
+    put_u32(out, token);
+    out.push_back(static_cast<char>(conn_type));
+    put_u16(out, static_cast<uint16_t>(src.size()));
+    out.append(src);
+    put_u16(out, static_cast<uint16_t>(name.size()));
+    out.append(name);
+    put_u32(out, payload_len);
+    if (payload_len > 0) { out.append(reinterpret_cast<const char *>(payload), payload_len); }
+    return out;
+}
+
+bool decode_msg(int fd, Msg &m) {
+    uint8_t head[11];
+    if (!read_exact(fd, head, sizeof(head))) { return false; }
+    if (get_u32(head) != kMagic) { return false; }
+    m.token = get_u32(head + 4);
+    m.conn_type = head[8];
+    uint16_t src_len = get_u16(head + 9);
+    m.src.resize(src_len);
+    if (src_len && !read_exact(fd, &m.src[0], src_len)) { return false; }
+    uint8_t nl[2];
+    if (!read_exact(fd, nl, 2)) { return false; }
+    uint16_t name_len = get_u16(nl);
+    m.name.resize(name_len);
+    if (name_len && !read_exact(fd, &m.name[0], name_len)) { return false; }
+    uint8_t pl[4];
+    if (!read_exact(fd, pl, 4)) { return false; }
+    uint32_t payload_len = get_u32(pl);
+    m.payload.resize(payload_len);
+    if (payload_len && !read_exact(fd, &m.payload[0], payload_len)) { return false; }
+    return true;
+}
+
+bool split_peer(const std::string &peer, std::string &host, uint16_t &port) {
+    auto pos = peer.rfind(':');
+    if (pos == std::string::npos) { return false; }
+    host = peer.substr(0, pos);
+    long p = ::strtol(peer.c_str() + pos + 1, nullptr, 10);
+    if (p <= 0 || p > 65535) { return false; }
+    port = static_cast<uint16_t>(p);
+    return true;
+}
+
+int connect_once(const std::string &host, uint16_t port, double timeout_s) {
+    // peer specs may carry hostnames, not just dotted quads (the Python
+    // backend resolves via create_connection) — use getaddrinfo
+    struct addrinfo hints;
+    std::memset(&hints, 0, sizeof(hints));
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo *res = nullptr;
+    if (::getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints, &res) != 0 ||
+        res == nullptr) {
+        return -1;
+    }
+    int fd = -1;
+    for (struct addrinfo *ai = res; ai != nullptr; ai = ai->ai_next) {
+        fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+        if (fd < 0) { continue; }
+        if (timeout_s > 0) {
+            struct timeval tv;
+            tv.tv_sec = static_cast<long>(timeout_s);
+            tv.tv_usec = static_cast<long>((timeout_s - tv.tv_sec) * 1e6);
+            ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+            ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+        }
+        if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) { break; }
+        ::close(fd);
+        fd = -1;
+    }
+    ::freeaddrinfo(res);
+    if (fd < 0) { return -1; }
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    return fd;
+}
+
+struct QueueKey {
+    uint8_t conn_type;
+    std::string src;
+    std::string name;
+    uint32_t token;  // 0 for non-collective
+    bool operator<(const QueueKey &o) const {
+        if (conn_type != o.conn_type) { return conn_type < o.conn_type; }
+        if (src != o.src) { return src < o.src; }
+        if (name != o.name) { return name < o.name; }
+        return token < o.token;
+    }
+};
+
+struct PoolEntry {
+    std::mutex mu;      // serializes senders; held across connect retries
+    std::mutex fd_mu;   // guards fd open/close handoff; never held long
+    int fd = -1;
+    // ::close happens only under fd_mu (or in the destructor, when the
+    // last shared_ptr holder is by construction the only thread left);
+    // reset_connections only ever shutdown()s under fd_mu, so it can
+    // neither race a sender's close nor hit a kernel-recycled fd number
+    ~PoolEntry() {
+        if (fd >= 0) { ::close(fd); }
+    }
+    void retire_fd() {
+        std::lock_guard<std::mutex> lk(fd_mu);
+        if (fd >= 0) {
+            ::close(fd);
+            fd = -1;
+        }
+    }
+    void install_fd(int new_fd) {
+        std::lock_guard<std::mutex> lk(fd_mu);
+        fd = new_fd;
+    }
+};
+
+struct ConnSlot {
+    int fd = -1;
+    std::thread thread;
+    std::atomic<bool> done{false};
+};
+
+class Channel {
+  public:
+    Channel(std::string self_spec, const std::string &bind_host, uint16_t port,
+            uint32_t token)
+        : self_(std::move(self_spec)), token_(token) {
+        listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+        if (listen_fd_ < 0) { return; }
+        int one = 1;
+        ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+        struct sockaddr_in addr;
+        std::memset(&addr, 0, sizeof(addr));
+        addr.sin_family = AF_INET;
+        addr.sin_port = htons(port);
+        if (bind_host.empty() || bind_host == "0.0.0.0") {
+            addr.sin_addr.s_addr = INADDR_ANY;
+        } else if (::inet_pton(AF_INET, bind_host.c_str(), &addr.sin_addr) != 1) {
+            ::close(listen_fd_);
+            listen_fd_ = -1;
+            return;
+        }
+        if (::bind(listen_fd_, reinterpret_cast<struct sockaddr *>(&addr),
+                   sizeof(addr)) != 0 ||
+            ::listen(listen_fd_, 128) != 0) {
+            ::close(listen_fd_);
+            listen_fd_ = -1;
+            return;
+        }
+        running_ = true;
+        accept_thread_ = std::thread([this] { accept_loop(); });
+    }
+
+    bool ok() const { return listen_fd_ >= 0; }
+
+    ~Channel() { close_all(); }
+
+    void close_all() {
+        {
+            // running_ flips under q_mu_ and the wakeup is sent under it
+            // too, so a receiver that checked running_ and is about to
+            // wait cannot miss the shutdown notification
+            std::lock_guard<std::mutex> lk(q_mu_);
+            if (!running_.exchange(false)) {
+                // never started or already closed; still reap a half-open fd
+                if (listen_fd_ >= 0) { ::close(listen_fd_); listen_fd_ = -1; }
+                return;
+            }
+            cv_.notify_all();
+        }
+        // shutdown wakes the blocked accept(); the close waits until the
+        // accept thread has exited so the loop can never accept() on an
+        // fd number the kernel recycled for another socket
+        ::shutdown(listen_fd_, SHUT_RDWR);
+        if (accept_thread_.joinable()) { accept_thread_.join(); }
+        ::close(listen_fd_);
+        {
+            std::lock_guard<std::mutex> lk(conns_mu_);
+            for (auto &slot : conns_) {
+                if (slot->fd >= 0) { ::shutdown(slot->fd, SHUT_RDWR); }
+            }
+        }
+        // stream loops close their own fds on exit; join them all
+        for (auto &slot : conns_) {
+            if (slot->thread.joinable()) { slot->thread.join(); }
+        }
+        conns_.clear();
+        reset_connections();
+        listen_fd_ = -1;
+        // a blocked receiver woke with rc=2 (closed); wait until every
+        // recv call has actually left before the caller may delete us
+        std::unique_lock<std::mutex> lk(q_mu_);
+        cv_.wait(lk, [this] { return recv_inflight_ == 0; });
+    }
+
+    void set_token(uint32_t token) {
+        std::lock_guard<std::mutex> lk(q_mu_);
+        token_ = token;
+        for (auto it = queues_.begin(); it != queues_.end();) {
+            if (it->first.conn_type == kConnCollective && it->first.token < token) {
+                it = queues_.erase(it);
+            } else {
+                ++it;
+            }
+        }
+    }
+
+    uint32_t token() const { return token_.load(); }
+
+    void set_control_cb(msg_cb cb) { control_cb_ = cb; }
+    void set_p2p_cb(msg_cb cb) { p2p_cb_ = cb; }
+
+    // 0 ok, -1 unreachable
+    int send(const std::string &peer, const std::string &name,
+             const uint8_t *payload, uint32_t len, int conn_type, int retries) {
+        std::string host;
+        uint16_t port = 0;
+        if (!split_peer(peer, host, port)) { return -1; }
+        std::string data = encode_msg(token_.load(), static_cast<uint8_t>(conn_type),
+                                      self_, name, payload, len);
+        std::shared_ptr<PoolEntry> entry;
+        {
+            std::lock_guard<std::mutex> lk(pool_mu_);
+            auto &slot = pool_[peer];
+            if (!slot) { slot = std::make_shared<PoolEntry>(); }
+            entry = slot;
+        }
+        std::lock_guard<std::mutex> lk(entry->mu);
+        if (entry->fd < 0) {
+            int fd = connect_retry(host, port, retries);
+            if (fd < 0) { return -1; }
+            entry->install_fd(fd);
+        }
+        if (!write_all(entry->fd, data.data(), data.size())) {
+            // stale pooled socket (peer restarted): reconnect once.
+            // retire before the (potentially long) reconnect so a
+            // concurrent reset_connections sees fd=-1, not a dead number
+            entry->retire_fd();
+            int fd = connect_retry(host, port, retries);
+            if (fd < 0) { return -1; }
+            entry->install_fd(fd);
+            if (!write_all(entry->fd, data.data(), data.size())) {
+                entry->retire_fd();
+                return -1;
+            }
+        }
+        return 0;
+    }
+
+    // 0 ok (out/out_len set, caller frees), 1 timeout, 2 closed.
+    // timeout_s < 0 means wait forever (a huge finite value would
+    // overflow duration_cast into a deadline in the past).
+    int recv(const std::string &src, const std::string &name, int conn_type,
+             double timeout_s, uint8_t **out, uint32_t *out_len) {
+        QueueKey key{static_cast<uint8_t>(conn_type), src, name,
+                     conn_type == kConnCollective ? token_.load() : 0};
+        const bool forever = timeout_s < 0;
+        std::unique_lock<std::mutex> lk(q_mu_);
+        // close_all() blocks on this counter before the channel is freed
+        ++recv_inflight_;
+        struct Guard {
+            Channel *ch;
+            ~Guard() {
+                if (--ch->recv_inflight_ == 0) { ch->cv_.notify_all(); }
+            }
+        } guard{this};
+        auto deadline =
+            std::chrono::steady_clock::now() +
+            (forever ? std::chrono::steady_clock::duration::zero()
+                     : std::chrono::duration_cast<std::chrono::steady_clock::duration>(
+                           std::chrono::duration<double>(timeout_s)));
+        for (;;) {
+            auto it = queues_.find(key);
+            if (it != queues_.end() && !it->second.empty()) {
+                std::string payload = std::move(it->second.front());
+                it->second.pop_front();
+                // copy outside q_mu_: a multi-MB p2p blob must not
+                // head-of-line block dispatch and every other recv
+                lk.unlock();
+                *out_len = static_cast<uint32_t>(payload.size());
+                *out = static_cast<uint8_t *>(::malloc(payload.size() ? payload.size() : 1));
+                std::memcpy(*out, payload.data(), payload.size());
+                lk.lock();  // Guard's decrement runs under q_mu_
+                return 0;
+            }
+            if (!running_.load()) { return 2; }
+            if (forever) {
+                cv_.wait(lk);
+            } else if (cv_.wait_until(lk, deadline) == std::cv_status::timeout) {
+                return 1;
+            }
+        }
+    }
+
+    int ping(const std::string &peer, double timeout_s) {
+        std::string host;
+        uint16_t port = 0;
+        if (!split_peer(peer, host, port)) { return -1; }
+        int fd = connect_once(host, port, timeout_s);
+        if (fd < 0) { return -1; }
+        std::string data =
+            encode_msg(token_.load(), kConnPing, self_, "ping", nullptr, 0);
+        Msg reply;
+        int rc = (write_all(fd, data.data(), data.size()) && decode_msg(fd, reply))
+                     ? 0
+                     : -1;
+        ::close(fd);
+        return rc;
+    }
+
+    void reset_connections() {
+        std::vector<std::shared_ptr<PoolEntry>> entries;
+        {
+            std::lock_guard<std::mutex> lk(pool_mu_);
+            for (auto &kv : pool_) { entries.push_back(kv.second); }
+            pool_.clear();
+        }
+        // shutdown (not close) without taking the per-entry *send* lock:
+        // a sender stuck retrying toward a dead peer must not block the
+        // reset.  fd_mu makes the read-and-shutdown atomic against a
+        // sender's close-and-replace, and the actual close stays with
+        // the last shared_ptr holder (PoolEntry destructor)
+        for (auto &e : entries) {
+            std::lock_guard<std::mutex> lk(e->fd_mu);
+            if (e->fd >= 0) { ::shutdown(e->fd, SHUT_RDWR); }
+        }
+    }
+
+    // newline-separated "src bytes" ingress totals; returns bytes written
+    int ingress_snapshot(char *out, int cap) {
+        std::string s;
+        {
+            std::lock_guard<std::mutex> lk(stats_mu_);
+            for (auto &kv : ingress_) {
+                s += kv.first + " " + std::to_string(kv.second) + "\n";
+            }
+        }
+        int n = static_cast<int>(s.size());
+        if (n >= cap) { return -n; }  // caller retries with bigger buffer
+        std::memcpy(out, s.data(), s.size());
+        out[n] = '\0';
+        return n;
+    }
+
+  private:
+    int connect_retry(const std::string &host, uint16_t port, int retries) {
+        for (int i = 0; i < retries && running_.load(); ++i) {
+            int fd = connect_once(host, port, 10.0);
+            if (fd >= 0) { return fd; }
+            // reference: 500 x 200ms (config.go:16-18)
+            std::this_thread::sleep_for(std::chrono::milliseconds(200));
+        }
+        return -1;
+    }
+
+    void accept_loop() {
+        while (running_.load()) {
+            int fd = ::accept(listen_fd_, nullptr, nullptr);
+            if (fd < 0) {
+                if (!running_.load()) { return; }
+                continue;
+            }
+            int one = 1;
+            ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+            {
+                std::lock_guard<std::mutex> lk(conns_mu_);
+                // reap finished connections so short-lived clients (pings
+                // arrive on a fresh connection each) don't grow the
+                // registry — their fds were closed by their stream loops
+                for (auto it = conns_.begin(); it != conns_.end();) {
+                    if ((*it)->done.load()) {
+                        (*it)->thread.join();
+                        it = conns_.erase(it);
+                    } else {
+                        ++it;
+                    }
+                }
+                auto slot = std::make_shared<ConnSlot>();
+                slot->fd = fd;
+                slot->thread = std::thread([this, slot] { stream_loop(slot.get()); });
+                conns_.push_back(std::move(slot));
+            }
+        }
+    }
+
+    // one pooled client sends many messages per connection (reference
+    // Stream(), handler.go:30-41); the stream loop owns its fd's close.
+    // The close runs under conns_mu_ — the same lock close_all() holds
+    // while shutdown()ing open fds — so a shutdown can never hit an fd
+    // number the kernel has already recycled for an unrelated socket.
+    void stream_loop(ConnSlot *slot) {
+        Msg m;
+        while (running_.load() && decode_msg(slot->fd, m)) { dispatch(m, slot->fd); }
+        {
+            std::lock_guard<std::mutex> lk(conns_mu_);
+            ::close(slot->fd);
+            slot->fd = -1;
+        }
+        // done flips only after the fd is retired; the accept loop joins
+        // (reaps) exclusively done slots, so it never blocks on a thread
+        // that is itself waiting for conns_mu_
+        slot->done.store(true);
+    }
+
+    void dispatch(Msg &m, int fd) {
+        {
+            std::lock_guard<std::mutex> lk(stats_mu_);
+            ingress_[m.src] += m.payload.size();
+        }
+        if (m.conn_type == kConnPing) {
+            std::string reply =
+                encode_msg(token_.load(), kConnPing, self_, m.name, nullptr, 0);
+            write_all(fd, reply.data(), reply.size());
+            return;
+        }
+        if (m.conn_type == kConnControl && control_cb_ != nullptr) {
+            if (control_cb_(m.name.c_str(),
+                            reinterpret_cast<const uint8_t *>(m.payload.data()),
+                            static_cast<uint32_t>(m.payload.size()),
+                            m.src.c_str()) == 0) {
+                return;
+            }
+        }
+        if (m.conn_type == kConnPeerToPeer && p2p_cb_ != nullptr &&
+            m.name.rfind("req.", 0) == 0) {
+            if (p2p_cb_(m.name.c_str(),
+                        reinterpret_cast<const uint8_t *>(m.payload.data()),
+                        static_cast<uint32_t>(m.payload.size()),
+                        m.src.c_str()) == 0) {
+                return;
+            }
+        }
+        std::lock_guard<std::mutex> lk(q_mu_);
+        uint32_t qtoken = 0;
+        if (m.conn_type == kConnCollective) {
+            // fencing: queue under the sender's epoch; a stale-epoch
+            // arrival (older than current) can never be read — drop it.
+            // A future-epoch arrival is preserved (the sender already
+            // moved on and will not retry).
+            if (m.token < token_.load()) { return; }
+            qtoken = m.token;
+        }
+        queues_[QueueKey{m.conn_type, m.src, m.name, qtoken}].push_back(
+            std::move(m.payload));
+        cv_.notify_all();
+    }
+
+    std::string self_;
+    std::atomic<uint32_t> token_;
+    std::atomic<bool> running_{false};
+    int listen_fd_ = -1;
+    std::thread accept_thread_;
+
+    std::mutex conns_mu_;
+    std::vector<std::shared_ptr<ConnSlot>> conns_;
+
+    std::mutex q_mu_;
+    std::condition_variable cv_;
+    std::map<QueueKey, std::deque<std::string>> queues_;
+    int recv_inflight_ = 0;  // guarded by q_mu_
+
+    std::mutex pool_mu_;
+    std::map<std::string, std::shared_ptr<PoolEntry>> pool_;
+
+    // egress accounting lives on the Python side (NativeHostChannel.send
+    // feeds the NetMonitor directly); only ingress is counted natively
+    std::mutex stats_mu_;
+    std::map<std::string, uint64_t> ingress_;
+
+    msg_cb control_cb_ = nullptr;
+    msg_cb p2p_cb_ = nullptr;
+};
+
+}  // namespace
+
+extern "C" {
+
+void *kf_host_create(const char *self_spec, const char *bind_host,
+                     uint32_t port, uint32_t token) {
+    auto *ch = new Channel(self_spec, bind_host ? bind_host : "",
+                           static_cast<uint16_t>(port), token);
+    if (!ch->ok()) {
+        delete ch;
+        return nullptr;
+    }
+    return ch;
+}
+
+void kf_host_close(void *h) {
+    auto *ch = static_cast<Channel *>(h);
+    ch->close_all();
+    delete ch;
+}
+
+void kf_host_set_token(void *h, uint32_t token) {
+    static_cast<Channel *>(h)->set_token(token);
+}
+
+uint32_t kf_host_token(void *h) { return static_cast<Channel *>(h)->token(); }
+
+int kf_host_send(void *h, const char *peer, const char *name,
+                 const uint8_t *payload, uint32_t len, int conn_type,
+                 int retries) {
+    return static_cast<Channel *>(h)->send(peer, name, payload, len, conn_type,
+                                           retries);
+}
+
+int kf_host_recv(void *h, const char *src, const char *name, int conn_type,
+                 double timeout_s, uint8_t **out, uint32_t *out_len) {
+    return static_cast<Channel *>(h)->recv(src, name, conn_type, timeout_s, out,
+                                           out_len);
+}
+
+void kf_host_buf_free(uint8_t *p) { ::free(p); }
+
+int kf_host_ping(void *h, const char *peer, double timeout_s) {
+    return static_cast<Channel *>(h)->ping(peer, timeout_s);
+}
+
+void kf_host_reset_connections(void *h) {
+    static_cast<Channel *>(h)->reset_connections();
+}
+
+void kf_host_set_control_cb(void *h, msg_cb cb) {
+    static_cast<Channel *>(h)->set_control_cb(cb);
+}
+
+void kf_host_set_p2p_cb(void *h, msg_cb cb) {
+    static_cast<Channel *>(h)->set_p2p_cb(cb);
+}
+
+int kf_host_ingress_snapshot(void *h, char *out, int cap) {
+    return static_cast<Channel *>(h)->ingress_snapshot(out, cap);
+}
+
+}  // extern "C"

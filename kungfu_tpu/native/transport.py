@@ -1,0 +1,177 @@
+"""ctypes wrapper for the native C++ host transport (:file:`transport.cpp`).
+
+Gives :mod:`kungfu_tpu.comm.host` a drop-in native backend for its message
+channel: the accept loop, framed decode, rendezvous queues, token fencing,
+and the pooled sender all run in C++ threads, with Python entering only
+for control/p2p handler callbacks.  Falls back cleanly (``available()``
+False) when the toolchain is absent.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Callable, List, Optional
+
+from kungfu_tpu import native as _native
+
+# int cb(name, payload, len, src): return 0 if consumed, 1 to enqueue
+MSG_CB = ctypes.CFUNCTYPE(
+    ctypes.c_int,
+    ctypes.c_char_p,
+    ctypes.POINTER(ctypes.c_ubyte),
+    ctypes.c_uint32,
+    ctypes.c_char_p,
+)
+
+_proto_done = False
+
+
+def _lib():
+    global _proto_done
+    lib = _native.load()
+    if lib is None:
+        return None
+    if not hasattr(lib, "kf_host_create"):  # stale prebuilt .so without transport
+        return None
+    if not _proto_done:
+        lib.kf_host_create.restype = ctypes.c_void_p
+        lib.kf_host_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint32,
+        ]
+        lib.kf_host_close.argtypes = [ctypes.c_void_p]
+        lib.kf_host_set_token.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+        lib.kf_host_token.restype = ctypes.c_uint32
+        lib.kf_host_token.argtypes = [ctypes.c_void_p]
+        lib.kf_host_send.restype = ctypes.c_int
+        lib.kf_host_send.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_uint32, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.kf_host_recv.restype = ctypes.c_int
+        lib.kf_host_recv.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_double,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
+        lib.kf_host_buf_free.argtypes = [ctypes.POINTER(ctypes.c_ubyte)]
+        lib.kf_host_ping.restype = ctypes.c_int
+        lib.kf_host_ping.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_double]
+        lib.kf_host_reset_connections.argtypes = [ctypes.c_void_p]
+        lib.kf_host_set_control_cb.argtypes = [ctypes.c_void_p, MSG_CB]
+        lib.kf_host_set_p2p_cb.argtypes = [ctypes.c_void_p, MSG_CB]
+        lib.kf_host_ingress_snapshot.restype = ctypes.c_int
+        lib.kf_host_ingress_snapshot.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+        ]
+        _proto_done = True
+    return lib
+
+
+def available() -> bool:
+    return _lib() is not None
+
+
+class NativeTransport:
+    """One C++ channel endpoint.  Raises OSError if the port can't bind."""
+
+    def __init__(self, self_spec: str, port: int, bind_host: str = "", token: int = 0):
+        lib = _lib()
+        if lib is None:
+            raise RuntimeError("native transport unavailable")
+        self._libref = lib  # keep alive through interpreter teardown
+        self._h = lib.kf_host_create(
+            self_spec.encode(), (bind_host or "").encode(), port, token
+        )
+        if not self._h:
+            raise OSError(f"cannot bind native channel on port {port}")
+        # CFUNCTYPE objects must outlive the channel
+        self._cbs: List[object] = []
+
+    def close(self) -> None:
+        if self._h:
+            self._libref.kf_host_close(self._h)
+            self._h = None
+
+    def set_token(self, token: int) -> None:
+        self._libref.kf_host_set_token(self._h, token)
+
+    @property
+    def token(self) -> int:
+        return int(self._libref.kf_host_token(self._h))
+
+    def send(self, peer_spec: str, name: str, payload: bytes, conn_type: int,
+             retries: int) -> None:
+        rc = self._libref.kf_host_send(
+            self._h, peer_spec.encode(), name.encode(), payload, len(payload),
+            conn_type, retries,
+        )
+        if rc != 0:
+            raise ConnectionError(
+                f"cannot reach {peer_spec} after {retries} retries")
+
+    def recv(self, src_spec: str, name: str, conn_type: int,
+             timeout: Optional[float]) -> bytes:
+        out = ctypes.POINTER(ctypes.c_ubyte)()
+        out_len = ctypes.c_uint32()
+        rc = self._libref.kf_host_recv(
+            self._h, src_spec.encode(), name.encode(), conn_type,
+            -1.0 if timeout is None else float(timeout),
+            ctypes.byref(out), ctypes.byref(out_len),
+        )
+        if rc == 1:
+            raise TimeoutError(
+                f"recv {name!r} from {src_spec} timed out after {timeout}s")
+        if rc != 0:
+            raise ConnectionError("channel closed")
+        try:
+            return ctypes.string_at(out, out_len.value)
+        finally:
+            self._libref.kf_host_buf_free(out)
+
+    def ping(self, peer_spec: str, timeout: float) -> bool:
+        return self._libref.kf_host_ping(self._h, peer_spec.encode(), timeout) == 0
+
+    def reset_connections(self) -> None:
+        self._libref.kf_host_reset_connections(self._h)
+
+    def set_control_handler(self, fn: Callable[[str, bytes, str], bool]) -> None:
+        """``fn(name, payload, src) -> consumed``; not-consumed falls
+        through to the rendezvous queue."""
+        self._set_cb(self._libref.kf_host_set_control_cb, fn)
+
+    def set_p2p_handler(self, fn: Callable[[str, bytes, str], bool]) -> None:
+        self._set_cb(self._libref.kf_host_set_p2p_cb, fn)
+
+    def _set_cb(self, setter, fn) -> None:
+        @MSG_CB
+        def trampoline(name, payload, length, src):
+            try:
+                data = ctypes.string_at(payload, length) if length else b""
+                return 0 if fn(name.decode(), data, src.decode()) else 1
+            except Exception:  # noqa: BLE001 - never unwind into C++
+                return 1
+
+        self._cbs.append(trampoline)
+        setter(self._h, trampoline)
+
+    def ingress_totals(self) -> dict:
+        cap = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._libref.kf_host_ingress_snapshot(self._h, buf, cap)
+            if n >= 0:
+                break
+            cap = -n + 1
+        out = {}
+        for line in buf.value.decode().splitlines():
+            src, _, num = line.rpartition(" ")
+            if src:
+                out[src] = int(num)
+        return out
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass

@@ -9,18 +9,43 @@ import threading
 
 import pytest
 
-from kungfu_tpu.comm.host import ConnType, HostChannel
+from kungfu_tpu.comm.host import (
+    ConnType,
+    HostChannel,
+    NativeHostChannel,
+    PyHostChannel,
+)
+from kungfu_tpu.native import transport as native_transport
 from kungfu_tpu.plan import PeerID, PeerList
 from kungfu_tpu.store.store import Store, VersionedStore
 
 
 BASE_PORT = 21000
 
+_needs_native = pytest.mark.skipif(
+    not native_transport.available(), reason="native transport not built"
+)
 
-@pytest.fixture
-def channels():
-    peers = PeerList.of(*(PeerID("127.0.0.1", BASE_PORT + i) for i in range(3)))
-    chans = [HostChannel(p, token=0, bind_host="127.0.0.1") for p in peers]
+# every backend mix must behave identically — the wire format is shared,
+# so a native endpoint and a python endpoint interoperate
+BACKENDS = {
+    "python": [PyHostChannel] * 3,
+    "native": [NativeHostChannel] * 3,
+    "mixed": [NativeHostChannel, PyHostChannel, NativeHostChannel],
+}
+
+
+@pytest.fixture(params=list(BACKENDS))
+def channels(request):
+    if any(c is NativeHostChannel for c in BACKENDS[request.param]):
+        if not native_transport.available():
+            pytest.skip("native transport not built")
+    base = BASE_PORT + 10 * list(BACKENDS).index(request.param)
+    peers = PeerList.of(*(PeerID("127.0.0.1", base + i) for i in range(3)))
+    chans = [
+        cls(p, token=0, bind_host="127.0.0.1")
+        for cls, p in zip(BACKENDS[request.param], peers)
+    ]
     yield peers, chans
     for c in chans:
         c.close()
@@ -97,6 +122,116 @@ class TestHostChannel:
             [lambda i=i, c=c: c.consensus_bytes(b"same" if i < 2 else b"diff", peers, "c2") for i, c in enumerate(chans)]
         )
         assert outs == [False, False, False]
+
+
+class TestBackendSelection:
+    @_needs_native
+    def test_factory_prefers_native(self, monkeypatch):
+        monkeypatch.delenv("KF_TPU_HOST_TRANSPORT", raising=False)
+        ch = HostChannel(PeerID("127.0.0.1", 21900), bind_host="127.0.0.1")
+        try:
+            assert isinstance(ch, NativeHostChannel)
+        finally:
+            ch.close()
+
+    def test_factory_env_forces_python(self, monkeypatch):
+        monkeypatch.setenv("KF_TPU_HOST_TRANSPORT", "python")
+        ch = HostChannel(PeerID("127.0.0.1", 21901), bind_host="127.0.0.1")
+        try:
+            assert isinstance(ch, PyHostChannel)
+        finally:
+            ch.close()
+
+    @_needs_native
+    def test_native_ingress_totals(self):
+        a, b = PeerID("127.0.0.1", 21902), PeerID("127.0.0.1", 21903)
+        ca = NativeHostChannel(a, bind_host="127.0.0.1")
+        cb = NativeHostChannel(b, bind_host="127.0.0.1")
+        try:
+            ca.send(b, "m", b"x" * 1000)
+            assert cb.recv(a, "m") == b"x" * 1000
+            assert cb._t.ingress_totals() == {str(a): 1000}
+        finally:
+            ca.close()
+            cb.close()
+
+    @_needs_native
+    def test_native_no_fd_leak(self):
+        """Pings (fresh connection each) and pool resets must not leak fds."""
+        import os
+        import time
+
+        a, b = PeerID("127.0.0.1", 21905), PeerID("127.0.0.1", 21906)
+        ca = NativeHostChannel(a, bind_host="127.0.0.1")
+        cb = NativeHostChannel(b, bind_host="127.0.0.1")
+        try:
+            ca.send(b, "warm", b"x")
+            cb.recv(a, "warm")
+            time.sleep(0.2)
+            base = len(os.listdir("/proc/self/fd"))
+            for i in range(30):
+                ca.ping(b)
+                ca.reset_connections()
+                ca.send(b, f"m{i}", b"x")
+                cb.recv(a, f"m{i}")
+            time.sleep(0.5)
+            assert len(os.listdir("/proc/self/fd")) - base <= 2
+        finally:
+            ca.close()
+            cb.close()
+
+    @_needs_native
+    def test_native_recv_none_timeout_blocks(self):
+        """timeout=None must block until data arrives (not instant-timeout)."""
+        a, b = PeerID("127.0.0.1", 21907), PeerID("127.0.0.1", 21908)
+        ca = NativeHostChannel(a, bind_host="127.0.0.1")
+        cb = NativeHostChannel(b, bind_host="127.0.0.1")
+        got = []
+        t = threading.Thread(target=lambda: got.append(ca.recv(b, "later", timeout=None)))
+        try:
+            t.start()
+            import time
+
+            time.sleep(0.3)
+            assert t.is_alive()
+            cb.send(a, "later", b"data")
+            t.join(10)
+            assert got == [b"data"]
+        finally:
+            ca.close()
+            cb.close()
+
+    @_needs_native
+    def test_native_close_while_recv_blocked(self):
+        """close() with a blocked receiver must not crash or hang."""
+        a = PeerID("127.0.0.1", 21909)
+        ca = NativeHostChannel(a, bind_host="127.0.0.1")
+        got = []
+
+        def r():
+            try:
+                ca.recv(PeerID("127.0.0.1", 21910), "never", timeout=None)
+            except ConnectionError:
+                got.append("closed")
+
+        t = threading.Thread(target=r)
+        t.start()
+        import time
+
+        time.sleep(0.2)
+        ca.close()
+        t.join(10)
+        assert got == ["closed"]
+
+    @_needs_native
+    def test_native_port_conflict_raises(self):
+        a = PeerID("127.0.0.1", 21904)
+        ca = NativeHostChannel(a, bind_host="127.0.0.1")
+        try:
+            with pytest.raises(OSError):
+                native_transport.NativeTransport(str(a), a.port, "127.0.0.1")
+        finally:
+            ca.close()
 
 
 class TestStore:
