@@ -64,6 +64,24 @@ def _rope(q, k, positions):
     return rot(q), rot(k)
 
 
+def pick_attention() -> Callable:
+    """Attention impl for the current backend (``KF_TPU_ATTN`` overrides:
+    ``auto`` | ``xla`` | ``flash``).  ``auto`` uses the Pallas flash
+    kernel on TPU — fused online softmax, no [S, S] score matrix in HBM —
+    and plain XLA attention elsewhere (the interpreter-mode kernel is for
+    tests, far too slow as a CPU default)."""
+    import os
+
+    mode = os.environ.get("KF_TPU_ATTN", "auto").lower()
+    if mode == "xla":
+        return default_attention
+    if mode == "flash" or (mode == "auto" and jax.default_backend() == "tpu"):
+        from kungfu_tpu.ops.pallas import make_flash_attn
+
+        return make_flash_attn()
+    return default_attention
+
+
 def default_attention(q, k, v, causal: bool, segment_positions=None):
     """Plain softmax attention.  q,k,v: [B, H, S, D] (bf16).  Logits and
     softmax in f32 for stability; output back in input dtype."""
@@ -127,7 +145,7 @@ class Transformer:
         parallelism passes the global positions of the local shard)."""
         cfg = self.cfg
         dt = cfg.compute_dtype
-        attn = attn_fn or default_attention
+        attn = attn_fn or pick_attention()
         B, S = ids.shape
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(S), (B, S))

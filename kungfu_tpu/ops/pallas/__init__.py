@@ -1,0 +1,14 @@
+"""Pallas TPU kernels for the hot ops.
+
+The compute data plane is mostly plain XLA (which fuses elementwise work
+into the MXU matmuls on its own); these kernels cover the places where
+hand-tiling beats the compiler — attention above all, where the fused
+online-softmax loop avoids materializing the [S, S] score matrix in HBM.
+
+Kernels run compiled on TPU and in interpreter mode on CPU (tests), so
+the CPU multi-process test cluster exercises the same code path.
+"""
+
+from kungfu_tpu.ops.pallas.attention import flash_attention, make_flash_attn
+
+__all__ = ["flash_attention", "make_flash_attn"]

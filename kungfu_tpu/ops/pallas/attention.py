@@ -1,0 +1,240 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Forward: one grid step per (batch*head, q-block); the kernel streams
+K/V blocks through an online-softmax accumulator (m/l running max/sum,
+f32) so the [S, S] score matrix never exists in HBM — scores live one
+[block_q, block_k] tile at a time in VMEM, feeding the MXU via
+``jnp.dot(..., preferred_element_type=f32)``.  Causal masking skips
+entire all-masked K blocks (the loop upper bound is derived from the
+q-block index), so causal attention does ~half the FLOPs.
+
+Backward: blocked jnp (``lax.scan`` over K blocks) using the saved
+logsumexp rows — the standard flash-attention recomputation:
+
+    P  = exp(Q K^T * scale - L)        (recomputed per block)
+    dV = P^T dO
+    dP = dO V^T
+    dS = P * (dP - rowsum(dO * O))
+    dQ = dS K * scale ;  dK = dS^T Q * scale
+
+so backward memory is also O(S * block) — autodiff through the Pallas
+call would instead save every tile.  The whole op is a ``custom_vjp``.
+
+The reference framework has no attention at all (SURVEY §2.4/§5.7 — it
+moves gradient buffers only); this kernel is part of the TPU build's
+long-context subsystem together with :mod:`kungfu_tpu.parallel.ring`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, scale, causal,
+                seq_len, block_q, block_k):
+    """One (batch*head, q-block) grid step."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # [block_q, D]
+    d = q.shape[-1]
+
+    n_k = pl.cdiv(seq_len, block_k)
+    if causal:
+        # K blocks strictly after this q block's last row are all-masked;
+        # don't even loop over them (this is the causal FLOP saving)
+        hi = jax.lax.div((qi + 1) * block_q + block_k - 1, block_k)
+        hi = jnp.minimum(hi, n_k)
+    else:
+        hi = n_k
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+
+    def body(j, carry):
+        m, l, acc = carry  # m, l: [block_q, 1] (keepdims — Mosaic wants 2D)
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :]  # [block_k, D]
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = k_pos < seq_len  # tail padding
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_pos)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        # fully-masked rows (can only happen on padded tails) contribute 0
+        p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jnp.dot(
+            p.astype(v_ref.dtype), vb, preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
+
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    # logsumexp rows, saved for the backward recomputation
+    l_ref[0] = (m + jnp.log(l_safe))[:, 0]
+
+
+def _fwd_call(q, k, v, causal, block_q, block_k, interpret):
+    """q,k,v: [BH, S, D] → (out [BH, S, D], lse [BH, S])."""
+    bh, s, d = q.shape
+    s_pad = ((s + block_q - 1) // block_q) * block_q
+    s_pad = ((s_pad + block_k - 1) // block_k) * block_k
+    if s_pad != s:
+        pad = [(0, 0), (0, s_pad - s), (0, 0)]
+        q, k, v = (jnp.pad(t, pad) for t in (q, k, v))
+    grid = (bh, s_pad // block_q)
+    kernel = functools.partial(
+        _fwd_kernel,
+        scale=1.0 / (d ** 0.5),
+        causal=causal,
+        seq_len=s,
+        block_q=block_q,
+        block_k=block_k,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s_pad, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s_pad, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out[:, :s], lse[:, :s]
+
+
+def _bwd_blocked(q, k, v, out, lse, dout, causal, block_k):
+    """Blocked flash backward in jnp; [BH, S, D] operands."""
+    bh, s, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    qf = q.astype(jnp.float32)
+    of = out.astype(jnp.float32)
+    dof = dout.astype(jnp.float32)
+    delta = jnp.sum(dof * of, axis=-1)  # [BH, S]
+
+    s_pad = ((s + block_k - 1) // block_k) * block_k
+    if s_pad != s:
+        pad3 = [(0, 0), (0, s_pad - s), (0, 0)]
+        k = jnp.pad(k, pad3)
+        v = jnp.pad(v, pad3)
+    n_blk = s_pad // block_k
+    kf = k.astype(jnp.float32).reshape(bh, n_blk, block_k, d)
+    vf = v.astype(jnp.float32).reshape(bh, n_blk, block_k, d)
+
+    q_pos = jnp.arange(s)
+
+    def fold(dq, blk):
+        j, kb, vb = blk  # kb/vb: [BH, block_k, D]
+        s_blk = jnp.einsum("bqd,bkd->bqk", qf, kb) * scale
+        k_pos = j * block_k + jnp.arange(block_k)
+        mask = k_pos[None, :] < s
+        if causal:
+            mask = jnp.logical_and(mask, q_pos[:, None] >= k_pos[None, :])
+        p = jnp.where(mask, jnp.exp(s_blk - lse[..., None]), 0.0)  # [BH,S,bk]
+        dp = jnp.einsum("bqd,bkd->bqk", dof, vb)
+        ds = p * (dp - delta[..., None])
+        dq = dq + jnp.einsum("bqk,bkd->bqd", ds, kb) * scale
+        dk_b = jnp.einsum("bqk,bqd->bkd", ds, qf) * scale
+        dv_b = jnp.einsum("bqk,bqd->bkd", p, dof)
+        return dq, (dk_b, dv_b)
+
+    dq0 = jnp.zeros((bh, s, d), jnp.float32)
+    dq, (dk_blocks, dv_blocks) = jax.lax.scan(
+        fold, dq0, (jnp.arange(n_blk), kf.transpose(1, 0, 2, 3), vf.transpose(1, 0, 2, 3))
+    )
+    dk = dk_blocks.transpose(1, 0, 2, 3).reshape(bh, s_pad, d)[:, :s]
+    dv = dv_blocks.transpose(1, 0, 2, 3).reshape(bh, s_pad, d)[:, :s]
+    return dq.astype(q.dtype), dk.astype(q.dtype), dv.astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    out, _ = _fwd_call(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _fwd_call(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, dout):
+    q, k, v, out, lse = res
+    return _bwd_blocked(q, k, v, out, lse, dout, causal, block_k)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = True,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: Optional[bool] = None,
+):
+    """Fused attention for [B, H, S, D] (or [BH, S, D]) operands.
+
+    Differentiable; numerically matches
+    :func:`kungfu_tpu.models.transformer.default_attention` (softmax in
+    f32).  ``interpret=None`` auto-selects interpreter mode off-TPU so
+    the same call works on the CPU test cluster.
+    """
+    if interpret is None:
+        interpret = _use_interpret()
+    if q.ndim == 3:
+        return _flash(q, k, v, causal, block_q, block_k, interpret)
+    if q.ndim != 4:
+        raise ValueError(f"expected [B,H,S,D] or [BH,S,D], got {q.shape}")
+    b, h, s, d = q.shape
+    out = _flash(
+        q.reshape(b * h, s, d),
+        k.reshape(b * h, s, d),
+        v.reshape(b * h, s, d),
+        causal, block_q, block_k, interpret,
+    )
+    return out.reshape(b, h, s, d)
+
+
+def make_flash_attn(block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K):
+    """Adapter for the ``attn_fn(q, k, v, causal)`` slot of
+    :meth:`kungfu_tpu.models.transformer.Transformer.apply`."""
+
+    def attn(q, k, v, causal):
+        return flash_attention(q, k, v, causal=causal, block_q=block_q, block_k=block_k)
+
+    return attn
