@@ -110,3 +110,27 @@ class ConfigServer:
     def snapshot(self):
         with self._lock:
             return self._version, self._cluster
+
+
+def main(argv=None) -> int:
+    """Standalone elastic config server (reference
+    ``cmd/kungfu-config-server/kungfu-config-server.go:19-30``)."""
+    import argparse
+    import time
+
+    p = argparse.ArgumentParser(prog="kf-config-server")
+    p.add_argument("-port", type=int, default=9100)
+    p.add_argument("-host", default="0.0.0.0")
+    ns = p.parse_args(argv)
+    srv = ConfigServer(port=ns.port, host=ns.host).start()
+    _log.info("config server listening on %s:%d", ns.host, ns.port)
+    try:
+        while srv._thread is not None and srv._thread.is_alive():
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

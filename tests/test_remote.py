@@ -1,0 +1,103 @@
+"""kf-distribute / kf-rrun tests via a local fake-ssh shim.
+
+The shim drops the host argument and executes the command locally —
+multi-host launch semantics tested without machines (the reference tests
+its SSH path the same way its cluster tests fake multi-node: everything
+on localhost, SURVEY §4).
+"""
+
+import os
+import stat
+import sys
+
+import pytest
+
+from kungfu_tpu.runner.remote import main_distribute, main_rrun, ssh_proc
+
+
+@pytest.fixture
+def fake_ssh(tmp_path):
+    shim = tmp_path / "fake-ssh"
+    shim.write_text("#!/bin/sh\n# $1 = [user@]host, $2 = command string\nshift\nexec sh -c \"$1\"\n")
+    shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+    return str(shim)
+
+
+class TestSshProc:
+    def test_command_quoting(self):
+        p = ssh_proc("10.0.0.1", ["echo", "a b", "$HOME"], user="me")
+        assert p.prog == "ssh"
+        assert p.args[0] == "me@10.0.0.1"
+        assert p.args[1] == "echo 'a b' '$HOME'"
+
+    def test_no_user(self):
+        p = ssh_proc("10.0.0.1", ["true"])
+        assert p.args[0] == "10.0.0.1"
+
+
+class TestDistribute:
+    def test_runs_on_every_host(self, fake_ssh, tmp_path):
+        out = tmp_path / "out"
+        rc = main_distribute([
+            "-H", "127.0.0.1:2,127.0.0.2:2",
+            "--ssh", fake_ssh,
+            "-q",
+            "sh", "-c", f"echo ran >> {out}",
+        ])
+        assert rc == 0
+        assert open(out).read().splitlines() == ["ran", "ran"]
+
+    def test_failure_propagates(self, fake_ssh):
+        rc = main_distribute([
+            "-H", "127.0.0.1:1",
+            "--ssh", fake_ssh,
+            "-q",
+            "false",
+        ])
+        assert rc == 1
+
+    def test_per_host_logs(self, fake_ssh, tmp_path):
+        logdir = tmp_path / "logs"
+        rc = main_distribute([
+            "-H", "127.0.0.1:1",
+            "--ssh", fake_ssh,
+            "-q",
+            "-logdir", str(logdir),
+            "echo", "hello-log",
+        ])
+        assert rc == 0
+        assert "hello-log" in open(logdir / "127.0.0.1.stdout.log").read()
+
+
+class TestRrun:
+    def test_launches_runner_per_host(self, fake_ssh, tmp_path):
+        """Full path: rrun → fake ssh → kfrun → worker procs.
+
+        One host with 2 slots on localhost; the worker just reports its
+        env contract."""
+        marker = tmp_path / "worker.out"
+        script = tmp_path / "worker.py"
+        script.write_text(
+            "import os\n"
+            f"open({str(marker)!r}, 'a').write(os.environ['KF_SELF_SPEC'] + chr(10))\n"
+        )
+        rc = main_rrun([
+            "-np", "2",
+            "-H", "127.0.0.1:2",
+            "--ssh", fake_ssh,
+            "--python", sys.executable,
+            "-timeout", "120",
+            str(sys.executable), str(script),
+        ])
+        assert rc == 0
+        lines = open(marker).read().splitlines()
+        assert len(lines) == 2 and len(set(lines)) == 2  # two distinct workers
+
+    def test_np_over_capacity(self, fake_ssh):
+        rc = main_rrun([
+            "-np", "4",
+            "-H", "127.0.0.1:1",
+            "--ssh", fake_ssh,
+            "true",
+        ])
+        assert rc == 1
