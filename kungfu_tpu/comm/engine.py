@@ -29,6 +29,7 @@ import numpy as np
 
 from kungfu_tpu import native
 from kungfu_tpu.comm.host import ConnType, HostChannel
+from kungfu_tpu.utils.trace import trace_scope
 from kungfu_tpu.plan import (
     Strategy,
     auto_select,
@@ -150,14 +151,15 @@ class CollectiveEngine:
                     w[0] += chunk.nbytes
                     w[1] += dt
 
-        if len(chunks) == 1:
-            run_chunk(0, chunks[0])
-        else:
-            futures = [
-                self._pool.submit(run_chunk, i, c) for i, c in enumerate(chunks)
-            ]
-            for f in futures:
-                f.result()
+        with trace_scope(f"engine.all_reduce[{flat.nbytes}B]"):
+            if len(chunks) == 1:
+                run_chunk(0, chunks[0])
+            else:
+                futures = [
+                    self._pool.submit(run_chunk, i, c) for i, c in enumerate(chunks)
+                ]
+                for f in futures:
+                    f.result()
         if errs:
             raise errs[0]
         out = np.concatenate(outs).reshape(x.shape)

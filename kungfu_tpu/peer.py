@@ -28,6 +28,7 @@ from kungfu_tpu.plan.cluster import Cluster
 from kungfu_tpu.utils import envs
 from kungfu_tpu.utils.log import get_logger, log_event
 from kungfu_tpu.utils.stall import stall_detector
+from kungfu_tpu.utils.trace import trace_scope
 
 _log = get_logger("peer")
 
@@ -93,6 +94,9 @@ class Peer:
                 install_p2p_handler(self._channel, self.store)
             if self.config.coordinator and self.config.num_processes > 1:
                 self._init_jax_distributed()
+            from kungfu_tpu.utils.affinity import bind_local_rank
+
+            bind_local_rank(self.local_rank(), self.local_size())
             log_event("peer-started")
 
     def _init_jax_distributed(self) -> None:
@@ -188,7 +192,7 @@ class Peer:
         """Host-level barrier across worker processes."""
         if self.size() <= 1 or self._channel is None:
             return
-        with stall_detector("barrier"):
+        with trace_scope("peer.barrier"), stall_detector("barrier"):
             self._channel.barrier(
                 self.cluster.workers, name=f"barrier.v{self.cluster_version}"
             )
@@ -243,7 +247,7 @@ class Peer:
         with self._lock:
             if new_cluster.workers == self.cluster.workers:
                 return False
-            with stall_detector("propose"):
+            with trace_scope("peer.propose"), stall_detector("propose"):
                 self._notify_runners(new_cluster, version)
                 self.cluster = new_cluster
                 self.cluster_version = version

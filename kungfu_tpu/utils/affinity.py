@@ -1,0 +1,69 @@
+"""CPU affinity for local workers (reference NUMA placement analog).
+
+The reference binds each local rank to a NUMA-partitioned CPU set via
+``sched_setaffinity`` when ``KUNGFU_USE_AFFINITY`` is set
+(``srcs/cpp/src/numa/affinity.cpp:26-40``, enabled in
+``python/init.cpp:23-28``).  On a TPU host the same concern applies to
+the host-side input pipeline and the collective engine's reducer
+threads: N worker processes on one VM should not migrate across each
+other's cores.  Enabled by ``KF_CONFIG_USE_AFFINITY``; the partition is
+an even split of the currently-allowed CPUs by local rank (hwloc-style
+topology discovery is unnecessary — cloud TPU VMs expose flat,
+homogeneous vCPU sets).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from kungfu_tpu.utils.log import get_logger
+
+_log = get_logger("affinity")
+
+USE_AFFINITY = "KF_CONFIG_USE_AFFINITY"
+
+
+def affinity_enabled() -> bool:
+    return os.environ.get(USE_AFFINITY, "").lower() in ("1", "true", "yes")
+
+
+def partition_cpus(cpus: List[int], local_rank: int, local_size: int) -> List[int]:
+    """Even contiguous split of ``cpus`` (ranks with lower index get the
+    remainder, matching the reference's per-rank partition)."""
+    if local_size <= 0:
+        raise ValueError("local_size must be positive")
+    if not 0 <= local_rank < local_size:
+        raise ValueError(f"local_rank {local_rank} not in [0, {local_size})")
+    cpus = sorted(cpus)
+    n = len(cpus)
+    base, extra = divmod(n, local_size)
+    start = local_rank * base + min(local_rank, extra)
+    size = base + (1 if local_rank < extra else 0)
+    return cpus[start : start + size]
+
+
+def bind_local_rank(
+    local_rank: int, local_size: int, pid: int = 0, force: bool = False
+) -> Optional[List[int]]:
+    """Pin ``pid`` (default: this process) to its local rank's CPU share.
+
+    Returns the CPU list bound to, or None if disabled / unsupported /
+    the share would be empty (never binds to an empty set — better
+    unpinned than unschedulable)."""
+    if not (force or affinity_enabled()):
+        return None
+    if not hasattr(os, "sched_getaffinity"):  # pragma: no cover - non-Linux
+        _log.warning("affinity unsupported on this platform")
+        return None
+    allowed = sorted(os.sched_getaffinity(pid))
+    share = partition_cpus(allowed, local_rank, local_size)
+    if not share:
+        _log.warning(
+            "no CPUs for local rank %d/%d over %d allowed; leaving unpinned",
+            local_rank, local_size, len(allowed),
+        )
+        return None
+    os.sched_setaffinity(pid, share)
+    _log.info("local rank %d/%d bound to CPUs %s", local_rank, local_size, share)
+    return share

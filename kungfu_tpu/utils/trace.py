@@ -1,0 +1,119 @@
+"""Scoped tracing / profiling.
+
+Parity with the reference's optional stdtracer (``TRACE_SCOPE``,
+``include/kungfu/utils/trace.hpp:1-17``, enabled by
+``KUNGFU_ENABLE_TRACE``) plus the TPU-native upgrade: scopes can also
+drive :mod:`jax.profiler` so a traced region produces an XPlane/
+TensorBoard trace of the actual device timeline.
+
+* ``trace_scope(name)`` — context manager / decorator.  When
+  ``KF_CONFIG_ENABLE_TRACE`` is truthy, logs entry depth + duration and
+  accumulates per-name (count, total) stats; near-zero cost when off.
+* ``trace_report()`` — aggregated table of all scopes seen.
+* ``device_trace(logdir)`` — jax.profiler capture of the wrapped region
+  (the stdtracer analog for the compiled side: XLA owns the device
+  schedule, so device-side "tracing" is the profiler, not prints).
+
+The runner stamps ``KF_JOB_START_TIMESTAMP`` / ``KF_PROC_START_TIMESTAMP``
+(``runner/job.py``), and ``kungfu_tpu.utils.log.log_event`` anchors event
+lines on them — together these reproduce the reference's event-timeline
+logging (``_utils.py:44-51``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from kungfu_tpu.utils.log import get_logger
+
+_log = get_logger("trace")
+
+ENABLE_TRACE = "KF_CONFIG_ENABLE_TRACE"
+
+_local = threading.local()
+_stats_lock = threading.Lock()
+_stats: Dict[str, Tuple[int, float]] = {}
+
+
+def trace_enabled() -> bool:
+    return os.environ.get(ENABLE_TRACE, "").lower() in ("1", "true", "yes")
+
+
+def _record(name: str, dt: float) -> None:
+    with _stats_lock:
+        n, total = _stats.get(name, (0, 0.0))
+        _stats[name] = (n + 1, total + dt)
+
+
+@contextlib.contextmanager
+def trace_scope(name: str, force: bool = False):
+    """Time a region; nested scopes are indented by depth in the log."""
+    if not (force or trace_enabled()):
+        yield
+        return
+    depth = getattr(_local, "depth", 0)
+    _local.depth = depth + 1
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        _local.depth = depth
+        _record(name, dt)
+        _log.info("%s%s took %.3fms", "  " * depth, name, dt * 1e3)
+
+
+def traced(fn=None, *, name: Optional[str] = None):
+    """Decorator form of :func:`trace_scope`."""
+    if fn is None:
+        return functools.partial(traced, name=name)
+
+    scope = name or fn.__qualname__
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        with trace_scope(scope):
+            return fn(*args, **kwargs)
+
+    return wrapper
+
+
+def trace_report() -> Dict[str, Dict[str, float]]:
+    """Aggregated scope stats: ``{name: {count, total_s, mean_ms}}``."""
+    with _stats_lock:
+        snap = dict(_stats)
+    return {
+        name: {
+            "count": n,
+            "total_s": total,
+            "mean_ms": (total / n * 1e3) if n else 0.0,
+        }
+        for name, (n, total) in snap.items()
+    }
+
+
+def reset_trace_stats() -> None:
+    with _stats_lock:
+        _stats.clear()
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str, force: bool = False):
+    """Capture a jax.profiler trace (XPlane, viewable in TensorBoard /
+    xprof) of the wrapped region.  No-op unless tracing is enabled."""
+    if not (force or trace_enabled()):
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        _log.info("device trace written to %s", logdir)

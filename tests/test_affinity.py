@@ -1,0 +1,60 @@
+"""CPU-affinity partition tests (reference NUMA placement analog)."""
+
+import os
+
+import pytest
+
+from kungfu_tpu.utils.affinity import (
+    USE_AFFINITY,
+    bind_local_rank,
+    partition_cpus,
+)
+
+
+class TestPartition:
+    def test_even_split(self):
+        cpus = list(range(8))
+        assert partition_cpus(cpus, 0, 2) == [0, 1, 2, 3]
+        assert partition_cpus(cpus, 1, 2) == [4, 5, 6, 7]
+
+    def test_remainder_goes_to_low_ranks(self):
+        cpus = list(range(10))
+        shares = [partition_cpus(cpus, r, 4) for r in range(4)]
+        assert [len(s) for s in shares] == [3, 3, 2, 2]
+        assert sorted(sum(shares, [])) == cpus  # exact cover, no overlap
+
+    def test_more_ranks_than_cpus(self):
+        cpus = [0, 1]
+        shares = [partition_cpus(cpus, r, 4) for r in range(4)]
+        assert shares == [[0], [1], [], []]
+
+    def test_bad_args(self):
+        with pytest.raises(ValueError):
+            partition_cpus([0], 0, 0)
+        with pytest.raises(ValueError):
+            partition_cpus([0], 2, 2)
+
+
+class TestBind:
+    def test_disabled_by_default(self, monkeypatch):
+        monkeypatch.delenv(USE_AFFINITY, raising=False)
+        assert bind_local_rank(0, 1) is None
+
+    def test_bind_and_restore(self, monkeypatch):
+        monkeypatch.setenv(USE_AFFINITY, "1")
+        before = os.sched_getaffinity(0)
+        try:
+            share = bind_local_rank(0, 1)
+            assert share == sorted(before)  # whole set for a single rank
+            assert os.sched_getaffinity(0) == set(share)
+        finally:
+            os.sched_setaffinity(0, before)
+
+    def test_empty_share_stays_unpinned(self, monkeypatch):
+        before = os.sched_getaffinity(0)
+        try:
+            # rank beyond the cpu count gets an empty share -> no bind
+            assert bind_local_rank(len(before) + 1, len(before) + 2, force=True) is None
+            assert os.sched_getaffinity(0) == before
+        finally:
+            os.sched_setaffinity(0, before)

@@ -1,0 +1,103 @@
+"""Tracing subsystem tests (reference TRACE_SCOPE / event-timeline analog)."""
+
+import time
+
+import pytest
+
+from kungfu_tpu.utils import trace
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    trace.reset_trace_stats()
+    yield
+    trace.reset_trace_stats()
+
+
+class TestTraceScope:
+    def test_disabled_by_default(self, monkeypatch, caplog):
+        monkeypatch.delenv(trace.ENABLE_TRACE, raising=False)
+        with trace.trace_scope("quiet-op"):
+            pass
+        assert trace.trace_report() == {}
+
+    def test_records_stats(self):
+        with trace.trace_scope("op-a", force=True):
+            time.sleep(0.01)
+        with trace.trace_scope("op-a", force=True):
+            time.sleep(0.01)
+        rep = trace.trace_report()
+        assert rep["op-a"]["count"] == 2
+        assert rep["op-a"]["total_s"] >= 0.02
+        assert rep["op-a"]["mean_ms"] >= 10
+
+    def test_env_enables(self, monkeypatch):
+        monkeypatch.setenv(trace.ENABLE_TRACE, "true")
+        with trace.trace_scope("op-env"):
+            pass
+        assert trace.trace_report()["op-env"]["count"] == 1
+
+    def test_nested_scopes(self):
+        with trace.trace_scope("outer", force=True):
+            with trace.trace_scope("inner", force=True):
+                pass
+        rep = trace.trace_report()
+        assert rep["outer"]["count"] == 1
+        assert rep["inner"]["count"] == 1
+
+    def test_exception_still_records(self):
+        with pytest.raises(ValueError):
+            with trace.trace_scope("boom", force=True):
+                raise ValueError("x")
+        assert trace.trace_report()["boom"]["count"] == 1
+
+
+class TestTracedDecorator:
+    def test_wraps(self):
+        @trace.traced(name="fn-x")
+        def f(a, b):
+            return a + b
+
+        import os
+
+        os.environ[trace.ENABLE_TRACE] = "1"
+        try:
+            assert f(1, 2) == 3
+        finally:
+            del os.environ[trace.ENABLE_TRACE]
+        assert trace.trace_report()["fn-x"]["count"] == 1
+
+
+class TestEngineIntegration:
+    def test_allreduce_emits_scope(self, monkeypatch):
+        """The collective engine's hot path is traced when enabled."""
+        import threading
+
+        import numpy as np
+
+        monkeypatch.setenv(trace.ENABLE_TRACE, "1")
+        from kungfu_tpu.comm.engine import CollectiveEngine
+        from kungfu_tpu.comm.host import HostChannel
+        from kungfu_tpu.plan import PeerID, PeerList
+        from kungfu_tpu.plan.strategy import Strategy
+
+        peers = PeerList.of(*(PeerID("127.0.0.1", 23100 + i) for i in range(2)))
+        chans = [HostChannel(p, bind_host="127.0.0.1") for p in peers]
+        engines = [
+            CollectiveEngine(c, peers, strategy=Strategy.STAR) for c in chans
+        ]
+        outs = [None, None]
+
+        def run(i):
+            outs[i] = engines[i].all_reduce(np.ones(4, np.float32))
+
+        ts = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        for c in chans:
+            c.close()
+        np.testing.assert_allclose(outs[0], 2 * np.ones(4))
+        rep = trace.trace_report()
+        assert any(k.startswith("engine.all_reduce[") for k in rep)
