@@ -18,6 +18,7 @@ the driver; CPU locally).  Use --quick for a reduced-shape smoke run.
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import time
 
@@ -36,7 +37,14 @@ def main() -> None:
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--quick", action="store_true")
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend (local smoke runs; the "
+                        "jax env preloads the TPU plugin, so a simple "
+                        "JAX_PLATFORMS env is too late)")
     args = p.parse_args()
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
@@ -61,7 +69,9 @@ def main() -> None:
         nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
         return nll, new_state
 
-    @jax.jit
+    # donate the train state: XLA updates params/momentum in place instead
+    # of allocating fresh buffers every step (HBM traffic + footprint)
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
     def train_step(params, bn_state, opt_state, images, labels):
         (loss, new_bn), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params, bn_state, images, labels
