@@ -7,16 +7,24 @@ build makes recovery real with a small checkpoint API used by the
 auto-recovery path: param/opt-state pytrees + step/epoch counters saved
 per epoch, newest-wins restore, atomic writes.
 
-Format: atomic numpy ``.npz`` of the flattened pytree — dependency-free
-and identical on CPU test clusters and TPU hosts.  (An orbax backend —
-async + sharding-aware — is the planned upgrade path; the API here is
-deliberately orbax-shaped: save/restore/latest_step/prune.)
+Two backends behind one API (save/restore/latest_step/prune):
+
+* ``orbax`` — sharding-aware PyTree checkpointing via
+  :mod:`orbax.checkpoint` (the standard JAX checkpoint library); used
+  when available.  Directories ``ckpt_<step>.orbax``.
+* ``npz`` — atomic numpy ``.npz`` of the flattened pytree; dependency-
+  free fallback, identical on CPU test clusters and TPU hosts.
+
+Select with ``KF_TPU_CKPT_BACKEND`` (``auto`` | ``orbax`` | ``npz``).
+Restore reads whichever format the newest checkpoint has, so a job can
+switch backends mid-history.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import shutil
 import tempfile
 from typing import Any, Optional, Tuple
 
@@ -28,17 +36,64 @@ from kungfu_tpu.utils.log import get_logger
 _log = get_logger("checkpoint")
 
 
+def _orbax():
+    try:
+        import orbax.checkpoint as ocp
+
+        return ocp
+    except ImportError:  # pragma: no cover - baked into the TPU image
+        return None
+
+
+def _backend() -> str:
+    mode = os.environ.get("KF_TPU_CKPT_BACKEND", "auto").lower()
+    if mode in ("orbax", "npz"):
+        return mode
+    return "orbax" if _orbax() is not None else "npz"
+
+
 def _flatten(tree) -> Tuple[list, Any]:
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     return leaves, treedef
+
+
+def _step_entries(ckpt_dir: str):
+    """[(step, filename)] of every checkpoint in either format."""
+    out = []
+    for name in os.listdir(ckpt_dir):
+        if not name.startswith("ckpt_"):
+            continue
+        stem = name[5:]
+        for suffix in (".npz", ".orbax"):
+            if stem.endswith(suffix):
+                try:
+                    out.append((int(stem[: -len(suffix)]), name))
+                except ValueError:
+                    pass
+    return out
 
 
 def save_checkpoint(ckpt_dir: str, step: int, tree, meta: Optional[dict] = None) -> str:
     """Atomically write ``tree`` (+ meta) as checkpoint ``step``; returns
     the checkpoint path."""
     os.makedirs(ckpt_dir, exist_ok=True)
+    if _backend() == "orbax":
+        return _save_orbax(ckpt_dir, step, tree, meta)
+    return _save_npz(ckpt_dir, step, tree, meta)
+
+
+def _to_npz_safe(arr: np.ndarray) -> np.ndarray:
+    """bfloat16 (ml_dtypes) round-trips through .npz as raw void bytes
+    numpy can't cast back — store it widened to f32 (lossless); restore
+    casts to the like-tree dtype anyway."""
+    if arr.dtype.name == "bfloat16" or arr.dtype.kind == "V":
+        return arr.astype(np.float32)
+    return arr
+
+
+def _save_npz(ckpt_dir: str, step: int, tree, meta: Optional[dict]) -> str:
     leaves, _ = _flatten(tree)
-    arrays = {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)}
+    arrays = {f"leaf_{i}": _to_npz_safe(np.asarray(l)) for i, l in enumerate(leaves)}
     path = os.path.join(ckpt_dir, f"ckpt_{step:08d}.npz")
     fd, tmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".tmp")
     try:
@@ -53,16 +108,26 @@ def save_checkpoint(ckpt_dir: str, step: int, tree, meta: Optional[dict] = None)
     return path
 
 
+def _save_orbax(ckpt_dir: str, step: int, tree, meta: Optional[dict]) -> str:
+    ocp = _orbax()
+    path = os.path.join(os.path.abspath(ckpt_dir), f"ckpt_{step:08d}.orbax")
+    # orbax writes into a temp dir and renames — atomic like the npz path;
+    # an aborted earlier attempt must be cleared first
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(path, tree)
+    # meta as a sidecar (orbax pytrees are arrays; job metadata is JSON)
+    with open(path + ".meta.json", "w") as f:
+        json.dump(meta or {}, f)
+    _log.info("saved checkpoint %s", path)
+    return path
+
+
 def latest_step(ckpt_dir: str) -> Optional[int]:
     if not os.path.isdir(ckpt_dir):
         return None
-    steps = []
-    for name in os.listdir(ckpt_dir):
-        if name.startswith("ckpt_") and name.endswith(".npz"):
-            try:
-                steps.append(int(name[5:-4]))
-            except ValueError:
-                pass
+    steps = [s for s, _ in _step_entries(ckpt_dir)]
     return max(steps) if steps else None
 
 
@@ -73,6 +138,9 @@ def restore_checkpoint(ckpt_dir: str, like_tree, step: Optional[int] = None):
         step = latest_step(ckpt_dir)
         if step is None:
             return None
+    orbax_path = os.path.join(os.path.abspath(ckpt_dir), f"ckpt_{step:08d}.orbax")
+    if os.path.isdir(orbax_path):
+        return _restore_orbax(orbax_path, like_tree, step)
     path = os.path.join(ckpt_dir, f"ckpt_{step:08d}.npz")
     with np.load(path, allow_pickle=False) as data:
         meta = json.loads(str(data["__meta__"]))
@@ -86,12 +154,35 @@ def restore_checkpoint(ckpt_dir: str, like_tree, step: Optional[int] = None):
     return tree, step, meta
 
 
+def _restore_orbax(path: str, like_tree, step: int):
+    ocp = _orbax()
+    with ocp.PyTreeCheckpointer() as ckptr:
+        restored = ckptr.restore(path)
+    meta = {}
+    if os.path.exists(path + ".meta.json"):
+        with open(path + ".meta.json") as f:
+            meta = json.load(f)
+    # conform dtypes/structure to like_tree (orbax restores as numpy)
+    leaves, treedef = _flatten(like_tree)
+    got_leaves, _ = _flatten(restored)
+    conformed = [
+        np.asarray(g, dtype=np.asarray(like).dtype)
+        for g, like in zip(got_leaves, leaves)
+    ]
+    tree = jax.tree_util.tree_unflatten(treedef, conformed)
+    _log.info("restored checkpoint %s (meta=%s)", path, meta)
+    return tree, step, dict(meta)
+
+
 def prune_checkpoints(ckpt_dir: str, keep: int = 3) -> None:
     if not os.path.isdir(ckpt_dir):
         return
-    steps = sorted(
-        int(n[5:-4]) for n in os.listdir(ckpt_dir)
-        if n.startswith("ckpt_") and n.endswith(".npz")
-    )
-    for s in steps[:-keep]:
-        os.unlink(os.path.join(ckpt_dir, f"ckpt_{s:08d}.npz"))
+    entries = sorted(_step_entries(ckpt_dir))
+    for _, name in entries[:-keep]:
+        full = os.path.join(ckpt_dir, name)
+        if os.path.isdir(full):
+            shutil.rmtree(full)
+            if os.path.exists(full + ".meta.json"):
+                os.unlink(full + ".meta.json")
+        else:
+            os.unlink(full)

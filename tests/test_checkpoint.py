@@ -1,0 +1,73 @@
+"""Checkpoint engine tests — both backends and cross-format restore."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kungfu_tpu import checkpoint as ckpt
+
+
+@pytest.fixture(params=["npz", "orbax"])
+def backend(request, monkeypatch):
+    if request.param == "orbax" and ckpt._orbax() is None:
+        pytest.skip("orbax not installed")
+    monkeypatch.setenv("KF_TPU_CKPT_BACKEND", request.param)
+    return request.param
+
+
+def _tree():
+    return {
+        "w": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+        "b": jnp.ones((3,), jnp.bfloat16),
+        "inner": {"step": jnp.int32(7)},
+    }
+
+
+class TestRoundTrip:
+    def test_save_restore(self, backend, tmp_path):
+        tree = _tree()
+        ckpt.save_checkpoint(str(tmp_path), 3, tree, meta={"epoch": 2})
+        out = ckpt.restore_checkpoint(str(tmp_path), tree)
+        assert out is not None
+        got, step, meta = out
+        assert step == 3 and meta == {"epoch": 2}
+        np.testing.assert_array_equal(np.asarray(got["w"]), np.asarray(tree["w"]))
+        assert np.asarray(got["b"]).dtype == np.asarray(tree["b"]).dtype
+        assert int(got["inner"]["step"]) == 7
+
+    def test_latest_wins(self, backend, tmp_path):
+        tree = _tree()
+        for s in (1, 5, 3):
+            ckpt.save_checkpoint(str(tmp_path), s, tree, meta={"s": s})
+        assert ckpt.latest_step(str(tmp_path)) == 5
+        _, step, meta = ckpt.restore_checkpoint(str(tmp_path), tree)
+        assert step == 5 and meta == {"s": 5}
+
+    def test_restore_empty_dir(self, backend, tmp_path):
+        assert ckpt.restore_checkpoint(str(tmp_path / "none"), _tree()) is None
+
+    def test_prune(self, backend, tmp_path):
+        tree = _tree()
+        for s in range(6):
+            ckpt.save_checkpoint(str(tmp_path), s, tree)
+        ckpt.prune_checkpoints(str(tmp_path), keep=2)
+        steps = sorted(s for s, _ in ckpt._step_entries(str(tmp_path)))
+        assert steps == [4, 5]
+
+
+class TestCrossFormat:
+    def test_mixed_history_restores_newest(self, tmp_path, monkeypatch):
+        if ckpt._orbax() is None:
+            pytest.skip("orbax not installed")
+        tree = _tree()
+        monkeypatch.setenv("KF_TPU_CKPT_BACKEND", "npz")
+        ckpt.save_checkpoint(str(tmp_path), 1, tree, meta={"fmt": "npz"})
+        monkeypatch.setenv("KF_TPU_CKPT_BACKEND", "orbax")
+        ckpt.save_checkpoint(str(tmp_path), 2, tree, meta={"fmt": "orbax"})
+        _, step, meta = ckpt.restore_checkpoint(str(tmp_path), tree)
+        assert (step, meta["fmt"]) == (2, "orbax")
+        # and the older npz is still individually restorable
+        _, step, meta = ckpt.restore_checkpoint(str(tmp_path), tree, step=1)
+        assert (step, meta["fmt"]) == (1, "npz")
