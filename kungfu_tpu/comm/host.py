@@ -59,6 +59,20 @@ MAGIC = 0x4B465450  # "KFTP"
 CONNECT_RETRIES = 500
 CONNECT_RETRY_PERIOD_S = 0.2  # reference: 500 x 200ms (config.go:16-18)
 
+USE_UNIXSOCK = "KF_TPU_USE_UNIXSOCK"
+
+
+def unixsock_enabled() -> bool:
+    """Colocated peers use a unix domain socket (reference
+    ``UseUnixSock=true``, sockfile ``plan/addr.go:24``); opt out with
+    ``KF_TPU_USE_UNIXSOCK=0``."""
+    return os.environ.get(USE_UNIXSOCK, "1").lower() not in ("0", "false", "no")
+
+
+def unix_sock_path(port: int) -> str:
+    """Must match the C++ transport's scheme (transport.cpp)."""
+    return f"/tmp/kf-tpu-{port}.sock"
+
 
 class ConnType(enum.IntEnum):
     """Parity with reference ``message.go:12-17``."""
@@ -219,11 +233,38 @@ class PyHostChannel(_ChannelOps):
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
         self._thread.start()
 
+        # composed server: second listener on the colocated-peer sockfile
+        self._unix_server = None
+        self._unix_path = None
+        if unixsock_enabled():
+            class UnixServer(socketserver.ThreadingUnixStreamServer):
+                daemon_threads = True
+
+            path = unix_sock_path(self_id.port)
+            try:
+                if os.path.exists(path):
+                    os.unlink(path)
+                self._unix_server = UnixServer(path, Handler)
+                self._unix_path = path
+                threading.Thread(
+                    target=self._unix_server.serve_forever, daemon=True
+                ).start()
+            except OSError as e:  # TCP-only is fine (e.g. /tmp unwritable)
+                _log.debug("no unix listener: %s", e)
+                self._unix_server = None
+
     # -- lifecycle -------------------------------------------------------
     def close(self) -> None:
         self.reset_connections()
         self._server.shutdown()
         self._server.server_close()
+        if self._unix_server is not None:
+            self._unix_server.shutdown()
+            self._unix_server.server_close()
+            try:
+                os.unlink(self._unix_path)
+            except OSError:
+                pass
 
     def set_token(self, token: int) -> None:
         """Move to a new cluster epoch; purge collective queues of older
@@ -302,8 +343,17 @@ class PyHostChannel(_ChannelOps):
 
     # -- client side -----------------------------------------------------
     def _connect(self, peer: PeerID, retries=CONNECT_RETRIES) -> socket.socket:
+        colocated = unixsock_enabled() and peer.host == self.self_id.host
         last = None
         for _ in range(retries):
+            if colocated:
+                try:
+                    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                    s.settimeout(10)
+                    s.connect(unix_sock_path(peer.port))
+                    return s
+                except OSError:
+                    pass  # peer may be TCP-only; fall through
             try:
                 return socket.create_connection((peer.host, peer.port), timeout=10)
             except OSError as e:
@@ -399,7 +449,8 @@ class NativeHostChannel(_ChannelOps):
         self.self_id = self_id
         self.monitor = monitor
         self._t = NativeTransport(
-            str(self_id), self_id.port, bind_host=bind_host, token=token
+            str(self_id), self_id.port, bind_host=bind_host, token=token,
+            use_unix=unixsock_enabled(),
         )
         self._control_handlers = []
         self._p2p_handlers = []

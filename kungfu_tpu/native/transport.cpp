@@ -19,6 +19,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/un.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -145,6 +146,37 @@ bool split_peer(const std::string &peer, std::string &host, uint16_t &port) {
     return true;
 }
 
+// colocated peers talk over a unix domain socket (reference: sockfile
+// /tmp/kungfu-run-<port>.sock, plan/addr.go:24; UseUnixSock=true const)
+std::string unix_sock_path(uint16_t port) {
+    return "/tmp/kf-tpu-" + std::to_string(port) + ".sock";
+}
+
+int connect_unix_once(const std::string &path, double timeout_s) {
+    int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+    if (fd < 0) { return -1; }
+    if (timeout_s > 0) {
+        struct timeval tv;
+        tv.tv_sec = static_cast<long>(timeout_s);
+        tv.tv_usec = static_cast<long>((timeout_s - tv.tv_sec) * 1e6);
+        ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+        ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    }
+    struct sockaddr_un addr;
+    std::memset(&addr, 0, sizeof(addr));
+    addr.sun_family = AF_UNIX;
+    if (path.size() >= sizeof(addr.sun_path)) {
+        ::close(fd);
+        return -1;
+    }
+    std::strncpy(addr.sun_path, path.c_str(), sizeof(addr.sun_path) - 1);
+    if (::connect(fd, reinterpret_cast<struct sockaddr *>(&addr), sizeof(addr)) != 0) {
+        ::close(fd);
+        return -1;
+    }
+    return fd;
+}
+
 int connect_once(const std::string &host, uint16_t port, double timeout_s) {
     // peer specs may carry hostnames, not just dotted quads (the Python
     // backend resolves via create_connection) — use getaddrinfo
@@ -225,8 +257,10 @@ struct ConnSlot {
 class Channel {
   public:
     Channel(std::string self_spec, const std::string &bind_host, uint16_t port,
-            uint32_t token)
-        : self_(std::move(self_spec)), token_(token) {
+            uint32_t token, bool use_unix)
+        : self_(std::move(self_spec)), token_(token), use_unix_(use_unix) {
+        auto pos = self_.rfind(':');
+        self_host_ = pos == std::string::npos ? self_ : self_.substr(0, pos);
         listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
         if (listen_fd_ < 0) { return; }
         int one = 1;
@@ -249,8 +283,32 @@ class Channel {
             listen_fd_ = -1;
             return;
         }
+        if (use_unix_) {
+            // composed server: a second listener on the colocated-peer
+            // sockfile (reference runs TCP and unix listeners together,
+            // rchannel/server/composed)
+            unix_path_ = unix_sock_path(port);
+            ::unlink(unix_path_.c_str());
+            unix_listen_fd_ = ::socket(AF_UNIX, SOCK_STREAM, 0);
+            if (unix_listen_fd_ >= 0) {
+                struct sockaddr_un ua;
+                std::memset(&ua, 0, sizeof(ua));
+                ua.sun_family = AF_UNIX;
+                std::strncpy(ua.sun_path, unix_path_.c_str(), sizeof(ua.sun_path) - 1);
+                if (::bind(unix_listen_fd_, reinterpret_cast<struct sockaddr *>(&ua),
+                           sizeof(ua)) != 0 ||
+                    ::listen(unix_listen_fd_, 128) != 0) {
+                    ::close(unix_listen_fd_);
+                    unix_listen_fd_ = -1;  // TCP-only; not fatal
+                }
+            }
+        }
         running_ = true;
-        accept_thread_ = std::thread([this] { accept_loop(); });
+        accept_thread_ = std::thread([this] { accept_loop(listen_fd_, true); });
+        if (unix_listen_fd_ >= 0) {
+            unix_accept_thread_ =
+                std::thread([this] { accept_loop(unix_listen_fd_, false); });
+        }
     }
 
     bool ok() const { return listen_fd_ >= 0; }
@@ -274,8 +332,15 @@ class Channel {
         // accept thread has exited so the loop can never accept() on an
         // fd number the kernel recycled for another socket
         ::shutdown(listen_fd_, SHUT_RDWR);
+        if (unix_listen_fd_ >= 0) { ::shutdown(unix_listen_fd_, SHUT_RDWR); }
         if (accept_thread_.joinable()) { accept_thread_.join(); }
+        if (unix_accept_thread_.joinable()) { unix_accept_thread_.join(); }
         ::close(listen_fd_);
+        if (unix_listen_fd_ >= 0) {
+            ::close(unix_listen_fd_);
+            ::unlink(unix_path_.c_str());
+            unix_listen_fd_ = -1;
+        }
         {
             std::lock_guard<std::mutex> lk(conns_mu_);
             for (auto &slot : conns_) {
@@ -446,7 +511,14 @@ class Channel {
 
   private:
     int connect_retry(const std::string &host, uint16_t port, int retries) {
+        const bool colocated = use_unix_ && host == self_host_;
         for (int i = 0; i < retries && running_.load(); ++i) {
+            if (colocated) {
+                int fd = connect_unix_once(unix_sock_path(port), 10.0);
+                if (fd >= 0) { return fd; }
+                // fall through: peer may be TCP-only (e.g. python backend
+                // with unix disabled)
+            }
             int fd = connect_once(host, port, 10.0);
             if (fd >= 0) { return fd; }
             // reference: 500 x 200ms (config.go:16-18)
@@ -455,15 +527,17 @@ class Channel {
         return -1;
     }
 
-    void accept_loop() {
+    void accept_loop(int lfd, bool is_tcp) {
         while (running_.load()) {
-            int fd = ::accept(listen_fd_, nullptr, nullptr);
+            int fd = ::accept(lfd, nullptr, nullptr);
             if (fd < 0) {
                 if (!running_.load()) { return; }
                 continue;
             }
-            int one = 1;
-            ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+            if (is_tcp) {
+                int one = 1;
+                ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+            }
             {
                 std::lock_guard<std::mutex> lk(conns_mu_);
                 // reap finished connections so short-lived clients (pings
@@ -548,10 +622,15 @@ class Channel {
     }
 
     std::string self_;
+    std::string self_host_;
     std::atomic<uint32_t> token_;
     std::atomic<bool> running_{false};
+    bool use_unix_ = false;
     int listen_fd_ = -1;
+    int unix_listen_fd_ = -1;
+    std::string unix_path_;
     std::thread accept_thread_;
+    std::thread unix_accept_thread_;
 
     std::mutex conns_mu_;
     std::vector<std::shared_ptr<ConnSlot>> conns_;
@@ -578,9 +657,9 @@ class Channel {
 extern "C" {
 
 void *kf_host_create(const char *self_spec, const char *bind_host,
-                     uint32_t port, uint32_t token) {
+                     uint32_t port, uint32_t token, int use_unix) {
     auto *ch = new Channel(self_spec, bind_host ? bind_host : "",
-                           static_cast<uint16_t>(port), token);
+                           static_cast<uint16_t>(port), token, use_unix != 0);
     if (!ch->ok()) {
         delete ch;
         return nullptr;

@@ -37,6 +37,7 @@ def _lib():
         lib.kf_host_create.restype = ctypes.c_void_p
         lib.kf_host_create.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.c_int,
         ]
         lib.kf_host_close.argtypes = [ctypes.c_void_p]
         lib.kf_host_set_token.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
@@ -75,13 +76,15 @@ def available() -> bool:
 class NativeTransport:
     """One C++ channel endpoint.  Raises OSError if the port can't bind."""
 
-    def __init__(self, self_spec: str, port: int, bind_host: str = "", token: int = 0):
+    def __init__(self, self_spec: str, port: int, bind_host: str = "",
+                 token: int = 0, use_unix: bool = True):
         lib = _lib()
         if lib is None:
             raise RuntimeError("native transport unavailable")
         self._libref = lib  # keep alive through interpreter teardown
         self._h = lib.kf_host_create(
-            self_spec.encode(), (bind_host or "").encode(), port, token
+            self_spec.encode(), (bind_host or "").encode(), port, token,
+            1 if use_unix else 0,
         )
         if not self._h:
             raise OSError(f"cannot bind native channel on port {port}")

@@ -234,6 +234,78 @@ class TestBackendSelection:
             ca.close()
 
 
+class TestUnixSocket:
+    def test_sockfile_lifecycle(self):
+        import os
+
+        from kungfu_tpu.comm.host import unix_sock_path
+
+        a = PeerID("127.0.0.1", 21920)
+        ch = PyHostChannel(a, bind_host="127.0.0.1")
+        try:
+            assert os.path.exists(unix_sock_path(21920))
+        finally:
+            ch.close()
+        assert not os.path.exists(unix_sock_path(21920))
+
+    def test_colocated_send_uses_unix(self, monkeypatch):
+        """With TCP connect disabled, colocated py->py traffic still flows."""
+        import socket as socket_mod
+
+        a, b = PeerID("127.0.0.1", 21921), PeerID("127.0.0.1", 21922)
+        ca = PyHostChannel(a, bind_host="127.0.0.1")
+        cb = PyHostChannel(b, bind_host="127.0.0.1")
+
+        def no_tcp(*args, **kwargs):
+            raise AssertionError("TCP used for colocated send")
+
+        monkeypatch.setattr(socket_mod, "create_connection", no_tcp)
+        try:
+            ca.send(b, "m", b"unix-only")
+            assert cb.recv(a, "m") == b"unix-only"
+        finally:
+            monkeypatch.undo()
+            ca.close()
+            cb.close()
+
+    def test_disabled_by_env(self, monkeypatch):
+        import os
+
+        from kungfu_tpu.comm.host import USE_UNIXSOCK, unix_sock_path
+
+        monkeypatch.setenv(USE_UNIXSOCK, "0")
+        a, b = PeerID("127.0.0.1", 21923), PeerID("127.0.0.1", 21924)
+        ca = PyHostChannel(a, bind_host="127.0.0.1")
+        cb = PyHostChannel(b, bind_host="127.0.0.1")
+        try:
+            assert not os.path.exists(unix_sock_path(21923))
+            ca.send(b, "m", b"tcp")
+            assert cb.recv(a, "m") == b"tcp"
+        finally:
+            ca.close()
+            cb.close()
+
+    @_needs_native
+    def test_native_unix_interop(self):
+        import os
+
+        from kungfu_tpu.comm.host import unix_sock_path
+
+        a, b = PeerID("127.0.0.1", 21925), PeerID("127.0.0.1", 21926)
+        ca = NativeHostChannel(a, bind_host="127.0.0.1")
+        cb = PyHostChannel(b, bind_host="127.0.0.1")
+        try:
+            assert os.path.exists(unix_sock_path(21925))  # native sockfile
+            ca.send(b, "m", b"n->p")
+            assert cb.recv(a, "m") == b"n->p"
+            cb.send(a, "m2", b"p->n")
+            assert ca.recv(b, "m2") == b"p->n"
+        finally:
+            ca.close()
+            cb.close()
+        assert not os.path.exists(unix_sock_path(21925))
+
+
 class TestStore:
     def test_size_check(self):
         s = Store()
