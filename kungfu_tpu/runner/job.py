@@ -20,6 +20,9 @@ from kungfu_tpu.plan.strategy import Strategy
 from kungfu_tpu.runner.proc import Proc
 from kungfu_tpu.utils import envs
 
+#: jax.distributed coordinator service port on the first worker's host
+COORDINATOR_PORT = 8476
+
 
 @dataclass
 class Job:
@@ -56,6 +59,17 @@ class Job:
             # sitecustomize, so the env var alone is not reliable.
             env["JAX_PLATFORMS"] = "cpu"
             env["KF_JAX_PLATFORM"] = "cpu"
+        else:
+            # TPU backend: workers form one jax.distributed world (device
+            # plane over ICI/DCN — the NCCL-bootstrap analog).  Coordinator
+            # is the first worker's host; peer.start() runs
+            # jax.distributed.initialize from these envs.
+            n = len(cluster.workers)
+            if n > 1 and rank is not None:
+                first = cluster.workers[0]
+                env[envs.COORDINATOR] = f"{first.host}:{COORDINATOR_PORT}"
+                env[envs.NUM_PROCESSES] = str(n)
+                env[envs.PROCESS_ID] = str(rank)
         # make the kungfu_tpu package importable in workers regardless of cwd
         import os as _os
 

@@ -61,3 +61,33 @@ class TestCLIParsing:
 
         ns = build_parser().parse_args(["-np", "2", "x"])
         assert build_cluster(ns).size() == 2
+
+
+class TestTpuBackendEnvContract:
+    def test_coordinator_envs_set(self):
+        """TPU-backend workers get the jax.distributed world contract."""
+        from kungfu_tpu.plan import Cluster, HostList
+        from kungfu_tpu.runner.job import COORDINATOR_PORT, Job
+        from kungfu_tpu.utils import envs as E
+
+        hl = HostList.parse("10.0.0.1:2,10.0.0.2:2")
+        cluster = Cluster(hl.gen_runner_list(), hl.gen_peer_list(4))
+        job = Job(prog="python3", args=["t.py"], backend="tpu")
+        procs = [job.new_proc(w, cluster) for w in cluster.workers]
+        assert len(procs) == 4
+        for i, p in enumerate(procs):
+            assert p.envs[E.COORDINATOR] == f"10.0.0.1:{COORDINATOR_PORT}"
+            assert p.envs[E.NUM_PROCESSES] == "4"
+            assert p.envs[E.PROCESS_ID] == str(i)
+            assert "JAX_PLATFORMS" not in p.envs
+
+    def test_single_worker_no_distributed(self):
+        from kungfu_tpu.plan import Cluster, HostList
+        from kungfu_tpu.runner.job import Job
+        from kungfu_tpu.utils import envs as E
+
+        hl = HostList.parse("10.0.0.1:1")
+        cluster = Cluster(hl.gen_runner_list(), hl.gen_peer_list(1))
+        job = Job(prog="python3", args=["t.py"], backend="tpu")
+        p = job.new_proc(cluster.workers[0], cluster)
+        assert E.COORDINATOR not in p.envs
