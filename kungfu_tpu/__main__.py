@@ -1,0 +1,13 @@
+"""``python -m kungfu_tpu`` launches the runner CLI.
+
+Parity with the reference's embedded launcher (``python -m kungfu.cmd``
+invokes the built-in ``kungfu_run_main``, ``cmd/__init__.py:7-9``) — no
+separately installed binary needed to launch a job.
+"""
+
+import sys
+
+from kungfu_tpu.runner.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())
