@@ -1,0 +1,170 @@
+"""Fused softmax-cross-entropy as a Pallas TPU kernel.
+
+For an LM head the logits tensor [B*S, V] (V ~ 32k) is the largest
+activation in the model.  ``jax.nn.log_softmax`` + gather materializes a
+second [B*S, V] tensor and autodiff saves more; this kernel streams the
+vocab once per row block, producing only per-token ``loss`` and
+``logsumexp`` — O(N) extra memory instead of O(N*V).
+
+Backward recomputes the softmax blockwise from the logits and the saved
+logsumexp (``dlogits = (softmax - onehot(target)) * g / N_tokens``) in a
+``lax.scan`` over vocab blocks, so its live memory is also one block at
+a time (the [N, V] dlogits output itself is required by the head matmul
+backward and is unavoidable).
+
+Interpret mode on CPU for tests; compiled on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_N = 128
+DEFAULT_BLOCK_V = 512
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(logits_ref, targets_ref, loss_ref, lse_ref, m_ref, l_ref,
+                t_ref, *, vocab, block_v):
+    """Grid = (row blocks, vocab blocks), vocab innermost.  One [block_n,
+    block_v] logits tile lives in VMEM at a time; the online max/sumexp/
+    target accumulators persist in scratch across the vocab sweep."""
+    j = pl.program_id(1)
+    n_v = pl.num_programs(1)
+    blk = logits_ref[...].astype(jnp.float32)  # [block_n, block_v]
+    n = blk.shape[0]
+    tgt = targets_ref[...]  # [block_n]
+
+    @pl.when(j == 0)
+    def _():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        t_ref[...] = jnp.zeros_like(t_ref)
+
+    k_pos = j * block_v + jax.lax.broadcasted_iota(jnp.int32, (n, block_v), 1)
+    valid = k_pos < vocab
+    blk = jnp.where(valid, blk, _NEG_INF)
+    m = m_ref[...]
+    m_new = jnp.maximum(m, jnp.max(blk, axis=-1, keepdims=True))
+    corr = jnp.exp(m - m_new)
+    l_new = l_ref[...] * corr + jnp.sum(
+        jnp.where(valid, jnp.exp(blk - m_new), 0.0), axis=-1, keepdims=True
+    )
+    # the target logit lives in exactly one vocab block
+    is_tgt = k_pos == tgt[:, None]
+    t_new = t_ref[...] + jnp.sum(jnp.where(is_tgt, blk, 0.0), axis=-1, keepdims=True)
+    m_ref[...] = m_new
+    l_ref[...] = l_new
+    t_ref[...] = t_new
+
+    @pl.when(j == n_v - 1)
+    def _():
+        lse = m_new + jnp.log(jnp.maximum(l_new, 1e-30))
+        loss_ref[...] = (lse - t_new)[:, 0]
+        lse_ref[...] = lse[:, 0]
+
+
+def _fwd_call(logits, targets, block_n, block_v, interpret):
+    """logits [N, V], targets [N] → (loss [N], lse [N])."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, v = logits.shape
+    n_pad = ((n + block_n - 1) // block_n) * block_n
+    v_pad = ((v + block_v - 1) // block_v) * block_v
+    if n_pad != n or v_pad != v:
+        logits = jnp.pad(logits, [(0, n_pad - n), (0, v_pad - v)])
+        targets = jnp.pad(targets, [(0, n_pad - n)])
+    kernel = functools.partial(_fwd_kernel, vocab=v, block_v=block_v)
+    loss, lse = pl.pallas_call(
+        kernel,
+        grid=(n_pad // block_n, v_pad // block_v),
+        in_specs=[
+            pl.BlockSpec((block_n, block_v), lambda i, j: (i, j)),
+            pl.BlockSpec((block_n,), lambda i, j: (i,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_n,), lambda i, j: (i,)),
+            pl.BlockSpec((block_n,), lambda i, j: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_pad,), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad,), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_n, 1), jnp.float32),
+            pltpu.VMEM((block_n, 1), jnp.float32),
+            pltpu.VMEM((block_n, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(logits, targets)
+    return loss[:n], lse[:n]
+
+
+def _bwd_blocked(logits, targets, lse, g, block_v):
+    """dlogits = (softmax - onehot) * g, computed vocab-block-wise."""
+    n, v = logits.shape
+    v_pad = ((v + block_v - 1) // block_v) * block_v
+    if v_pad != v:
+        logits = jnp.pad(logits, [(0, 0), (0, v_pad - v)])
+    n_blk = v_pad // block_v
+    xf = logits.astype(jnp.float32).reshape(n, n_blk, block_v)
+
+    def fold(_, blk):
+        j, x_blk = blk  # x_blk: [N, block_v]
+        k_pos = j * block_v + jnp.arange(block_v)
+        p = jnp.where(k_pos[None, :] < v, jnp.exp(x_blk - lse[:, None]), 0.0)
+        onehot = (k_pos[None, :] == targets[:, None]).astype(jnp.float32)
+        return None, (p - onehot) * g[:, None]
+
+    _, dblocks = jax.lax.scan(
+        fold, None, (jnp.arange(n_blk), xf.transpose(1, 0, 2))
+    )
+    return dblocks.transpose(1, 0, 2).reshape(n, v_pad)[:, :v]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _xent(logits, targets, block_n, block_v, interpret):
+    loss, _ = _fwd_call(logits, targets, block_n, block_v, interpret)
+    return loss
+
+
+def _xent_fwd(logits, targets, block_n, block_v, interpret):
+    loss, lse = _fwd_call(logits, targets, block_n, block_v, interpret)
+    return loss, (logits, targets, lse)
+
+
+def _xent_bwd(block_n, block_v, interpret, res, g):
+    logits, targets, lse = res
+    dlogits = _bwd_blocked(logits, targets, lse, g, block_v)
+    return dlogits.astype(logits.dtype), None
+
+
+_xent.defvjp(_xent_fwd, _xent_bwd)
+
+
+def softmax_cross_entropy(
+    logits,
+    targets,
+    block_n: int = DEFAULT_BLOCK_N,
+    block_v: int = DEFAULT_BLOCK_V,
+    interpret: Optional[bool] = None,
+):
+    """Per-token NLL for ``logits`` [..., V] and int targets [...].
+
+    Matches ``-log_softmax(logits)[target]`` numerically; differentiable
+    w.r.t. logits."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    v = logits.shape[-1]
+    lead = logits.shape[:-1]
+    out = _xent(
+        logits.reshape(-1, v),
+        targets.reshape(-1).astype(jnp.int32),
+        block_n, block_v, interpret,
+    )
+    return out.reshape(lead)

@@ -95,3 +95,57 @@ class TestTransformerIntegration:
         np.testing.assert_allclose(
             np.asarray(ref), np.asarray(got), atol=2e-3
         )
+
+
+class TestFusedCrossEntropy:
+    def _data(self, b=2, s=100, v=1000, seed=0):
+        rng = np.random.default_rng(seed)
+        logits = jnp.asarray(rng.normal(size=(b, s, v)) * 3, jnp.float32)
+        targets = jnp.asarray(rng.integers(0, v, size=(b, s)), jnp.int32)
+        return logits, targets
+
+    def _ref(self, logits, targets):
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.take_along_axis(logp, targets[..., None], -1).squeeze(-1)
+
+    def test_matches_log_softmax(self):
+        from kungfu_tpu.ops.pallas import softmax_cross_entropy
+
+        logits, targets = self._data()
+        got = softmax_cross_entropy(logits, targets, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(self._ref(logits, targets)), np.asarray(got), atol=1e-4
+        )
+
+    def test_grads_match(self):
+        from kungfu_tpu.ops.pallas import softmax_cross_entropy
+
+        logits, targets = self._data(b=1, s=64, v=700, seed=1)
+        gk = jax.grad(lambda x: jnp.mean(softmax_cross_entropy(x, targets, interpret=True)))(logits)
+        gr = jax.grad(lambda x: jnp.mean(self._ref(x, targets)))(logits)
+        np.testing.assert_allclose(np.asarray(gk), np.asarray(gr), atol=1e-6)
+
+    def test_bf16_logits(self):
+        from kungfu_tpu.ops.pallas import softmax_cross_entropy
+
+        logits, targets = self._data(v=512)
+        got = softmax_cross_entropy(logits.astype(jnp.bfloat16), targets, interpret=True)
+        ref = self._ref(logits.astype(jnp.bfloat16).astype(jnp.float32), targets)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(got), atol=1e-3)
+
+    def test_model_loss_fused_matches(self, monkeypatch):
+        from kungfu_tpu.models.transformer import Transformer, TransformerConfig
+
+        cfg = TransformerConfig(
+            vocab_size=256, d_model=64, n_layers=1, n_heads=2, d_ff=128,
+            max_seq=32, causal=True, dtype="float32",
+        )
+        model = Transformer(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        data = np.random.default_rng(0).integers(0, 256, size=(2, 33))
+        batch = (jnp.asarray(data[:, :-1], jnp.int32), jnp.asarray(data[:, 1:], jnp.int32))
+        monkeypatch.setenv("KF_TPU_XENT", "xla")
+        ref = model.loss(params, batch)
+        monkeypatch.setenv("KF_TPU_XENT", "fused")
+        got = model.loss(params, batch)
+        np.testing.assert_allclose(float(ref), float(got), atol=1e-5)
