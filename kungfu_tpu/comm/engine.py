@@ -177,6 +177,141 @@ class CollectiveEngine:
         out = self._run_bcast(flat.copy(), f"{tag}", bcast_g)
         return out.reshape(x.shape)
 
+    def reduce(self, x: np.ndarray, root: int = 0, op: str = "sum", name: str = "") -> np.ndarray:
+        """Reduce to ``root`` (reference ``session.go:157-161``): only the
+        root returns the reduced value; other ranks get their input back."""
+        tag = (name or f"rd{self._next_seq()}") + ".r"
+        flat = np.ascontiguousarray(x).reshape(-1)
+        eff_op = "sum" if op == "mean" else op
+        reduce_g, _ = gen_star(len(self.peers), center=root)
+        me = self.rank
+        acc = flat.copy()
+        for prev in reduce_g.prevs(me):
+            data = np.frombuffer(self._recv(prev, tag), dtype=flat.dtype)
+            acc = native.transform2(acc, data, eff_op)
+        for nxt in reduce_g.nexts(me):
+            self._send(nxt, tag, acc.tobytes())
+        if me == root and op == "mean":
+            acc = acc / len(self.peers)
+        return acc.reshape(x.shape) if me == root else x
+
+    def gather(self, x: np.ndarray, root: int = 0, name: str = "") -> Optional[np.ndarray]:
+        """Root returns [n, ...] stacked in rank order; others None
+        (reference gathers to rank 0, ``session.go:189-211``)."""
+        tag = (name or f"ga{self._next_seq()}") + ".g"
+        flat = np.ascontiguousarray(x).reshape(-1)
+        if self.rank == root:
+            parts = []
+            for r in range(len(self.peers)):
+                if r == root:
+                    parts.append(flat)
+                else:
+                    parts.append(np.frombuffer(self._recv(r, tag), dtype=flat.dtype))
+            return np.stack(parts).reshape((len(self.peers),) + x.shape)
+        self._send(root, tag, flat.tobytes())
+        return None
+
+    def all_gather(self, x: np.ndarray, name: str = "") -> np.ndarray:
+        """Direct full-exchange (reference ``allgather.go:17-45``): every
+        peer sends to every other; returns [n, ...] in rank order."""
+        tag = (name or f"ag{self._next_seq()}") + ".ag"
+        flat = np.ascontiguousarray(x).reshape(-1)
+        me = self.rank
+        for r in range(len(self.peers)):
+            if r != me:
+                self._send(r, tag, flat.tobytes())
+        parts = []
+        for r in range(len(self.peers)):
+            if r == me:
+                parts.append(flat)
+            else:
+                parts.append(np.frombuffer(self._recv(r, tag), dtype=flat.dtype))
+        return np.stack(parts).reshape((len(self.peers),) + x.shape)
+
+    # -- hierarchical (host-partitioned) collectives ----------------------
+    # Local = peers sharing this peer's host; the local root is the
+    # lowest-global-rank peer on each host (reference local masters).
+    def _local_ranks(self) -> List[int]:
+        host = self.peers[self.rank].host
+        return [r for r, p in enumerate(self.peers) if p.host == host]
+
+    def _local_roots(self) -> List[int]:
+        seen = {}
+        for r, p in enumerate(self.peers):
+            seen.setdefault(p.host, r)
+        return sorted(seen.values())
+
+    def _subset_reduce(self, flat, ranks: List[int], root: int, op: str, tag: str):
+        """Star-reduce over a rank subset; result lands on ``root``."""
+        me = self.rank
+        acc = flat.copy()
+        if me == root:
+            for r in ranks:
+                if r != root:
+                    data = np.frombuffer(self._recv(r, tag), dtype=flat.dtype)
+                    acc = native.transform2(acc, data, op)
+        else:
+            self._send(root, tag, flat.tobytes())
+        return acc
+
+    def _subset_bcast(self, flat, ranks: List[int], root: int, tag: str):
+        me = self.rank
+        if me == root:
+            for r in ranks:
+                if r != root:
+                    self._send(r, tag, flat.tobytes())
+            return flat
+        return np.frombuffer(self._recv(root, tag), dtype=flat.dtype).copy()
+
+    def local_reduce(self, x: np.ndarray, op: str = "sum", name: str = "") -> np.ndarray:
+        """Reduce among same-host peers; result on the local root
+        (reference ``LocalReduce``).  Non-roots get their input back."""
+        tag = (name or f"lr{self._next_seq()}") + ".lr"
+        flat = np.ascontiguousarray(x).reshape(-1)
+        ranks = self._local_ranks()
+        root = min(ranks)
+        acc = self._subset_reduce(flat, ranks, root, "sum" if op == "mean" else op, tag)
+        if self.rank == root:
+            if op == "mean":
+                acc = acc / len(ranks)
+            return acc.reshape(x.shape)
+        return x
+
+    def local_broadcast(self, x: np.ndarray, name: str = "") -> np.ndarray:
+        """Broadcast from the local root to same-host peers."""
+        tag = (name or f"lb{self._next_seq()}") + ".lb"
+        flat = np.ascontiguousarray(x).reshape(-1)
+        ranks = self._local_ranks()
+        out = self._subset_bcast(flat, ranks, min(ranks), tag)
+        return out.reshape(x.shape)
+
+    def cross_all_reduce(self, x: np.ndarray, op: str = "sum", name: str = "") -> np.ndarray:
+        """Hierarchical allreduce (reference ``allreduce.go:38``
+        CrossAllReduce + the ScheduledHierarchical pattern): local reduce
+        to the host roots, allreduce among roots, local broadcast."""
+        base = name or f"xa{self._next_seq()}"
+        eff_op = "sum" if op == "mean" else op
+        flat = np.ascontiguousarray(x).reshape(-1)
+        local = self._local_ranks()
+        local_root = min(local)
+        roots = self._local_roots()
+        acc = self._subset_reduce(flat, local, local_root, eff_op, base + ".lr")
+        if self.rank == local_root and len(roots) > 1:
+            # allreduce among the host roots: star at the global min root
+            top = min(roots)
+            acc = self._subset_reduce(acc, roots, top, eff_op, base + ".xr")
+            acc = self._subset_bcast(acc, roots, top, base + ".xb")
+        acc = self._subset_bcast(acc, local, local_root, base + ".lb")
+        if op == "mean":
+            acc = acc / len(self.peers)
+        return acc.reshape(x.shape)
+
+    def _next_seq(self) -> int:
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+        return seq
+
     # -- internals -------------------------------------------------------
     def _split(self, flat: np.ndarray) -> List[np.ndarray]:
         n_chunks = max(1, -(-flat.nbytes // CHUNK_SIZE))

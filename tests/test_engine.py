@@ -169,3 +169,99 @@ class TestEngine:
         finally:
             for c in chans:
                 c.close()
+
+
+class TestSessionSurfaceParity:
+    """Reduce/Gather/AllGather/Local*/CrossAllReduce (reference Session API)."""
+
+    @pytest.fixture
+    def quad(self):
+        # two simulated hosts (loopback aliases) x two peers each
+        peers = PeerList.of(
+            PeerID("127.0.0.1", 23200), PeerID("127.0.0.1", 23201),
+            PeerID("127.0.0.2", 23202), PeerID("127.0.0.2", 23203),
+        )
+        chans = [HostChannel(p, bind_host=p.host) for p in peers]
+        engines = [CollectiveEngine(c, peers, strategy=Strategy.STAR) for c in chans]
+        yield peers, engines
+        for c in chans:
+            c.close()
+
+    def _run(self, engines, fn):
+        outs = [None] * len(engines)
+        errs = []
+
+        def go(i):
+            try:
+                outs[i] = fn(i, engines[i])
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=go, args=(i,)) for i in range(len(engines))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        if errs:
+            raise errs[0]
+        return outs
+
+    def test_reduce_to_root(self, quad):
+        _, engines = quad
+        outs = self._run(
+            engines, lambda i, e: e.reduce(np.full(3, i + 1, np.float32), root=0)
+        )
+        np.testing.assert_allclose(outs[0], np.full(3, 10.0))  # 1+2+3+4
+        np.testing.assert_allclose(outs[2], np.full(3, 3.0))  # unchanged input
+
+    def test_gather(self, quad):
+        _, engines = quad
+        outs = self._run(
+            engines, lambda i, e: e.gather(np.full(2, i, np.int32), root=0)
+        )
+        np.testing.assert_array_equal(
+            outs[0], np.stack([np.full(2, i, np.int32) for i in range(4)])
+        )
+        assert outs[1] is None and outs[3] is None
+
+    def test_all_gather(self, quad):
+        _, engines = quad
+        outs = self._run(
+            engines, lambda i, e: e.all_gather(np.full(2, i, np.float32))
+        )
+        expect = np.stack([np.full(2, i, np.float32) for i in range(4)])
+        for o in outs:
+            np.testing.assert_array_equal(o, expect)
+
+    def test_local_reduce_and_broadcast(self, quad):
+        _, engines = quad
+        outs = self._run(
+            engines, lambda i, e: e.local_reduce(np.full(2, i + 1.0, np.float32))
+        )
+        np.testing.assert_allclose(outs[0], np.full(2, 3.0))  # host A: 1+2
+        np.testing.assert_allclose(outs[2], np.full(2, 7.0))  # host B: 3+4
+        np.testing.assert_allclose(outs[1], np.full(2, 2.0))  # unchanged
+        outs = self._run(
+            engines,
+            lambda i, e: e.local_broadcast(
+                np.full(2, 100.0 + i, np.float32) if i in (0, 2) else np.zeros(2, np.float32)
+            ),
+        )
+        np.testing.assert_allclose(outs[1], np.full(2, 100.0))
+        np.testing.assert_allclose(outs[3], np.full(2, 102.0))
+
+    def test_cross_all_reduce(self, quad):
+        _, engines = quad
+        outs = self._run(
+            engines, lambda i, e: e.cross_all_reduce(np.full(3, i + 1.0, np.float32))
+        )
+        for o in outs:
+            np.testing.assert_allclose(o, np.full(3, 10.0))
+
+    def test_cross_all_reduce_mean(self, quad):
+        _, engines = quad
+        outs = self._run(
+            engines, lambda i, e: e.cross_all_reduce(np.full(3, i + 1.0, np.float32), op="mean")
+        )
+        for o in outs:
+            np.testing.assert_allclose(o, np.full(3, 2.5))
