@@ -47,6 +47,10 @@ def _orbax():
 
 def _backend() -> str:
     mode = os.environ.get("KF_TPU_CKPT_BACKEND", "auto").lower()
+    if mode == "orbax" and _orbax() is None:
+        raise RuntimeError(
+            "KF_TPU_CKPT_BACKEND=orbax but orbax.checkpoint is not importable"
+        )
     if mode in ("orbax", "npz"):
         return mode
     return "orbax" if _orbax() is not None else "npz"
@@ -156,6 +160,11 @@ def restore_checkpoint(ckpt_dir: str, like_tree, step: Optional[int] = None):
 
 def _restore_orbax(path: str, like_tree, step: int):
     ocp = _orbax()
+    if ocp is None:
+        raise RuntimeError(
+            f"checkpoint {path} was written by the orbax backend but "
+            "orbax.checkpoint is not importable in this environment"
+        )
     with ocp.PyTreeCheckpointer() as ckptr:
         restored = ckptr.restore(path)
     meta = {}

@@ -69,9 +69,12 @@ def unixsock_enabled() -> bool:
     return os.environ.get(USE_UNIXSOCK, "1").lower() not in ("0", "false", "no")
 
 
-def unix_sock_path(port: int) -> str:
-    """Must match the C++ transport's scheme (transport.cpp)."""
-    return f"/tmp/kf-tpu-{port}.sock"
+def unix_sock_path(host: str, port: int) -> str:
+    """Must match the C++ transport's scheme (transport.cpp).  Keyed by
+    host AND port: loopback-alias multi-host simulations give worker j
+    the same port on every host (``gen_peer_list``), so a port-only
+    sockfile would alias two different peers on one machine."""
+    return f"/tmp/kf-tpu-{host}-{port}.sock"
 
 
 class ConnType(enum.IntEnum):
@@ -240,7 +243,7 @@ class PyHostChannel(_ChannelOps):
             class UnixServer(socketserver.ThreadingUnixStreamServer):
                 daemon_threads = True
 
-            path = unix_sock_path(self_id.port)
+            path = unix_sock_path(self_id.host, self_id.port)
             try:
                 if os.path.exists(path):
                     os.unlink(path)
@@ -350,7 +353,7 @@ class PyHostChannel(_ChannelOps):
                 try:
                     s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
                     s.settimeout(10)
-                    s.connect(unix_sock_path(peer.port))
+                    s.connect(unix_sock_path(peer.host, peer.port))
                     return s
                 except OSError:
                     pass  # peer may be TCP-only; fall through

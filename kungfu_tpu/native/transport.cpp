@@ -147,9 +147,11 @@ bool split_peer(const std::string &peer, std::string &host, uint16_t &port) {
 }
 
 // colocated peers talk over a unix domain socket (reference: sockfile
-// /tmp/kungfu-run-<port>.sock, plan/addr.go:24; UseUnixSock=true const)
-std::string unix_sock_path(uint16_t port) {
-    return "/tmp/kf-tpu-" + std::to_string(port) + ".sock";
+// /tmp/kungfu-run-<port>.sock, plan/addr.go:24; UseUnixSock=true const).
+// Keyed by host AND port: loopback-alias multi-host simulations give the
+// same port to one worker on every host, so port alone would alias peers.
+std::string unix_sock_path(const std::string &host, uint16_t port) {
+    return "/tmp/kf-tpu-" + host + "-" + std::to_string(port) + ".sock";
 }
 
 int connect_unix_once(const std::string &path, double timeout_s) {
@@ -287,7 +289,7 @@ class Channel {
             // composed server: a second listener on the colocated-peer
             // sockfile (reference runs TCP and unix listeners together,
             // rchannel/server/composed)
-            unix_path_ = unix_sock_path(port);
+            unix_path_ = unix_sock_path(self_host_, port);
             ::unlink(unix_path_.c_str());
             unix_listen_fd_ = ::socket(AF_UNIX, SOCK_STREAM, 0);
             if (unix_listen_fd_ >= 0) {
@@ -514,7 +516,7 @@ class Channel {
         const bool colocated = use_unix_ && host == self_host_;
         for (int i = 0; i < retries && running_.load(); ++i) {
             if (colocated) {
-                int fd = connect_unix_once(unix_sock_path(port), 10.0);
+                int fd = connect_unix_once(unix_sock_path(host, port), 10.0);
                 if (fd >= 0) { return fd; }
                 // fall through: peer may be TCP-only (e.g. python backend
                 // with unix disabled)

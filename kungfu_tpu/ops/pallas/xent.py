@@ -106,24 +106,29 @@ def _fwd_call(logits, targets, block_n, block_v, interpret):
 
 
 def _bwd_blocked(logits, targets, lse, g, block_v):
-    """dlogits = (softmax - onehot) * g, computed vocab-block-wise."""
+    """dlogits = (softmax - onehot) * g, computed vocab-block-wise.
+
+    Blocks are sliced from the (possibly bf16) logits INSIDE the scan
+    body and the result is cast back to the logits dtype per block, so
+    live f32 memory stays one [N, block_v] tile — the only full-size
+    tensor is the unavoidable dlogits output itself."""
     n, v = logits.shape
     v_pad = ((v + block_v - 1) // block_v) * block_v
     if v_pad != v:
         logits = jnp.pad(logits, [(0, 0), (0, v_pad - v)])
     n_blk = v_pad // block_v
-    xf = logits.astype(jnp.float32).reshape(n, n_blk, block_v)
 
-    def fold(_, blk):
-        j, x_blk = blk  # x_blk: [N, block_v]
+    def fold(_, j):
+        x_blk = jax.lax.dynamic_slice_in_dim(
+            logits, j * block_v, block_v, axis=1
+        ).astype(jnp.float32)
         k_pos = j * block_v + jnp.arange(block_v)
         p = jnp.where(k_pos[None, :] < v, jnp.exp(x_blk - lse[:, None]), 0.0)
         onehot = (k_pos[None, :] == targets[:, None]).astype(jnp.float32)
-        return None, (p - onehot) * g[:, None]
+        d_blk = (p - onehot) * g[:, None]
+        return None, d_blk.astype(logits.dtype)
 
-    _, dblocks = jax.lax.scan(
-        fold, None, (jnp.arange(n_blk), xf.transpose(1, 0, 2))
-    )
+    _, dblocks = jax.lax.scan(fold, None, jnp.arange(n_blk))
     return dblocks.transpose(1, 0, 2).reshape(n, v_pad)[:, :v]
 
 

@@ -20,8 +20,9 @@ from kungfu_tpu.plan.strategy import Strategy
 from kungfu_tpu.runner.proc import Proc
 from kungfu_tpu.utils import envs
 
-#: jax.distributed coordinator service port on the first worker's host
-COORDINATOR_PORT = 8476
+#: jax.distributed coordinator port = first worker's (job-unique) peer
+#: port + this offset, so two jobs sharing a host never collide
+COORDINATOR_PORT_OFFSET = 20000
 
 
 @dataclass
@@ -67,7 +68,8 @@ class Job:
             n = len(cluster.workers)
             if n > 1 and rank is not None:
                 first = cluster.workers[0]
-                env[envs.COORDINATOR] = f"{first.host}:{COORDINATOR_PORT}"
+                coord_port = first.port + COORDINATOR_PORT_OFFSET
+                env[envs.COORDINATOR] = f"{first.host}:{coord_port}"
                 env[envs.NUM_PROCESSES] = str(n)
                 env[envs.PROCESS_ID] = str(rank)
         # make the kungfu_tpu package importable in workers regardless of cwd

@@ -67,7 +67,7 @@ class TestTpuBackendEnvContract:
     def test_coordinator_envs_set(self):
         """TPU-backend workers get the jax.distributed world contract."""
         from kungfu_tpu.plan import Cluster, HostList
-        from kungfu_tpu.runner.job import COORDINATOR_PORT, Job
+        from kungfu_tpu.runner.job import COORDINATOR_PORT_OFFSET, Job
         from kungfu_tpu.utils import envs as E
 
         hl = HostList.parse("10.0.0.1:2,10.0.0.2:2")
@@ -76,7 +76,7 @@ class TestTpuBackendEnvContract:
         procs = [job.new_proc(w, cluster) for w in cluster.workers]
         assert len(procs) == 4
         for i, p in enumerate(procs):
-            assert p.envs[E.COORDINATOR] == f"10.0.0.1:{COORDINATOR_PORT}"
+            assert p.envs[E.COORDINATOR] == f"10.0.0.1:{cluster.workers[0].port + COORDINATOR_PORT_OFFSET}"
             assert p.envs[E.NUM_PROCESSES] == "4"
             assert p.envs[E.PROCESS_ID] == str(i)
             assert "JAX_PLATFORMS" not in p.envs

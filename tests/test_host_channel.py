@@ -243,10 +243,10 @@ class TestUnixSocket:
         a = PeerID("127.0.0.1", 21920)
         ch = PyHostChannel(a, bind_host="127.0.0.1")
         try:
-            assert os.path.exists(unix_sock_path(21920))
+            assert os.path.exists(unix_sock_path("127.0.0.1", 21920))
         finally:
             ch.close()
-        assert not os.path.exists(unix_sock_path(21920))
+        assert not os.path.exists(unix_sock_path("127.0.0.1", 21920))
 
     def test_colocated_send_uses_unix(self, monkeypatch):
         """With TCP connect disabled, colocated py->py traffic still flows."""
@@ -278,7 +278,7 @@ class TestUnixSocket:
         ca = PyHostChannel(a, bind_host="127.0.0.1")
         cb = PyHostChannel(b, bind_host="127.0.0.1")
         try:
-            assert not os.path.exists(unix_sock_path(21923))
+            assert not os.path.exists(unix_sock_path("127.0.0.1", 21923))
             ca.send(b, "m", b"tcp")
             assert cb.recv(a, "m") == b"tcp"
         finally:
@@ -295,7 +295,7 @@ class TestUnixSocket:
         ca = NativeHostChannel(a, bind_host="127.0.0.1")
         cb = PyHostChannel(b, bind_host="127.0.0.1")
         try:
-            assert os.path.exists(unix_sock_path(21925))  # native sockfile
+            assert os.path.exists(unix_sock_path("127.0.0.1", 21925))  # native sockfile
             ca.send(b, "m", b"n->p")
             assert cb.recv(a, "m") == b"n->p"
             cb.send(a, "m2", b"p->n")
@@ -303,7 +303,7 @@ class TestUnixSocket:
         finally:
             ca.close()
             cb.close()
-        assert not os.path.exists(unix_sock_path(21925))
+        assert not os.path.exists(unix_sock_path("127.0.0.1", 21925))
 
 
 class TestStore:
@@ -346,3 +346,28 @@ class TestP2PStore:
         assert got == b"weights-v0"
         assert remote_request(FakePeer, peers[1], "nope") is None
         reset_local_store()
+
+
+class TestLoopbackAliasCluster:
+    """Same worker port on two simulated hosts must not alias sockfiles
+    (regression: port-only sockfile scheme misdelivered colocated sends)."""
+
+    @pytest.mark.parametrize("cls", [PyHostChannel, NativeHostChannel])
+    def test_same_port_two_hosts(self, cls):
+        if cls is NativeHostChannel and not native_transport.available():
+            pytest.skip("native transport not built")
+        p1 = PeerID("127.0.0.1", 21940)
+        p2 = PeerID("127.0.0.2", 21940)  # same port, different loopback host
+        sender = PeerID("127.0.0.1", 21941)
+        c1 = cls(p1, bind_host=p1.host)
+        c2 = cls(p2, bind_host=p2.host)
+        cs = cls(sender, bind_host=sender.host)
+        try:
+            cs.send(p1, "m", b"to-host-1")  # colocated -> unix path
+            cs.send(p2, "m", b"to-host-2")  # cross-host -> TCP
+            assert c1.recv(sender, "m", timeout=10) == b"to-host-1"
+            assert c2.recv(sender, "m", timeout=10) == b"to-host-2"
+        finally:
+            c1.close()
+            c2.close()
+            cs.close()
