@@ -58,17 +58,34 @@ def main():
 
     start_epoch = 0
     if not args.restart and rank == 0:
-        # fresh run: drop checkpoints from previous invocations
+        # fresh run: drop checkpoints from previous invocations — BOTH
+        # backends (.npz files and .orbax directories + meta sidecars)
         import glob
+        import shutil
 
-        for f in glob.glob(os.path.join(args.ckpt_dir, "ckpt_*.npz")):
-            os.unlink(f)
+        for f in glob.glob(os.path.join(args.ckpt_dir, "ckpt_*")):
+            if os.path.isdir(f):
+                shutil.rmtree(f)
+            else:
+                os.unlink(f)
     if args.restart:
         got = restore_checkpoint(args.ckpt_dir, params)
         if got is not None:
             params, _, meta = got
             start_epoch = int(meta.get("epochs_done", 0))
             print(f"worker {rank}: restarted from epoch {start_epoch}", flush=True)
+        elif rank == 0:
+            # rank 0 owns the checkpoints, so ITS restore failing on a
+            # restart round is a real fault and must be loud — retraining
+            # from scratch silently corrupts the runner's cumulative epoch
+            # accounting.  (Other ranks legitimately have no local
+            # checkpoint; they re-sync from rank 0's broadcast below.)
+            print(
+                f"worker {rank}: RESTART WITHOUT CHECKPOINT in "
+                f"{args.ckpt_dir} (contents: "
+                f"{sorted(os.listdir(args.ckpt_dir)) if os.path.isdir(args.ckpt_dir) else 'missing'})",
+                flush=True,
+            )
         # only rank 0 writes checkpoints, and ckpt_dir may not be shared
         # across hosts — re-sync both the restored params and the resume
         # epoch from rank 0 so ranks without a local checkpoint don't

@@ -29,6 +29,7 @@ import numpy as np
 
 from kungfu_tpu import native
 from kungfu_tpu.comm.host import ConnType, HostChannel
+from kungfu_tpu.utils import envs
 from kungfu_tpu.utils.trace import trace_scope
 from kungfu_tpu.plan import (
     Strategy,
@@ -40,6 +41,11 @@ from kungfu_tpu.plan import (
     gen_multi_star,
     gen_star,
     gen_tree,
+)
+from kungfu_tpu.plan.topology import (
+    gen_clique,
+    gen_cross_binary_tree,
+    gen_cross_ring_pairs,
 )
 from kungfu_tpu.plan.graph import Graph
 from kungfu_tpu.plan.peerlist import PeerList
@@ -64,13 +70,13 @@ def build_strategy_graphs(
     if strategy == Strategy.STAR:
         return [gen_star(n)]
     if strategy == Strategy.MULTI_STAR:
-        return gen_multi_star(n)
+        return gen_multi_star(n, host_ranks)
     if strategy == Strategy.RING:
         return [gen_circular_graph_pair(n, shift=s) for s in range(n)]
     if strategy == Strategy.CLIQUE:
-        return gen_multi_star(n)
+        return gen_clique(n)
     if strategy == Strategy.TREE:
-        return [gen_tree(n)]
+        return [gen_tree(n, host_ranks)]
     if strategy == Strategy.BINARY_TREE:
         return [gen_binary_tree(n)]
     if strategy == Strategy.BINARY_TREE_STAR:
@@ -78,6 +84,27 @@ def build_strategy_graphs(
     if strategy == Strategy.MULTI_BINARY_TREE_STAR:
         return gen_multi_binary_tree_star(n, host_ranks)
     raise ValueError(f"unhandled strategy {strategy}")
+
+
+def build_cross_strategy_graphs(
+    strategy: Strategy, peers: PeerList
+) -> List[Tuple[Graph, Graph]]:
+    """Cross-host-stage strategies for hierarchical allreduce (reference
+    ``session/strategy.go:188-210`` genCrossStrategyList): RING runs ring
+    rotations over the local masters; every other strategy runs one
+    binary tree over them."""
+    n = len(peers)
+    masters = [ranks[0] for ranks in peers.partition_by_host().values() if ranks]
+    if strategy == Strategy.RING:
+        return gen_cross_ring_pairs(n, masters)
+    return gen_cross_binary_tree(n, masters)
+
+
+def name_based_hash(name: str) -> int:
+    """Name-based chunk→strategy hash (reference ``shard.go:17-23``): all
+    chunks of one tensor share a strategy keyed by its name, balancing
+    load across *tensors* instead of across chunks."""
+    return sum(ord(c) * ord(c) for c in name)
 
 
 class CollectiveEngine:
@@ -96,6 +123,14 @@ class CollectiveEngine:
             raise ValueError(f"{channel.self_id} not in {peers}")
         self.strategy = strategy
         self._graphs = build_strategy_graphs(strategy, peers)
+        self._cross_graphs = build_cross_strategy_graphs(strategy, peers)
+        # chunk→strategy hash mode (reference shard.go:25-31); read once at
+        # engine construction, like the reference reads config at init
+        import os
+
+        self._hash_name_based = (
+            os.environ.get(envs.STRATEGY_HASH_METHOD, "").strip().upper() == "NAME"
+        )
         self._seq = 0
         self._lock = threading.Lock()
         self._stats_lock = threading.Lock()  # guards stats/_window swaps
@@ -124,45 +159,12 @@ class CollectiveEngine:
         eff_op = "sum" if op == "mean" else op
         x = np.ascontiguousarray(x)
         flat = x.reshape(-1)
-        with self._lock:
-            seq = self._seq
-            self._seq += 1
-        tag = name or f"ar{seq}"
-        chunks = self._split(flat)
-        outs: List[Optional[np.ndarray]] = [None] * len(chunks)
-        errs: List[BaseException] = []
-
-        def run_chunk(i: int, chunk: np.ndarray):
-            gi = self._choose(i, tag)
-            reduce_g, bcast_g = self._graphs[gi]
-            t0 = time.perf_counter()
-            try:
-                outs[i] = self._run_graphs(chunk, eff_op, f"{tag}.c{i}", reduce_g, bcast_g)
-            except BaseException as e:  # noqa: BLE001
-                errs.append(e)
-                return
-            dt = time.perf_counter() - t0
-            if record:
-                with self._stats_lock:
-                    st = self.stats[gi]
-                    st[0] += chunk.nbytes
-                    st[1] += dt
-                    w = self._window[gi]
-                    w[0] += chunk.nbytes
-                    w[1] += dt
-
+        tag = name or f"ar{self._next_seq()}"
         with trace_scope(f"engine.all_reduce[{flat.nbytes}B]"):
-            if len(chunks) == 1:
-                run_chunk(0, chunks[0])
-            else:
-                futures = [
-                    self._pool.submit(run_chunk, i, c) for i, c in enumerate(chunks)
-                ]
-                for f in futures:
-                    f.result()
-        if errs:
-            raise errs[0]
-        out = np.concatenate(outs).reshape(x.shape)
+            out = self._run_over_graphs(
+                flat, eff_op, tag, self._graphs, record=record
+            )
+        out = out.reshape(x.shape)
         if op == "mean":
             out = out / len(self.peers)
         return out
@@ -297,14 +299,64 @@ class CollectiveEngine:
         roots = self._local_roots()
         acc = self._subset_reduce(flat, local, local_root, eff_op, base + ".lr")
         if self.rank == local_root and len(roots) > 1:
-            # allreduce among the host roots: star at the global min root
-            top = min(roots)
-            acc = self._subset_reduce(acc, roots, top, eff_op, base + ".xr")
-            acc = self._subset_bcast(acc, roots, top, base + ".xb")
+            # allreduce among the host roots via the cross-stage strategy
+            # graphs (ring rotations or binary tree over the masters,
+            # reference strategy.go:188-210), chunked like the global path
+            acc = self._run_over_graphs(
+                np.ascontiguousarray(acc), eff_op, base + ".x", self._cross_graphs
+            )
         acc = self._subset_bcast(acc, local, local_root, base + ".lb")
         if op == "mean":
             acc = acc / len(self.peers)
         return acc.reshape(x.shape)
+
+    def _run_over_graphs(
+        self,
+        flat: np.ndarray,
+        op: str,
+        tag: str,
+        graphs: List[Tuple[Graph, Graph]],
+        record: bool = False,
+    ) -> np.ndarray:
+        """The runStrategies core (reference ``session.go:292-321``):
+        chunk ``flat``, hash each chunk onto a graph pair, run the pairs
+        concurrently.  ``record`` feeds the per-strategy throughput stats
+        (only meaningful for the global strategy list, whose indices the
+        stats arrays are keyed by)."""
+        chunks = self._split(flat)
+        outs: List[Optional[np.ndarray]] = [None] * len(chunks)
+        errs: List[BaseException] = []
+
+        def run_chunk(i: int, chunk: np.ndarray):
+            gi = self._choose(i, tag, len(graphs))
+            reduce_g, bcast_g = graphs[gi]
+            t0 = time.perf_counter()
+            try:
+                outs[i] = self._run_graphs(chunk, op, f"{tag}.c{i}", reduce_g, bcast_g)
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+                return
+            if record:
+                dt = time.perf_counter() - t0
+                with self._stats_lock:
+                    st = self.stats[gi]
+                    st[0] += chunk.nbytes
+                    st[1] += dt
+                    w = self._window[gi]
+                    w[0] += chunk.nbytes
+                    w[1] += dt
+
+        if len(chunks) == 1:
+            run_chunk(0, chunks[0])
+        else:
+            futures = [
+                self._pool.submit(run_chunk, i, c) for i, c in enumerate(chunks)
+            ]
+            for f in futures:
+                f.result()
+        if errs:
+            raise errs[0]
+        return np.concatenate(outs) if len(outs) > 1 else outs[0]
 
     def _next_seq(self) -> int:
         with self._lock:
@@ -317,9 +369,15 @@ class CollectiveEngine:
         n_chunks = max(1, -(-flat.nbytes // CHUNK_SIZE))
         return [np.ascontiguousarray(c) for c in np.array_split(flat, n_chunks)]
 
-    def _choose(self, chunk_idx: int, name: str) -> int:
-        """Chunk→strategy hash (reference ``shard.go:11-31``; simple mode)."""
-        return chunk_idx % len(self._graphs)
+    def _choose(self, chunk_idx: int, name: str, n_graphs: Optional[int] = None) -> int:
+        """Chunk→strategy hash (reference ``shard.go:11-31``): simple mode
+        spreads chunks round-robin; NAME mode
+        (``KF_CONFIG_STRATEGY_HASH_METHOD=NAME``) keys on the tensor name
+        so whole tensors stick to one strategy."""
+        n = n_graphs if n_graphs is not None else len(self._graphs)
+        if self._hash_name_based:
+            return name_based_hash(name) % n
+        return chunk_idx % n
 
     def _send(self, rank: int, name: str, payload: bytes):
         self.channel.send(self.peers[rank], name, payload, ConnType.COLLECTIVE)
@@ -415,6 +473,7 @@ class CollectiveEngine:
         consensus fencing around the swap)."""
         self.strategy = strategy
         self._graphs = build_strategy_graphs(strategy, self.peers)
+        self._cross_graphs = build_cross_strategy_graphs(strategy, self.peers)
         with self._stats_lock:
             self.stats = [[0, 0.0] for _ in self._graphs]
             self._window = [[0, 0.0] for _ in self._graphs]

@@ -41,12 +41,25 @@ def gen_star(n: int, center: int = 0) -> GraphPair:
     return _pair(b)
 
 
-def gen_tree(n: int) -> GraphPair:
-    """Heap-shaped tree rooted at 0: node i's father is (i-1)//2."""
+def gen_tree(n: int, host_ranks: Sequence[Sequence[int]] = None) -> GraphPair:
+    """Host-aware tree (reference ``topology.go:17-31`` GenTree): a star
+    from each host's local master to its local ranks, plus a star over the
+    masters centered at the first.  Without host info, degenerates to a
+    flat star at rank 0 (single-host case)."""
+    if not host_ranks:
+        host_ranks = [list(range(n))]
     b = Graph(n)
-    b.add_self_loop(0)
-    for i in range(1, n):
-        b.add_edge((i - 1) // 2, i)
+    masters: List[int] = []
+    for ranks in host_ranks:
+        if not ranks:
+            continue
+        masters.append(ranks[0])
+        for r in ranks[1:]:
+            b.add_edge(ranks[0], r)
+    if masters:
+        b.add_self_loop(masters[0])
+        for m in masters[1:]:
+            b.add_edge(masters[0], m)
     return _pair(b)
 
 
@@ -94,9 +107,31 @@ def gen_multi_binary_tree_star(n: int, host_ranks: Sequence[Sequence[int]]) -> L
     return pairs
 
 
-def gen_multi_star(n: int) -> List[GraphPair]:
-    """One star per rank as center (``topology.go:117``)."""
-    return [gen_star(n, center=c) for c in range(n)]
+def gen_multi_star(n: int, host_ranks: Sequence[Sequence[int]] = None) -> List[GraphPair]:
+    """Host-aware multi-star (reference ``topology.go:117-125`` GenMultiStar
+    + ``genMultiStar``): within each host a star from the local master to
+    its ranks; across hosts a star over the masters — one graph pair per
+    master rotation, so chunks spread the cross-host load over every
+    host's NIC.  Without host info, one host is assumed (pure local star,
+    single pair)."""
+    if not host_ranks:
+        host_ranks = [list(range(n))]
+    hosts = [list(h) for h in host_ranks if h]
+    masters = [h[0] for h in hosts]
+    pairs: List[GraphPair] = []
+    for root_idx in range(max(1, len(masters))):
+        b = Graph(n)
+        for ranks in hosts:
+            for r in ranks[1:]:
+                b.add_edge(ranks[0], r)
+        if masters:
+            center = masters[root_idx % len(masters)]
+            b.add_self_loop(center)
+            for m in masters:
+                if m != center:
+                    b.add_edge(center, m)
+        pairs.append(_pair(b))
+    return pairs
 
 
 def gen_circular_graph_pair(n: int, ranks: Sequence[int] = None, shift: int = 0) -> GraphPair:
@@ -121,5 +156,33 @@ def gen_circular_graph_pair(n: int, ranks: Sequence[int] = None, shift: int = 0)
 
 
 def gen_clique(n: int) -> List[GraphPair]:
-    """All-to-all: n stars, one centered at each rank — the CLIQUE strategy."""
-    return gen_multi_star(n)
+    """All-to-all: n stars, one centered at each rank — the CLIQUE strategy
+    (reference ``topology.go:136-147``)."""
+    return [gen_star(n, center=c) for c in range(n)]
+
+
+def gen_cross_ring_pairs(n: int, masters: Sequence[int]) -> List[GraphPair]:
+    """Ring rotations over the local-master subset for the cross-host
+    stage of hierarchical allreduce (reference
+    ``subgraph.go:5-17`` + ``session/strategy.go:188-196``): one ring pair
+    per rotation; every non-master node is untouched (no self-loop)."""
+    return [
+        gen_circular_graph_pair(n, ranks=list(masters), shift=r)
+        for r in range(max(1, len(masters)))
+    ]
+
+
+def gen_cross_binary_tree(n: int, masters: Sequence[int]) -> List[GraphPair]:
+    """Binary tree over the local-master subset (reference
+    ``subgraph.go:19-31`` + ``strategy.go:198-202``), reduce graph with
+    self-loops on the masters only so non-participants stay inert."""
+    ms = list(masters)
+    b = Graph(n)
+    for i in range(len(ms)):
+        for j in (2 * i + 1, 2 * i + 2):
+            if j < len(ms):
+                b.add_edge(ms[i], ms[j])
+    r = b.reverse()
+    for m in ms:
+        r.add_self_loop(m)
+    return [(r, b)]

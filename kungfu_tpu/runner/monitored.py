@@ -131,6 +131,26 @@ def monitored_run(ns, cluster: Cluster, job: Job) -> int:
                 time.sleep(0.2)
                 codes = [r.popen.poll() for r in running]
                 if detector.results.finish_flag or all(c == 0 for c in codes):
+                    # acceptance: success means the EPOCH CONTRACT was met,
+                    # not merely that processes exited 0 — a restart round
+                    # that silently retrained from scratch (restore
+                    # failure) finishes "cleanly" having trained the wrong
+                    # epochs (VERDICT round 1).  min_epoch is the min
+                    # cumulative completed-epoch count across ranks.
+                    # Only enforceable where epoch heartbeats actually
+                    # arrive: the main host's detector (workers post only
+                    # there).  Non-main hosts always see min_epoch()==0 and
+                    # must not fail a healthy recovery; likewise a job that
+                    # never signals epochs can still finish cleanly.
+                    if total_epochs is not None and detector.min_epoch() > 0:
+                        completed = max(detector.min_epoch(), epochs_done_total)
+                        if completed < total_epochs:
+                            _log.error(
+                                "workers exited cleanly but completed only "
+                                "%d/%d epochs — epoch contract violated",
+                                completed, total_epochs,
+                            )
+                            return 1
                     _log.info("training finished")
                     return 0
                 if any(c is not None and c != 0 for c in codes):

@@ -147,16 +147,40 @@ class TestCheckpoint:
 
 @pytest.mark.slow
 class TestAutoRecoveryCLI:
-    def test_crash_recovery(self, tmp_path):
+    @staticmethod
+    def _env():
         env = dict(os.environ)
-        env.pop("JAX_PLATFORMS", None)
+        # this exercises the host-side recovery machinery (detector,
+        # restart, checkpoint restore) on a tiny SLP — force the CPU
+        # backend so worker startup latency and chip contention can't
+        # interact with the heartbeat timeout (round-1 flake)
+        env["JAX_PLATFORMS"] = "cpu"
+        return env
+
+    def test_crash_recovery(self, tmp_path):
         r = subprocess.run(
             [sys.executable, "-m", "kungfu_tpu.runner.cli", "-auto-recover", "4s",
              "-np", "2", sys.executable, "examples/failure_recovery.py",
              "--n-epochs", "3", "--die-at-epoch", "1",
              "--ckpt-dir", str(tmp_path)],
-            cwd=REPO, capture_output=True, text=True, timeout=350, env=env,
+            cwd=REPO, capture_output=True, text=True, timeout=350, env=self._env(),
         )
         assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
+        assert "restarted from epoch 1" in r.stdout
+        assert "trained epochs [1, 3) OK" in r.stdout
+
+    def test_hang_recovery(self, tmp_path):
+        """Stall path: a worker sends begin-without-end and sleeps; the
+        detector must flag it via the heartbeat timeout (not process exit)
+        and the restart round must restore + finish."""
+        r = subprocess.run(
+            [sys.executable, "-m", "kungfu_tpu.runner.cli", "-auto-recover", "3s",
+             "-np", "2", sys.executable, "examples/failure_recovery.py",
+             "--n-epochs", "3", "--hang-at-epoch", "1",
+             "--ckpt-dir", str(tmp_path)],
+            cwd=REPO, capture_output=True, text=True, timeout=350, env=self._env(),
+        )
+        assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
+        assert "simulating stall" in r.stdout
         assert "restarted from epoch 1" in r.stdout
         assert "trained epochs [1, 3) OK" in r.stdout

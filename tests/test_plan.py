@@ -163,11 +163,64 @@ class TestTopology:
         for red, bc in pairs:
             _check_broadcast_tree(bc, 4)
 
-    def test_multi_star(self):
+    def test_multi_star_single_host(self):
+        # one host -> one pure local star
         pairs = gen_multi_star(3)
+        assert len(pairs) == 1
+        _check_broadcast_tree(pairs[0][1], 3, expect_root=0)
+
+    def test_multi_star_host_aware(self):
+        # reference GenMultiStar (topology.go:117-125): per-host local
+        # stars + a rotated star over the masters, one pair per master
+        hosts = [[0, 1], [2, 3], [4, 5]]
+        pairs = gen_multi_star(6, hosts)
         assert len(pairs) == 3
-        for c, (red, bc) in enumerate(pairs):
-            _check_broadcast_tree(bc, 3, expect_root=c)
+        masters = [0, 2, 4]
+        for i, (red, bc) in enumerate(pairs):
+            _check_broadcast_tree(bc, 6, expect_root=masters[i])
+            # local edges identical in every rotation
+            for ranks in hosts:
+                assert ranks[1] in bc.nexts(ranks[0])
+            # cross edges: center -> other masters
+            for m in masters:
+                if m != masters[i]:
+                    assert m in bc.nexts(masters[i])
+
+    def test_tree_host_aware(self):
+        # reference GenTree (topology.go:17-31): local stars + star of
+        # masters centered at the first
+        red, bc = gen_tree(4, [[0, 1], [2, 3]])
+        assert bc.is_self_loop(0)
+        assert set(bc.nexts(0)) == {1, 2}
+        assert set(bc.nexts(2)) == {3}
+        _check_broadcast_tree(bc, 4, expect_root=0)
+        _check_reduce_graph(red, 4)
+
+    def test_cross_ring_pairs(self):
+        from kungfu_tpu.plan.topology import gen_cross_ring_pairs
+
+        masters = [0, 2, 4]
+        pairs = gen_cross_ring_pairs(6, masters)
+        assert len(pairs) == 3
+        for red, bc in pairs:
+            # only masters participate: non-masters have no edges/loops
+            for r in (1, 3, 5):
+                assert not red.prevs(r) and not red.nexts(r)
+                assert not red.is_self_loop(r)
+            # reduce chain covers all masters, ends where bcast starts
+            ends = [m for m in masters if not red.nexts(m)]
+            assert len(ends) == 1 and bc.is_self_loop(ends[0])
+
+    def test_cross_binary_tree(self):
+        from kungfu_tpu.plan.topology import gen_cross_binary_tree
+
+        ((red, bc),) = gen_cross_binary_tree(7, [0, 2, 4, 6])
+        assert set(bc.nexts(0)) == {2, 4}
+        assert set(bc.nexts(2)) == {6}
+        for r in (1, 3, 5):
+            assert not red.is_self_loop(r) and not bc.nexts(r)
+        for m in (0, 2, 4, 6):
+            assert red.is_self_loop(m)
 
     @pytest.mark.parametrize("n", [2, 4, 8])
     def test_ring(self, n):
