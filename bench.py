@@ -11,8 +11,19 @@ on 8x V100 ResNet-50 synchronous throughput, ~360 images/sec/GPU (the
 per-worker rate behind reference README.md:201-213's 16xV100 scalability
 plot; see BASELINE.md).
 
-Runs single-process on whatever backend JAX has (one real TPU chip under
-the driver; CPU locally).  Use --quick for a reduced-shape smoke run.
+Robustness (round-2 hardening): TPU backend init through the tunnel can
+HANG indefinitely or die with UNAVAILABLE, so the measurement payload runs
+in a subprocess with a hard timeout and is retried with backoff; on final
+failure the script still prints one well-formed JSON line carrying the
+error instead of a traceback (round 1 lost its entire perf record to one
+init failure).
+
+Modes::
+
+    python bench.py                  # headline ResNet-50 images/sec JSON
+    python bench.py --kernels        # pallas-vs-XLA flash-attn + xent micro-bench
+    python bench.py --allreduce      # device + host allreduce GiB/s
+    python bench.py --cpu --quick    # local smoke
 """
 
 from __future__ import annotations
@@ -20,43 +31,75 @@ from __future__ import annotations
 import argparse
 import functools
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
-
 BASELINE_IMG_PER_SEC_PER_WORKER = 360.0
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+PAYLOAD_ATTEMPTS = 3
+PAYLOAD_TIMEOUT_S = 900.0  # first TPU compile can be slow; hangs are common
+RETRY_BACKOFF_S = 20.0
 
 
-def main() -> None:
-    p = argparse.ArgumentParser()
-    p.add_argument("--batch-size", type=int, default=None)
-    p.add_argument("--image-size", type=int, default=None)
-    p.add_argument("--steps", type=int, default=20)
-    p.add_argument("--warmup", type=int, default=3)
-    p.add_argument("--quick", action="store_true")
-    p.add_argument("--cpu", action="store_true",
-                   help="force the CPU backend (local smoke runs; the "
-                        "jax env preloads the TPU plugin, so a simple "
-                        "JAX_PLATFORMS env is too late)")
-    args = p.parse_args()
+# --------------------------------------------------------------------------
+# guarded runner: payload in a subprocess, retried, JSON-or-error contract
+# --------------------------------------------------------------------------
+
+def run_guarded(payload_args, attempts=PAYLOAD_ATTEMPTS, timeout=PAYLOAD_TIMEOUT_S):
+    """Run ``bench.py <payload_args>`` in a subprocess; return the parsed
+    JSON object from its last stdout line, or an error dict after all
+    attempts fail.  Guards both crashes (UNAVAILABLE at backend init) and
+    hangs (tunnel never responding)."""
+    last_err = ""
+    for attempt in range(attempts):
+        if attempt:
+            time.sleep(RETRY_BACKOFF_S * attempt)
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)] + payload_args,
+                capture_output=True, text=True, timeout=timeout, cwd=REPO,
+            )
+        except subprocess.TimeoutExpired:
+            last_err = f"payload timed out after {timeout:.0f}s (backend hang?)"
+            print(f"bench: attempt {attempt}: {last_err}", file=sys.stderr)
+            continue
+        lines = [ln for ln in r.stdout.strip().splitlines() if ln.strip()]
+        if r.returncode == 0 and lines:
+            try:
+                return json.loads(lines[-1])
+            except ValueError:
+                last_err = f"payload printed non-JSON: {lines[-1][:200]}"
+        else:
+            tail = (r.stderr or r.stdout or "").strip().splitlines()[-6:]
+            last_err = f"rc={r.returncode}: " + " | ".join(tail)[-400:]
+        print(f"bench: attempt {attempt} failed: {last_err}", file=sys.stderr)
+    return {"error": last_err}
+
+
+# --------------------------------------------------------------------------
+# payloads (run inside the guarded subprocess; may crash/hang freely)
+# --------------------------------------------------------------------------
+
+def payload_resnet(args) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
 
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
-
     dev = jax.devices()[0]
-    on_tpu = dev.platform == "tpu"
-    # CPU fallback keeps the harness runnable anywhere; the recorded number
-    # is only meaningful on TPU.
+    on_tpu = dev.platform != "cpu"
     batch = args.batch_size or (64 if on_tpu else 8)
     img = args.image_size or (224 if on_tpu else 64)
+    steps, warmup = args.steps, args.warmup
     if args.quick:
-        batch, img, args.steps = 8, 64, 5
+        batch, img, steps = 8, 64, 5
 
     from kungfu_tpu.models.resnet import ResNet
-    from kungfu_tpu.optimizers import synchronous_sgd  # noqa: F401 (API parity)
 
     model = ResNet(50, num_classes=1000)
     params, bn_state = model.init(jax.random.PRNGKey(0))
@@ -85,31 +128,231 @@ def main() -> None:
     )
     labels = jnp.asarray(rng.integers(0, 1000, size=(batch,)), dtype=jnp.int32)
 
-    for _ in range(args.warmup):
+    for _ in range(warmup):
         params, bn_state, opt_state, loss = train_step(
             params, bn_state, opt_state, images, labels
         )
     jax.block_until_ready(loss)
 
     t0 = time.perf_counter()
-    for _ in range(args.steps):
+    for _ in range(steps):
         params, bn_state, opt_state, loss = train_step(
             params, bn_state, opt_state, images, labels
         )
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
 
-    img_per_sec = batch * args.steps / dt
-    print(
-        json.dumps(
-            {
-                "metric": "resnet50_images_per_sec_per_chip",
-                "value": round(img_per_sec, 2),
-                "unit": "images/sec",
-                "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC_PER_WORKER, 4),
-            }
+    img_per_sec = batch * steps / dt
+    return {
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(img_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC_PER_WORKER, 4),
+        "platform": dev.platform,
+        "batch": batch,
+        "image": img,
+    }
+
+
+def payload_kernels(args) -> dict:
+    """Pallas kernels vs their XLA equivalents on this chip (VERDICT round
+    1 weak #7: kernels were interpret-mode tested only)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    if args.quick:
+        # CPU/interpret-mode smoke shapes; the real numbers come from TPU
+        args.seq_len = min(args.seq_len, 256)
+
+    def timeit(fn, *xs, iters=20):
+        fn = jax.jit(fn)
+        out = fn(*xs)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*xs)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters
+
+    results = {}
+    rng = np.random.default_rng(0)
+
+    # flash attention: pallas kernel vs naive XLA softmax(QK^T)V
+    from kungfu_tpu.ops.pallas.attention import flash_attention
+
+    B, H, S, D = 4, 8, args.seq_len, 128
+    q = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.bfloat16)
+
+    def xla_attn(q, k, v):
+        # causal-masked softmax(QK^T)V — the O(S^2)-HBM baseline XLA
+        # produces without a fused kernel
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / (D ** 0.5)
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    t_pallas = timeit(lambda q, k, v: flash_attention(q, k, v, causal=True), q, k, v)
+    t_xla = timeit(xla_attn, q, k, v)
+    results["flash_attention"] = {
+        "pallas_ms": round(t_pallas * 1e3, 3),
+        "xla_naive_ms": round(t_xla * 1e3, 3),
+        "speedup": round(t_xla / t_pallas, 3),
+        "shape": [B, H, S, D],
+    }
+
+    # fused softmax-xent: pallas kernel vs XLA logsumexp path
+    from kungfu_tpu.ops.pallas.xent import softmax_cross_entropy
+
+    V, N = (2048, 512) if args.quick else (32768, 8192)
+    logits = jnp.asarray(rng.standard_normal((N, V)), jnp.bfloat16)
+    labels = jnp.asarray(rng.integers(0, V, N), jnp.int32)
+
+    def xla_xent(logits, labels):
+        lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+        gold = jnp.take_along_axis(
+            logits.astype(jnp.float32), labels[:, None], axis=-1
+        )[:, 0]
+        return (lse - gold).mean()
+
+    t_pallas_x = timeit(softmax_cross_entropy, logits, labels)
+    t_xla_x = timeit(xla_xent, logits, labels)
+    results["fused_xent"] = {
+        "pallas_ms": round(t_pallas_x * 1e3, 3),
+        "xla_ms": round(t_xla_x * 1e3, 3),
+        "speedup": round(t_xla_x / t_pallas_x, 3),
+        "shape": [N, V],
+    }
+
+    return {
+        "metric": "pallas_kernel_speedup_vs_xla",
+        "value": round(
+            min(results["flash_attention"]["speedup"], results["fused_xent"]["speedup"]), 3
+        ),
+        "unit": "x",
+        "vs_baseline": 1.0,
+        "platform": dev.platform,
+        "kernels": results,
+    }
+
+
+def payload_allreduce(args) -> dict:
+    """Device-plane allreduce bus bandwidth (the headline comm number)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    devs = jax.devices()
+    n = len(devs)
+    if args.quick:
+        args.mbytes = min(args.mbytes, 4)
+    nbytes = args.mbytes << 20
+    x = jnp.asarray(np.random.default_rng(0).standard_normal(nbytes // 4), jnp.float32)
+
+    if n == 1:
+        # single chip: no collective possible; measure on-chip reduction +
+        # copy as a floor and report honestly
+        fn = jax.jit(lambda x: x + x)
+    else:
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        mesh = Mesh(np.array(devs), ("d",))
+        fn = jax.jit(
+            shard_map(
+                lambda x: jax.lax.psum(x, "d"),
+                mesh=mesh, in_specs=P("d"), out_specs=P(),
+            )
         )
-    )
+    out = fn(x)
+    jax.block_until_ready(out)
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(x)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    bus = 2 * (n - 1) / max(n, 2) * nbytes / dt / (1 << 30) if n > 1 else nbytes / dt / (1 << 30)
+    return {
+        "metric": "allreduce_bus_bandwidth",
+        "value": round(bus, 3),
+        "unit": "GiB/s",
+        "vs_baseline": 1.0,
+        "platform": devs[0].platform,
+        "n_devices": n,
+        "mbytes": args.mbytes,
+    }
+
+
+PAYLOADS = {
+    "resnet": payload_resnet,
+    "kernels": payload_kernels,
+    "allreduce": payload_allreduce,
+}
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--image-size", type=int, default=None)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--seq-len", type=int, default=2048)
+    p.add_argument("--mbytes", type=int, default=64)
+    p.add_argument("--quick", action="store_true")
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend (local smoke runs; the "
+                        "jax env preloads the TPU plugin, so a simple "
+                        "JAX_PLATFORMS env is too late)")
+    p.add_argument("--kernels", action="store_true", help="pallas-vs-XLA micro-bench")
+    p.add_argument("--allreduce", action="store_true", help="allreduce GiB/s")
+    p.add_argument("--payload", choices=sorted(PAYLOADS), default=None,
+                   help=argparse.SUPPRESS)  # internal: run in-process
+    p.add_argument("--timeout", type=float, default=PAYLOAD_TIMEOUT_S)
+    args = p.parse_args()
+
+    if args.payload:
+        # inside the guarded subprocess — crash/hang freely, parent guards
+        print(json.dumps(PAYLOADS[args.payload](args)))
+        return
+
+    which = "kernels" if args.kernels else "allreduce" if args.allreduce else "resnet"
+    fwd = ["--payload", which]
+    for flag, val in [
+        ("--batch-size", args.batch_size), ("--image-size", args.image_size),
+        ("--steps", args.steps), ("--warmup", args.warmup),
+        ("--seq-len", args.seq_len), ("--mbytes", args.mbytes),
+    ]:
+        if val is not None:
+            fwd += [flag, str(val)]
+    if args.quick:
+        fwd.append("--quick")
+    if args.cpu:
+        fwd.append("--cpu")
+
+    out = run_guarded(fwd, timeout=args.timeout)
+    if "error" in out and "metric" not in out:
+        # keep the one-JSON-line contract even in total failure
+        out = {
+            "metric": {
+                "resnet": "resnet50_images_per_sec_per_chip",
+                "kernels": "pallas_kernel_speedup_vs_xla",
+                "allreduce": "allreduce_bus_bandwidth",
+            }[which],
+            "value": 0.0,
+            "unit": {"resnet": "images/sec", "kernels": "x", "allreduce": "GiB/s"}[which],
+            "vs_baseline": 0.0,
+            "error": out["error"],
+        }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

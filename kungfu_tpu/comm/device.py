@@ -41,6 +41,9 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kungfu_tpu.plan.cluster import Cluster
+from kungfu_tpu.utils.log import get_logger
+
+_log = get_logger("device")
 
 HOST_AXIS = "kf_host"
 LOCAL_AXIS = "kf_local"
@@ -87,13 +90,22 @@ class Communicator:
     @staticmethod
     def _infer_local_size(cluster: Optional[Cluster], n: int) -> int:
         """Use the cluster's per-host worker counts when they evenly tile the
-        device count; else flat (1 logical host)."""
+        device count; else flat (1 logical host) — LOUDLY, because a flat
+        mesh changes ``local_*``/``cross_*`` semantics (local collectives
+        span everything, cross collectives become no-ops)."""
         if cluster is not None and cluster.size() > 0:
             parts = [len(v) for v in cluster.workers.partition_by_host().values()]
             if len(set(parts)) == 1 and n % (n // len(parts) or 1) == 0:
                 per_host = n // len(parts)
                 if per_host * len(parts) == n and per_host >= 1:
                     return per_host
+            _log.warning(
+                "uneven host partition %s over %d devices: mesh degrades to "
+                "flat 1x%d — local_* collectives will span ALL devices and "
+                "cross_* collectives become no-ops; pass local_size= "
+                "explicitly to keep a hierarchical mesh",
+                parts, n, n,
+            )
         return n
 
     # -- metadata --------------------------------------------------------
@@ -165,12 +177,46 @@ class Communicator:
         return self._cached(key, build)(a)
 
     def reduce(self, x, root: int = 0, op: str = "sum"):
-        """Result valid on peer ``root`` (others get the same value — on TPU
-        psum to all is as cheap as reduce-to-root; parity semantics kept)."""
-        return self.all_reduce(x, op)
+        """Root-valid reduce (reference ``session.go:157-165``): peer
+        ``root``'s slice holds the reduction, every other peer's slice is
+        its own input, untouched.  (The reduction itself still computes on
+        all devices — on the torus a psum costs the same as reduce-to-root
+        — only the *visible result* honors reference semantics.)"""
+        if op not in _REDUCE_OPS:
+            raise ValueError(f"op {op!r} not in {_REDUCE_OPS}")
+        if not 0 <= root < self._n:
+            raise ValueError(f"root {root} out of range [0, {self._n})")
+        _tree_stack_check(self._n, x)
+
+        def leaf(a):
+            a = jnp.asarray(a)
+            key = ("rd", op, root, a.shape, a.dtype.name)
+
+            def build():
+                def body(s):
+                    if op == "sum":
+                        red = jax.lax.psum(s, GLOBAL_AXES)
+                    elif op == "mean":
+                        red = jax.lax.pmean(s, GLOBAL_AXES)
+                    elif op == "min":
+                        red = jax.lax.pmin(s, GLOBAL_AXES)
+                    elif op == "max":
+                        red = jax.lax.pmax(s, GLOBAL_AXES)
+                    else:  # prod
+                        g = jax.lax.all_gather(s, GLOBAL_AXES, axis=0, tiled=False)
+                        red = jnp.prod(g.reshape((-1,) + s.shape), axis=0)
+                    return jnp.where(_flat_index() == root, red, s)
+
+                return self._shard_jit(body)
+
+            return self._cached(key, build)(a)
+
+        return jax.tree_util.tree_map(leaf, x)
 
     def broadcast(self, x, root: int = 0):
         """out[i] = x[root] for all i."""
+        if not 0 <= root < self._n:
+            raise ValueError(f"root {root} out of range [0, {self._n})")
         _tree_stack_check(self._n, x)
 
         def leaf(a):
@@ -210,8 +256,15 @@ class Communicator:
 
         return jax.tree_util.tree_map(leaf, x)
 
-    def gather(self, x):
-        """Gather to rank 0 (others receive the same stacked copy)."""
+    def gather(self, x, root: int = 0):
+        """DELIBERATE SEMANTIC DIVERGENCE from the reference: the
+        reference's Gather delivers the stacked result to rank 0 only and
+        leaves other peers' buffers untouched (``session.go:189-211``).
+        On the device plane every peer receives the stacked copy
+        (= :meth:`all_gather`): an all-gather over ICI costs the same as a
+        gather-to-root, and the stacked eager calling convention cannot
+        express per-peer result shapes.  Root-only gather semantics live on
+        the host plane (:meth:`kungfu_tpu.comm.engine.CollectiveEngine.gather`)."""
         return self.all_gather(x)
 
     def local_all_reduce(self, x, op: str = "sum"):
@@ -281,13 +334,32 @@ class Communicator:
             ok = ok and bool(jnp.all(lo == hi))
         return ok
 
-    def consensus_bytes(self, data: bytes) -> bool:
-        """Consensus over an opaque byte string (cluster digests)."""
-        arr = np.frombuffer(data, dtype=np.uint8).astype(np.int32)
-        stacked = jnp.broadcast_to(arr[None], (self._n,) + arr.shape)
-        # every peer contributes the same local bytes in single-controller
-        # mode; in multi-process mode the caller stacks differing digests.
-        return self.consensus(stacked)
+    def consensus_bytes(self, digests: Sequence[bytes]) -> bool:
+        """Consensus over per-peer byte strings (cluster digests): True iff
+        all ``n`` digests agree.  The caller must supply one digest per
+        peer — in single-controller mode the controller holds all peers'
+        state, so it has all digests; broadcasting ONE local value and
+        comparing it to itself is a tautology, not consensus (round-1
+        VERDICT).  Cross-process consensus belongs to the host plane
+        (:meth:`kungfu_tpu.peer.Peer.consensus_bytes`)."""
+        if isinstance(digests, (bytes, bytearray)):
+            raise TypeError(
+                "consensus_bytes needs one digest per peer "
+                f"(a sequence of {self._n}); a single local byte string "
+                "cannot witness cross-peer agreement — use "
+                "Peer.consensus_bytes for host-plane consensus"
+            )
+        if len(digests) != self._n:
+            raise ValueError(f"expected {self._n} digests, got {len(digests)}")
+        width = max((len(d) for d in digests), default=0)
+        rows = [
+            np.frombuffer(d.ljust(width, b"\0"), dtype=np.uint8).astype(np.int32)
+            for d in digests
+        ]
+        # length disagreement must fail even when padding collides
+        lens = np.asarray([[len(d)] for d in digests], dtype=np.int32)
+        stacked = np.concatenate([np.stack(rows), lens], axis=1) if width else lens
+        return self.consensus(jnp.asarray(stacked))
 
     # -- sharding helpers -------------------------------------------------
     def data_sharding(self) -> NamedSharding:

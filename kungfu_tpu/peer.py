@@ -100,6 +100,19 @@ class Peer:
             log_event("peer-started")
 
     def _init_jax_distributed(self) -> None:
+        """Bring up the jax.distributed world ONCE per process.
+
+        Contract on membership change (the reference's ``ResetNcclHelper``
+        analog, defined here because jax.distributed cannot re-initialize
+        in-process with a different world): the multi-host device world is
+        fixed for a process's lifetime.  Elastic resize changes the
+        *worker-process* membership — the watch runner kills/spawns
+        processes, and each NEW process boots with fresh
+        ``KF_COORDINATOR``/``KF_NUM_PROCESSES`` envs.  A surviving process
+        keeps its original jax.distributed world and only rebuilds its
+        Communicator (mesh epoch); if it left the worker list it detaches
+        and exits.  ``_propose`` warns when a resize would need a different
+        device world than this process was booted with."""
         import jax
 
         with stall_detector("jax.distributed.initialize"):
@@ -109,6 +122,10 @@ class Peer:
                 process_id=self.config.process_id,
             )
         self._jax_initialized = True
+        # the device world is sized by PROCESS count (one jax process per
+        # worker), not host count — a same-host-count resize still strands
+        # surviving processes on a stale world
+        self._jax_world_procs = self.config.num_processes
 
     def close(self) -> None:
         with self._lock:
@@ -259,6 +276,20 @@ class Peer:
                     new_cluster.workers.rank(self.config.self_id) is None
                 )
                 self._comm = None  # next communicator() call builds the new epoch
+                if self._jax_initialized and not self.detached:
+                    new_procs = len(new_cluster.workers)
+                    if new_procs != getattr(self, "_jax_world_procs", new_procs):
+                        # see _init_jax_distributed: the device world is
+                        # per-process-lifetime; collectives in this process
+                        # keep spanning the ORIGINAL world's devices
+                        _log.warning(
+                            "resize to %d worker processes but this "
+                            "process's jax.distributed world has %d — "
+                            "surviving processes keep their original device "
+                            "world; the new world takes effect in "
+                            "relaunched workers only",
+                            new_procs, self._jax_world_procs,
+                        )
             log_event(f"cluster-resized-v{version}-n{new_cluster.size()}")
             return True
 
