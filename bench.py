@@ -254,8 +254,14 @@ def payload_allreduce(args) -> dict:
     n = len(devs)
     if args.quick:
         args.mbytes = min(args.mbytes, 4)
-    nbytes = args.mbytes << 20
-    x = jnp.asarray(np.random.default_rng(0).standard_normal(nbytes // 4), jnp.float32)
+    # per-RANK payload is args.mbytes (the busbw convention: each rank
+    # allreduces a buffer of this size); the global sharded array is n
+    # ranks' worth
+    per_rank_bytes = args.mbytes << 20
+    x = jnp.asarray(
+        np.random.default_rng(0).standard_normal(n * per_rank_bytes // 4),
+        jnp.float32,
+    )
 
     if n == 1:
         # single chip: no collective possible; measure on-chip reduction +
@@ -280,7 +286,12 @@ def payload_allreduce(args) -> dict:
         out = fn(x)
     jax.block_until_ready(out)
     dt = (time.perf_counter() - t0) / iters
-    bus = 2 * (n - 1) / max(n, 2) * nbytes / dt / (1 << 30) if n > 1 else nbytes / dt / (1 << 30)
+    # standard allreduce bus-bandwidth formula over the per-rank size
+    bus = (
+        2 * (n - 1) / n * per_rank_bytes / dt / (1 << 30)
+        if n > 1
+        else per_rank_bytes / dt / (1 << 30)
+    )
     return {
         "metric": "allreduce_bus_bandwidth",
         "value": round(bus, 3),

@@ -385,6 +385,21 @@ class CollectiveEngine:
     def _recv(self, rank: int, name: str) -> bytes:
         return self.channel.recv(self.peers[rank], name, ConnType.COLLECTIVE)
 
+    def _recv_into(self, rank: int, name: str, arr: np.ndarray) -> np.ndarray:
+        """Receive a same-shaped payload into ``arr`` via the registered
+        zero-copy path (native: socket→buffer in the C++ stream thread).
+        Graph collectives exchange deterministically-sized chunks, so a
+        size mismatch is a protocol violation — diagnosed loudly, not
+        papered over."""
+        if self.channel.recv_into(self.peers[rank], name, arr):
+            return arr
+        data = self._recv(rank, name)
+        raise ValueError(
+            f"collective {name!r} from rank {rank}: expected {arr.nbytes} "
+            f"bytes, got {len(data)} — peers disagree on the chunk layout "
+            "(mixed strategy/epoch?)"
+        )
+
     def _run_graphs(
         self, chunk: np.ndarray, op: str, tag: str, reduce_g: Graph, bcast_g: Graph
     ) -> np.ndarray:
@@ -395,10 +410,21 @@ class CollectiveEngine:
         acc = chunk.copy() if reduce_g.is_self_loop(me) else None
 
         # reduce stage: wait for all prevs, accumulate (native C++ kernel,
-        # numpy fallback — kungfu_tpu/native/reduce.cpp)
+        # numpy fallback — kungfu_tpu/native/reduce.cpp).  Receives land
+        # directly in a registered scratch buffer (zero-copy on the native
+        # transport: no per-message allocation or queue hop).
+        scratch: Optional[np.ndarray] = None
         for prev in reduce_g.prevs(me):
-            data = np.frombuffer(self._recv(prev, tag + ".r"), dtype=chunk.dtype)
-            acc = data.copy() if acc is None else native.transform2(acc, data, op)
+            if scratch is None:
+                scratch = np.empty_like(chunk)
+            data = self._recv_into(prev, tag + ".r", scratch)
+            if acc is None:
+                # fallback path returns a read-only frombuffer view — copy
+                # it; the fast path hands us the (writable) scratch itself
+                acc = data if data is scratch else data.copy()
+                scratch = None  # acc now owns it; next prev gets a fresh one
+            else:
+                acc = native.transform2(acc, data, op)
         if acc is None:
             acc = chunk.copy()
         for nxt in reduce_g.nexts(me):
@@ -408,7 +434,10 @@ class CollectiveEngine:
         if not bcast_g.is_self_loop(me):
             prevs = bcast_g.prevs(me)
             if prevs:
-                acc = np.frombuffer(self._recv(prevs[0], tag + ".b"), dtype=chunk.dtype).copy()
+                buf = np.empty_like(chunk)
+                acc = self._recv_into(prevs[0], tag + ".b", buf)
+                if acc is not buf:
+                    acc = acc.copy()  # frombuffer fallback view is read-only
         for nxt in bcast_g.nexts(me):
             self._send(nxt, tag + ".b", acc.tobytes())
         return acc

@@ -73,8 +73,26 @@ def unix_sock_path(host: str, port: int) -> str:
     """Must match the C++ transport's scheme (transport.cpp).  Keyed by
     host AND port: loopback-alias multi-host simulations give worker j
     the same port on every host (``gen_peer_list``), so a port-only
-    sockfile would alias two different peers on one machine."""
-    return f"/tmp/kf-tpu-{host}-{port}.sock"
+    sockfile would alias two different peers on one machine.  Sockfiles
+    live in a per-uid mode-0700 directory (override: ``KF_SOCK_DIR``) so
+    another local user on a shared host can neither squat the path nor
+    pre-bind it to intercept collective traffic."""
+    base = os.environ.get("KF_SOCK_DIR") or f"/tmp/kf-tpu-{os.getuid()}"
+    os.makedirs(base, mode=0o700, exist_ok=True)
+    # an existing dir must actually be OURS and private — makedirs with
+    # exist_ok says nothing about who owns it (a squatter could pre-create
+    # it 0777 and then swap sockfiles under us); raising OSError makes
+    # every caller fall back to TCP-only
+    st = os.lstat(base)
+    import stat as _stat
+
+    if (
+        not _stat.S_ISDIR(st.st_mode)
+        or st.st_uid != os.getuid()
+        or (st.st_mode & 0o077) != 0
+    ):
+        raise OSError(f"unsafe socket dir {base}: not a private dir owned by uid {os.getuid()}")
+    return f"{base}/{host}-{port}.sock"
 
 
 class ConnType(enum.IntEnum):
@@ -243,8 +261,8 @@ class PyHostChannel(_ChannelOps):
             class UnixServer(socketserver.ThreadingUnixStreamServer):
                 daemon_threads = True
 
-            path = unix_sock_path(self_id.host, self_id.port)
             try:
+                path = unix_sock_path(self_id.host, self_id.port)
                 if os.path.exists(path):
                     os.unlink(path)
                 self._unix_server = UnixServer(path, Handler)
@@ -431,6 +449,30 @@ class PyHostChannel(_ChannelOps):
         except queue.Empty:
             raise TimeoutError(f"recv {name!r} from {src} timed out after {timeout}s") from None
 
+    def recv_into(
+        self, src: PeerID, name: str, buf,
+        conn_type: ConnType = ConnType.COLLECTIVE,
+        timeout: Optional[float] = 60.0,
+    ) -> bool:
+        """API parity with the native backend's zero-copy receive; the
+        pure-Python path necessarily copies (bytes off the queue → buf).
+        False = size mismatch, payload left queued."""
+        q = self._queue(conn_type, str(src), name, self._token)
+        try:
+            payload = q.get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError(
+                f"recv_into {name!r} from {src} timed out after {timeout}s"
+            ) from None
+        mv = memoryview(buf).cast("B")
+        if len(payload) != mv.nbytes:
+            # put it back for the recv() fallback (FIFO position is moot:
+            # rendezvous names are unique per op instance)
+            q.put(payload)
+            return False
+        mv[:] = payload
+        return True
+
     def ping(self, peer: PeerID, timeout: float = 10.0) -> bool:
         try:
             with socket.create_connection((peer.host, peer.port), timeout=timeout) as sock:
@@ -540,6 +582,17 @@ class NativeHostChannel(_ChannelOps):
         timeout: Optional[float] = 60.0,
     ) -> bytes:
         return self._t.recv(str(src), name, int(conn_type), timeout)
+
+    def recv_into(
+        self, src: PeerID, name: str, buf,
+        conn_type: ConnType = ConnType.COLLECTIVE,
+        timeout: Optional[float] = 60.0,
+    ) -> bool:
+        """Zero-copy receive into ``buf`` (writable contiguous buffer):
+        socket→buffer in the C++ stream thread, no allocation or queue
+        hop (reference RecvInto, ``handler/collective.go:34-65``).
+        False = size mismatch, payload left queued — use :meth:`recv`."""
+        return self._t.recv_into(str(src), name, int(conn_type), timeout, buf)
 
     def ping(self, peer: PeerID, timeout: float = 10.0) -> bool:
         return self._t.ping(str(peer), timeout)

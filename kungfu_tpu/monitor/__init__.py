@@ -9,6 +9,7 @@
 """
 
 from kungfu_tpu.monitor.detector import DetectorServer, DetectorResults, DEFAULT_DETECTOR_PORT
+from kungfu_tpu.monitor.adaptive import AdaptiveStrategyDriver, monitored_all_reduce
 from kungfu_tpu.monitor.signals import (
     monitor_batch_begin,
     monitor_batch_end,
@@ -20,6 +21,8 @@ __all__ = [
     "DetectorServer",
     "DetectorResults",
     "DEFAULT_DETECTOR_PORT",
+    "AdaptiveStrategyDriver",
+    "monitored_all_reduce",
     "monitor_batch_begin",
     "monitor_batch_end",
     "monitor_epoch_end",

@@ -1,0 +1,144 @@
+"""Closed-loop strategy adaptation.
+
+The reference monitors the active strategy's throughput against a
+reference window and, when a cluster-wide majority sees a drop below 0.8x
+(network interference), swaps every peer to an alternative strategy
+(``session/adaptiveStrategies.go:57-121``) or installs the latency-MST
+tree (``tensorflow/ops/cpu/adaptation.cpp`` + ``mst.hpp``).  Round 1
+shipped the primitives (per-strategy windows, interference vote, MST,
+``set_tree``) but no driver that actually performs the swap mid-training
+— this module closes the loop.
+
+Usage (training loop, every rank)::
+
+    driver = AdaptiveStrategyDriver(peer, check_every=32)
+    for step in range(steps):
+        grads = engine.all_reduce(grads, op="mean")
+        driver.step()          # may consensus-swap the strategy
+
+The swap is fenced exactly like the reference's ``SetGlobalStrategy``
+(``session/adaptation.go:8-28``): all ranks reach the SAME decision from
+the majority vote (the vote result is itself an allreduce, so it is
+identical everywhere), agree on the proposed strategy via a consensus
+digest, barrier, then swap engines in lockstep.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from kungfu_tpu.monitor.adapt import (
+    INTERFERENCE_THRESHOLD,
+    check_interference,
+    majority_vote_interference,
+    minimum_spanning_tree_from_latencies,
+    set_tree,
+)
+from kungfu_tpu.plan.strategy import Strategy
+from kungfu_tpu.utils.log import get_logger
+
+_log = get_logger("adaptive")
+
+#: default swap rotation — mirrors the reference's single fixed
+#: "alternativeStrategy"; a rotation keeps swapping meaningful when
+#: interference persists across several strategies
+DEFAULT_ALTERNATIVES = (
+    Strategy.BINARY_TREE_STAR,
+    Strategy.MULTI_BINARY_TREE_STAR,
+    Strategy.RING,
+    Strategy.STAR,
+)
+
+
+class AdaptiveStrategyDriver:
+    """Per-rank driver; every rank must construct one with the SAME
+    arguments and call :meth:`step` at the same points in the training
+    loop (the decisions are collective)."""
+
+    def __init__(
+        self,
+        peer,
+        check_every: int = 32,
+        alternatives: Sequence[Strategy] = DEFAULT_ALTERNATIVES,
+        threshold: float = INTERFERENCE_THRESHOLD,
+        use_mst: bool = False,
+        min_steps_between_swaps: int = 2,
+    ):
+        self.peer = peer
+        self.check_every = max(1, check_every)
+        self.alternatives = list(alternatives)
+        self.threshold = threshold
+        self.use_mst = use_mst
+        self.min_checks_between_swaps = max(1, min_steps_between_swaps)
+        self._step = 0
+        self._checks_since_swap = self.min_checks_between_swaps
+        self.swaps = 0  # observability: number of performed swaps
+
+    # -- loop hook --------------------------------------------------------
+    def step(self) -> bool:
+        """Call once per training step; returns True when a strategy swap
+        happened (collectively, on every rank)."""
+        self._step += 1
+        if self._step % self.check_every:
+            return False
+        engine = self.peer.engine()
+        if engine is None:
+            return False
+        suspected = bool(
+            check_interference(engine, threshold=self.threshold)
+        )
+        # the vote is an allreduce: every rank computes the same verdict
+        agreed = majority_vote_interference(self.peer, suspected)
+        self._checks_since_swap += 1
+        if not agreed:
+            return False
+        if self._checks_since_swap < self.min_checks_between_swaps:
+            # hysteresis: a fresh strategy needs a window to establish its
+            # own best before it can be judged (prevents swap thrash)
+            return False
+        self._swap(engine)
+        self._checks_since_swap = 0
+        self.swaps += 1
+        return True
+
+    # -- the fenced swap --------------------------------------------------
+    def _next_strategy(self, engine) -> Optional[Strategy]:
+        cur = engine.strategy
+        for s in self.alternatives:
+            if s != cur:
+                return s
+        return None
+
+    def _swap(self, engine) -> None:
+        if self.use_mst:
+            forest = minimum_spanning_tree_from_latencies(self.peer)
+            # latency matrix is allgathered -> identical on all ranks ->
+            # identical MST; peer.set_tree does consensus + barrier fencing
+            self.peer.set_tree(forest)
+            _log.info("interference: installed latency-MST tree %s", forest)
+            return
+        target = self._next_strategy(engine)
+        if target is None:
+            _log.warning("interference agreed but no alternative strategy")
+            return
+        # fencing (reference adaptation.go:8-28): consensus on the proposed
+        # strategy, barrier, swap
+        digest = f"strategy:{target.name}".encode()
+        if not self.peer.consensus_bytes(digest, name="adapt-swap"):
+            raise RuntimeError(
+                f"peers disagree on the strategy swap target {target.name}"
+            )
+        self.peer.barrier()
+        engine.set_strategy(target)
+        _log.info("interference: swapped strategy to %s", target.name)
+
+
+def monitored_all_reduce(engine, x: np.ndarray, driver: AdaptiveStrategyDriver,
+                         op: str = "sum", name: str = "") -> np.ndarray:
+    """Allreduce + adaptation step in one call (the reference's
+    ``MonitoredAllReduce`` op shape, ``collective.go:16-157``)."""
+    out = engine.all_reduce(x, op=op, name=name)
+    driver.step()
+    return out

@@ -19,6 +19,8 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/types.h>
 #include <sys/un.h>
 #include <unistd.h>
 
@@ -41,6 +43,12 @@ constexpr int kConnPing = 1;
 constexpr int kConnControl = 2;
 constexpr int kConnCollective = 3;
 constexpr int kConnPeerToPeer = 4;
+
+// framing sanity limits: the wire is unauthenticated, so a u32 length
+// from a stray/hostile connection must not drive a 4 GiB allocation
+// (std::bad_alloc in a stream thread would std::terminate the worker)
+constexpr uint32_t kMaxFrame = 1u << 30;  // 1 GiB payload (model blobs fit)
+constexpr uint16_t kMaxMetaLen = 4096;    // src / name fields
 
 // callback: return 0 if consumed, nonzero to fall through to the queue
 using msg_cb = int (*)(const char *name, const uint8_t *payload,
@@ -114,23 +122,34 @@ std::string encode_msg(uint32_t token, uint8_t conn_type, const std::string &src
     return out;
 }
 
-bool decode_msg(int fd, Msg &m) {
+// header through payload_len; the payload itself is read separately so
+// the stream loop can route it straight into a registered receive buffer
+bool decode_head(int fd, Msg &m, uint32_t &payload_len) {
     uint8_t head[11];
     if (!read_exact(fd, head, sizeof(head))) { return false; }
     if (get_u32(head) != kMagic) { return false; }
     m.token = get_u32(head + 4);
     m.conn_type = head[8];
     uint16_t src_len = get_u16(head + 9);
+    if (src_len > kMaxMetaLen) { return false; }
     m.src.resize(src_len);
     if (src_len && !read_exact(fd, &m.src[0], src_len)) { return false; }
     uint8_t nl[2];
     if (!read_exact(fd, nl, 2)) { return false; }
     uint16_t name_len = get_u16(nl);
+    if (name_len > kMaxMetaLen) { return false; }
     m.name.resize(name_len);
     if (name_len && !read_exact(fd, &m.name[0], name_len)) { return false; }
     uint8_t pl[4];
     if (!read_exact(fd, pl, 4)) { return false; }
-    uint32_t payload_len = get_u32(pl);
+    payload_len = get_u32(pl);
+    if (payload_len > kMaxFrame) { return false; }
+    return true;
+}
+
+bool decode_msg(int fd, Msg &m) {
+    uint32_t payload_len = 0;
+    if (!decode_head(fd, m, payload_len)) { return false; }
     m.payload.resize(payload_len);
     if (payload_len && !read_exact(fd, &m.payload[0], payload_len)) { return false; }
     return true;
@@ -150,11 +169,37 @@ bool split_peer(const std::string &peer, std::string &host, uint16_t &port) {
 // /tmp/kungfu-run-<port>.sock, plan/addr.go:24; UseUnixSock=true const).
 // Keyed by host AND port: loopback-alias multi-host simulations give the
 // same port to one worker on every host, so port alone would alias peers.
+// Sockfiles live in a per-uid mode-0700 directory (not world-writable
+// /tmp directly) so another local user can neither squat nor intercept;
+// must stay in lockstep with kungfu_tpu/comm/host.py unix_sock_path.
+// "" = no safe directory available (another user pre-created it, say);
+// callers then skip the unix listener / fall back to TCP
+std::string unix_sock_dir() {
+    const char *env = ::getenv("KF_SOCK_DIR");
+    std::string dir =
+        env != nullptr && env[0] != '\0'
+            ? std::string(env)
+            : "/tmp/kf-tpu-" + std::to_string(::getuid());
+    ::mkdir(dir.c_str(), 0700);
+    // an existing dir must actually be OURS and private — mkdir's EEXIST
+    // says nothing about who owns it (a squatter could pre-create it 0777
+    // and then swap sockfiles under us)
+    struct stat st;
+    if (::lstat(dir.c_str(), &st) != 0 || !S_ISDIR(st.st_mode) ||
+        st.st_uid != ::getuid() || (st.st_mode & 0077) != 0) {
+        return "";
+    }
+    return dir;
+}
+
 std::string unix_sock_path(const std::string &host, uint16_t port) {
-    return "/tmp/kf-tpu-" + host + "-" + std::to_string(port) + ".sock";
+    std::string dir = unix_sock_dir();
+    if (dir.empty()) { return ""; }
+    return dir + "/" + host + "-" + std::to_string(port) + ".sock";
 }
 
 int connect_unix_once(const std::string &path, double timeout_s) {
+    if (path.empty()) { return -1; }
     int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
     if (fd < 0) { return -1; }
     if (timeout_s > 0) {
@@ -256,6 +301,19 @@ struct ConnSlot {
     std::atomic<bool> done{false};
 };
 
+// a registered zero-copy receive destination (the reference's
+// RecvInto/WaitRecvBuf, handler/collective.go:34-65, minus the wire flag:
+// registration is receiver-side only, so the format stays compatible).
+// Owned by the recv_into stack frame; the map holds a borrowed pointer.
+struct RegBuf {
+    uint8_t *buf;
+    uint32_t cap;
+    uint32_t got = 0;
+    // 0 waiting, 1 filled, 2 failed (conn dropped mid-read), 3 claimed
+    // (stream thread is writing into buf — the owner must not return)
+    int state = 0;
+};
+
 class Channel {
   public:
     Channel(std::string self_spec, const std::string &bind_host, uint16_t port,
@@ -290,6 +348,9 @@ class Channel {
             // sockfile (reference runs TCP and unix listeners together,
             // rchannel/server/composed)
             unix_path_ = unix_sock_path(self_host_, port);
+            if (unix_path_.empty()) { use_unix_ = false; }
+        }
+        if (use_unix_) {
             ::unlink(unix_path_.c_str());
             unix_listen_fd_ = ::socket(AF_UNIX, SOCK_STREAM, 0);
             if (unix_listen_fd_ >= 0) {
@@ -461,6 +522,88 @@ class Channel {
         }
     }
 
+    // Zero-copy receive into a caller-owned buffer (the reference's
+    // registered-buffer RecvInto, handler/collective.go:34-65).
+    // 0 ok, 1 timeout, 2 closed, -2 size mismatch (payload left queued —
+    // caller falls back to recv()).
+    int recv_into(const std::string &src, const std::string &name,
+                  int conn_type, double timeout_s, uint8_t *buf, uint32_t cap,
+                  uint32_t *got) {
+        QueueKey key{static_cast<uint8_t>(conn_type), src, name,
+                     conn_type == kConnCollective ? token_.load() : 0};
+        const bool forever = timeout_s < 0;
+        std::unique_lock<std::mutex> lk(q_mu_);
+        ++recv_inflight_;
+        struct Guard {
+            Channel *ch;
+            ~Guard() {
+                if (--ch->recv_inflight_ == 0) { ch->cv_.notify_all(); }
+            }
+        } guard{this};
+        auto deadline =
+            std::chrono::steady_clock::now() +
+            (forever ? std::chrono::steady_clock::duration::zero()
+                     : std::chrono::duration_cast<std::chrono::steady_clock::duration>(
+                           std::chrono::duration<double>(timeout_s)));
+        RegBuf rb{buf, cap};
+        bool registered = false;
+        auto deregister = [&] {
+            if (registered) {
+                auto it = regbufs_.find(key);
+                if (it != regbufs_.end() && it->second == &rb) { regbufs_.erase(it); }
+                registered = false;
+            }
+        };
+        for (;;) {
+            // resolution order matters: while CLAIMED (state 3) the stream
+            // thread is writing into buf and holds a pointer to this stack
+            // frame — nothing (queue hits, timeouts, shutdown) may return
+            // until the claim resolves to filled/failed.
+            if (rb.state == 1) {
+                deregister();
+                *got = rb.got;
+                return 0;
+            }
+            if (rb.state == 2) {
+                // sender connection died mid-fill: the buffer holds a torn
+                // payload and the message is gone — surface as closed
+                deregister();
+                return 2;
+            }
+            if (rb.state == 0) {
+                // a queued payload (arrived before registration, or a
+                // duplicate keyed send) wins over waiting
+                auto it = queues_.find(key);
+                if (it != queues_.end() && !it->second.empty()) {
+                    deregister();
+                    if (it->second.front().size() != cap) { return -2; }
+                    std::string payload = std::move(it->second.front());
+                    it->second.pop_front();
+                    lk.unlock();
+                    std::memcpy(buf, payload.data(), payload.size());
+                    lk.lock();
+                    *got = cap;
+                    return 0;
+                }
+                if (!running_.load()) {
+                    deregister();
+                    return 2;
+                }
+                if (!registered) {
+                    registered = regbufs_.emplace(key, &rb).second;
+                }
+            }
+            if (forever || rb.state == 3) {
+                cv_.wait(lk);
+            } else if (cv_.wait_until(lk, deadline) == std::cv_status::timeout) {
+                if (rb.state == 0) {
+                    deregister();
+                    return 1;
+                }
+            }
+        }
+    }
+
     int ping(const std::string &peer, double timeout_s) {
         std::string host;
         uint16_t port = 0;
@@ -567,8 +710,18 @@ class Channel {
     // while shutdown()ing open fds — so a shutdown can never hit an fd
     // number the kernel has already recycled for an unrelated socket.
     void stream_loop(ConnSlot *slot) {
-        Msg m;
-        while (running_.load() && decode_msg(slot->fd, m)) { dispatch(m, slot->fd); }
+        // any exception (bad_alloc on a huge-but-legal frame, etc.) drops
+        // THIS connection instead of std::terminate'ing the whole worker
+        try {
+            Msg m;
+            uint32_t plen = 0;
+            while (running_.load() && decode_head(slot->fd, m, plen)) {
+                bool consumed = false;
+                if (!read_payload(slot->fd, m, plen, consumed)) { break; }
+                if (!consumed) { dispatch(m, slot->fd); }
+            }
+        } catch (...) {
+        }
         {
             std::lock_guard<std::mutex> lk(conns_mu_);
             ::close(slot->fd);
@@ -578,6 +731,40 @@ class Channel {
         // (reaps) exclusively done slots, so it never blocks on a thread
         // that is itself waiting for conns_mu_
         slot->done.store(true);
+    }
+
+    // read the payload off the socket — directly into a registered
+    // receive buffer when one matches (zero-copy path: no allocation, no
+    // queue hop, no malloc'd copy for the ctypes boundary), else into
+    // m.payload for normal dispatch.  Runs on the stream thread.
+    bool read_payload(int fd, Msg &m, uint32_t plen, bool &consumed) {
+        consumed = false;
+        if (m.conn_type == kConnCollective) {
+            std::unique_lock<std::mutex> lk(q_mu_);
+            if (m.token >= token_.load()) {
+                auto it = regbufs_.find(
+                    QueueKey{m.conn_type, m.src, m.name, m.token});
+                if (it != regbufs_.end() && it->second->state == 0 &&
+                    it->second->cap == plen) {
+                    RegBuf *rb = it->second;
+                    rb->state = 3;  // claimed: owner must wait for us
+                    lk.unlock();
+                    bool ok = plen == 0 || read_exact(fd, rb->buf, plen);
+                    lk.lock();
+                    rb->got = plen;
+                    rb->state = ok ? 1 : 2;
+                    cv_.notify_all();
+                    {
+                        std::lock_guard<std::mutex> slk(stats_mu_);
+                        ingress_[m.src] += plen;
+                    }
+                    consumed = true;
+                    return ok;
+                }
+            }
+        }
+        m.payload.resize(plen);
+        return plen == 0 || read_exact(fd, &m.payload[0], plen);
     }
 
     void dispatch(Msg &m, int fd) {
@@ -640,6 +827,7 @@ class Channel {
     std::mutex q_mu_;
     std::condition_variable cv_;
     std::map<QueueKey, std::deque<std::string>> queues_;
+    std::map<QueueKey, RegBuf *> regbufs_;  // guarded by q_mu_; borrowed ptrs
     int recv_inflight_ = 0;  // guarded by q_mu_
 
     std::mutex pool_mu_;
@@ -695,6 +883,15 @@ int kf_host_recv(void *h, const char *src, const char *name, int conn_type,
 }
 
 void kf_host_buf_free(uint8_t *p) { ::free(p); }
+
+// 0 ok, 1 timeout, 2 closed, -2 size mismatch (payload queued; fall back
+// to kf_host_recv)
+int kf_host_recv_into(void *h, const char *src, const char *name,
+                      int conn_type, double timeout_s, uint8_t *buf,
+                      uint32_t cap, uint32_t *got) {
+    return static_cast<Channel *>(h)->recv_into(src, name, conn_type,
+                                                timeout_s, buf, cap, got);
+}
 
 int kf_host_ping(void *h, const char *peer, double timeout_s) {
     return static_cast<Channel *>(h)->ping(peer, timeout_s);

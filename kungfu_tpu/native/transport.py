@@ -56,6 +56,12 @@ def _lib():
             ctypes.POINTER(ctypes.c_uint32),
         ]
         lib.kf_host_buf_free.argtypes = [ctypes.POINTER(ctypes.c_ubyte)]
+        lib.kf_host_recv_into.restype = ctypes.c_int
+        lib.kf_host_recv_into.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_double, ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
         lib.kf_host_ping.restype = ctypes.c_int
         lib.kf_host_ping.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_double]
         lib.kf_host_reset_connections.argtypes = [ctypes.c_void_p]
@@ -131,6 +137,33 @@ class NativeTransport:
             return ctypes.string_at(out, out_len.value)
         finally:
             self._libref.kf_host_buf_free(out)
+
+    def recv_into(self, src_spec: str, name: str, conn_type: int,
+                  timeout: Optional[float], buf) -> bool:
+        """Receive directly into ``buf`` (a writable contiguous buffer,
+        e.g. a numpy array) — the registered-buffer zero-copy path
+        (reference RecvInto/WaitRecvBuf): the payload goes socket→buffer
+        with no allocation, queue hop, or ctypes copy.  Returns False on
+        size mismatch (payload stays queued; fall back to :meth:`recv`)."""
+        mv = memoryview(buf)
+        if mv.readonly or not mv.contiguous:
+            raise ValueError("recv_into needs a writable contiguous buffer")
+        cap = mv.nbytes
+        got = ctypes.c_uint32()
+        addr = ctypes.addressof(ctypes.c_char.from_buffer(buf))
+        rc = self._libref.kf_host_recv_into(
+            self._h, src_spec.encode(), name.encode(), conn_type,
+            -1.0 if timeout is None else float(timeout),
+            addr, cap, ctypes.byref(got),
+        )
+        if rc == 0:
+            return True
+        if rc == -2:
+            return False
+        if rc == 1:
+            raise TimeoutError(
+                f"recv_into {name!r} from {src_spec} timed out after {timeout}s")
+        raise ConnectionError("channel closed")
 
     def ping(self, peer_spec: str, timeout: float) -> bool:
         return self._libref.kf_host_ping(self._h, peer_spec.encode(), timeout) == 0

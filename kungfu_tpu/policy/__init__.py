@@ -11,6 +11,7 @@ elastic protocol.
 
 from kungfu_tpu.policy.base import BasePolicy, PolicyContext  # noqa: F401
 from kungfu_tpu.policy.policies import (  # noqa: F401
+    AdaptiveStrategyPolicy,
     GNSResizePolicy,
     ScheduledSizePolicy,
 )

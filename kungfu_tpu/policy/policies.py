@@ -28,6 +28,23 @@ class ScheduledSizePolicy(BasePolicy):
             ctx.request_resize(target)
 
 
+class AdaptiveStrategyPolicy(BasePolicy):
+    """Policy form of the closed adaptation loop: run the
+    :class:`~kungfu_tpu.monitor.adaptive.AdaptiveStrategyDriver` after
+    every step (it self-paces via ``check_every``).  Every rank's policy
+    runner must drive it at the same step points — the swap decision is a
+    collective."""
+
+    def __init__(self, peer, **driver_kwargs):
+        from kungfu_tpu.monitor.adaptive import AdaptiveStrategyDriver
+
+        self.driver = AdaptiveStrategyDriver(peer, **driver_kwargs)
+
+    def after_step(self, ctx: PolicyContext) -> None:
+        if self.driver.step():
+            ctx.metrics["strategy_swaps"] = float(self.driver.swaps)
+
+
 class GNSResizePolicy(BasePolicy):
     """Resize toward ``gns / batch_size`` workers, within bounds.
 

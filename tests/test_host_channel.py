@@ -6,6 +6,7 @@ multi-process integration tests, one level cheaper.
 """
 
 import threading
+import time
 
 import pytest
 
@@ -101,6 +102,47 @@ class TestHostChannel:
                 break
             time.sleep(0.05)
         assert got == [("update", b"cfg")]
+
+    def test_recv_into_zero_copy(self, channels):
+        """Registered-buffer receive (reference RecvInto/WaitRecvBuf):
+        payload lands in the caller's buffer on every backend mix —
+        registered-before-arrival AND arrived-before-registration."""
+        import numpy as np
+
+        peers, chans = channels
+        payload = np.arange(1024, dtype=np.float32)
+
+        # case 1: receiver registers first, sender fires after a delay
+        def recv_side():
+            buf = np.empty(1024, np.float32)
+            ok = chans[1].recv_into(peers[0], "ri1", buf, timeout=30.0)
+            assert ok
+            np.testing.assert_array_equal(buf, payload)
+            return True
+
+        def send_side():
+            time.sleep(0.3)
+            chans[0].send(peers[1], "ri1", payload.tobytes())
+            return True
+
+        assert all(run_all([recv_side, send_side]))
+
+        # case 2: message already queued when recv_into is called
+        chans[0].send(peers[1], "ri2", payload.tobytes())
+        time.sleep(0.3)
+        buf = np.empty(1024, np.float32)
+        assert chans[1].recv_into(peers[0], "ri2", buf, timeout=10.0)
+        np.testing.assert_array_equal(buf, payload)
+
+        # case 3: size mismatch -> False, payload stays for recv()
+        chans[0].send(peers[1], "ri3", payload.tobytes())
+        time.sleep(0.3)
+        small = np.empty(10, np.float32)
+        assert not chans[1].recv_into(peers[0], "ri3", small, timeout=10.0)
+        got = chans[1].recv(peers[0], "ri3", timeout=10.0)
+        np.testing.assert_array_equal(
+            np.frombuffer(got, np.float32), payload
+        )
 
     def test_barrier(self, channels):
         peers, chans = channels

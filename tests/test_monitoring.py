@@ -131,6 +131,80 @@ class TestAdaptIntegration:
         outs = self.run_all([lambda p=p: p.check_interference() for p in peers])
         assert outs == [False, False, False]
 
+    def test_adaptive_driver_swaps_on_interference(self, peers):
+        """Close the adaptation loop (reference adaptiveStrategies.go:
+        57-121): establish a best-throughput window, throttle the network,
+        and assert every rank swaps strategy in lockstep — with collectives
+        still correct afterwards."""
+        import time as _time
+
+        from kungfu_tpu.monitor.adaptive import AdaptiveStrategyDriver
+        from kungfu_tpu.plan import Strategy
+
+        for p in peers:
+            p.config.strategy = Strategy.STAR
+        drivers = [
+            AdaptiveStrategyDriver(p, check_every=1, min_steps_between_swaps=1)
+            for p in peers
+        ]
+        data = np.ones(64_000, np.float32)  # big enough for a stable rate
+
+        def train_step(p, d):
+            out = p.engine().all_reduce(data, op="sum")
+            swapped = d.step()
+            return out, swapped
+
+        # healthy step: establishes the reference window; the first check
+        # can never flag (window == freshly-recorded best)
+        outs = self.run_all([lambda p=p, d=d: train_step(p, d) for p, d in zip(peers, drivers)])
+        assert not any(s for _, s in outs)
+
+        # pin the recorded best far above anything this machine can do —
+        # real wall-clock rates flap under parallel test load, so the
+        # drop-below-0.8x condition is forced deterministically while the
+        # suspicion -> majority vote -> fenced swap loop stays fully real
+        for p in peers:
+            e = p.engine()
+            e.best_throughputs = [1e9] * len(e.best_throughputs)
+        originals = []
+        for p in peers:
+            ch = p.channel
+            orig = ch.send
+            originals.append((ch, orig))
+
+            def slow_send(*a, _orig=orig, **kw):
+                _time.sleep(0.005)
+                return _orig(*a, **kw)
+
+            ch.send = slow_send
+        try:
+            swapped_anywhere = False
+            for _ in range(3):
+                outs = self.run_all(
+                    [lambda p=p, d=d: train_step(p, d) for p, d in zip(peers, drivers)],
+                    timeout=120,
+                )
+                for o, _ in outs:
+                    np.testing.assert_allclose(o, data * 3)
+                flags = [s for _, s in outs]
+                assert len(set(flags)) == 1  # lockstep: all or none
+                if flags[0]:
+                    swapped_anywhere = True
+                    break
+            assert swapped_anywhere, "no swap despite sustained throttling"
+            assert all(d.swaps == 1 for d in drivers)
+            strategies = {p.engine().strategy for p in peers}
+            assert strategies == {Strategy.BINARY_TREE_STAR}
+        finally:
+            for ch, orig in originals:
+                ch.send = orig
+        # post-swap collectives remain correct at full speed
+        outs = self.run_all(
+            [lambda p=p: p.engine().all_reduce(np.full(5, 2.0, np.float32)) for p in peers]
+        )
+        for o in outs:
+            np.testing.assert_allclose(o, np.full(5, 6.0))
+
     def test_egress_rates_with_monitoring(self):
         import os
 
