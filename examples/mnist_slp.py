@@ -26,11 +26,11 @@ import optax
 
 
 def synthetic_mnist(n=4096, seed=42):
-    rng = np.random.RandomState(seed)
-    x = rng.randn(n, 28 * 28).astype(np.float32)
-    w_true = rng.randn(28 * 28, 10).astype(np.float32)
-    y = np.argmax(x @ w_true, axis=1).astype(np.int32)
-    return x, y
+    # kept as an importable name (failure_recovery.py and tests use it);
+    # the canonical copy lives in kungfu_tpu.datasets.mnist
+    from kungfu_tpu.datasets.mnist import synthetic_mnist as _syn
+
+    return _syn(n, seed)
 
 
 def main():
@@ -39,6 +39,10 @@ def main():
     ap.add_argument("--batch-size", type=int, default=64)
     ap.add_argument("--lr", type=float, default=0.2)
     ap.add_argument("--restart", type=int, default=0)
+    ap.add_argument("--data", choices=["auto", "real", "synthetic"], default="synthetic",
+                    help="'real' = cached/downloaded MNIST (hash-pinned); "
+                         "'auto' falls back to synthetic off-line; the "
+                         "default keeps CI deterministic")
     args = ap.parse_args()
 
     import kungfu_tpu as kf
@@ -53,7 +57,12 @@ def main():
     params = model.init(jax.random.PRNGKey(7 + rank))  # deliberately different
     params = broadcast_parameters(params, peer)  # ... then re-synced from rank 0
 
-    x, y = synthetic_mnist()
+    if args.data == "synthetic":
+        x, y = synthetic_mnist()
+    else:
+        from kungfu_tpu.datasets.mnist import load_mnist
+
+        x, y = load_mnist("train", synthetic_fallback=args.data == "auto")
     shard = np.arange(len(x)) % size == rank  # data-parallel shard
     x, y = x[shard], y[shard]
 

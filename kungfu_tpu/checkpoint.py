@@ -171,9 +171,28 @@ def _restore_orbax(path: str, like_tree, step: int):
     if os.path.exists(path + ".meta.json"):
         with open(path + ".meta.json") as f:
             meta = json.load(f)
-    # conform dtypes/structure to like_tree (orbax restores as numpy)
-    leaves, treedef = _flatten(like_tree)
-    got_leaves, _ = _flatten(restored)
+    # conform dtypes/structure to like_tree (orbax restores as numpy).
+    # Structure drift (model config changed since the checkpoint) must
+    # fail loudly like the npz path does on a missing key — zip() would
+    # silently truncate or mispair parameters.  Compare KEY PATHS, not
+    # just leaf counts: a renamed/reordered layer keeps the count equal
+    # while changing which array lands where.  (Paths are compared as
+    # strings so a custom pytree restored as a plain dict still matches
+    # when its keys agree.)
+    path_leaves, treedef = jax.tree_util.tree_flatten_with_path(like_tree)
+    got_path_leaves, _ = jax.tree_util.tree_flatten_with_path(restored)
+    want_paths = [jax.tree_util.keystr(p) for p, _ in path_leaves]
+    got_paths = [jax.tree_util.keystr(p) for p, _ in got_path_leaves]
+    if got_paths != want_paths:
+        missing = sorted(set(want_paths) - set(got_paths))
+        extra = sorted(set(got_paths) - set(want_paths))
+        raise ValueError(
+            f"checkpoint {path} structure does not match the restore "
+            f"target (missing: {missing[:5]}, unexpected: {extra[:5]}) — "
+            "model structure changed since this checkpoint was written"
+        )
+    leaves = [l for _, l in path_leaves]
+    got_leaves = [l for _, l in got_path_leaves]
     conformed = [
         np.asarray(g, dtype=np.asarray(like).dtype)
         for g, like in zip(got_leaves, leaves)

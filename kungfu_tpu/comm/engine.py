@@ -385,14 +385,14 @@ class CollectiveEngine:
     def _recv(self, rank: int, name: str) -> bytes:
         return self.channel.recv(self.peers[rank], name, ConnType.COLLECTIVE)
 
-    def _recv_into(self, rank: int, name: str, arr: np.ndarray) -> np.ndarray:
+    def _recv_into(self, rank: int, name: str, arr: np.ndarray) -> None:
         """Receive a same-shaped payload into ``arr`` via the registered
         zero-copy path (native: socket→buffer in the C++ stream thread).
         Graph collectives exchange deterministically-sized chunks, so a
         size mismatch is a protocol violation — diagnosed loudly, not
         papered over."""
         if self.channel.recv_into(self.peers[rank], name, arr):
-            return arr
+            return
         data = self._recv(rank, name)
         raise ValueError(
             f"collective {name!r} from rank {rank}: expected {arr.nbytes} "
@@ -417,14 +417,12 @@ class CollectiveEngine:
         for prev in reduce_g.prevs(me):
             if scratch is None:
                 scratch = np.empty_like(chunk)
-            data = self._recv_into(prev, tag + ".r", scratch)
+            self._recv_into(prev, tag + ".r", scratch)
             if acc is None:
-                # fallback path returns a read-only frombuffer view — copy
-                # it; the fast path hands us the (writable) scratch itself
-                acc = data if data is scratch else data.copy()
+                acc = scratch
                 scratch = None  # acc now owns it; next prev gets a fresh one
             else:
-                acc = native.transform2(acc, data, op)
+                acc = native.transform2(acc, scratch, op)
         if acc is None:
             acc = chunk.copy()
         for nxt in reduce_g.nexts(me):
@@ -434,10 +432,8 @@ class CollectiveEngine:
         if not bcast_g.is_self_loop(me):
             prevs = bcast_g.prevs(me)
             if prevs:
-                buf = np.empty_like(chunk)
-                acc = self._recv_into(prevs[0], tag + ".b", buf)
-                if acc is not buf:
-                    acc = acc.copy()  # frombuffer fallback view is read-only
+                acc = np.empty_like(chunk)
+                self._recv_into(prevs[0], tag + ".b", acc)
         for nxt in bcast_g.nexts(me):
             self._send(nxt, tag + ".b", acc.tobytes())
         return acc

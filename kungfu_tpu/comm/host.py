@@ -56,6 +56,12 @@ from kungfu_tpu.utils.log import get_logger
 _log = get_logger("host-chan")
 
 MAGIC = 0x4B465450  # "KFTP"
+# shared with transport.cpp kMaxFrame/kMaxMetaLen: the wire is
+# unauthenticated, so lengths from a stray connection are bounded, and
+# senders enforce the same bound loudly (error next to its cause, not a
+# silent remote connection drop)
+MAX_FRAME = 3 << 30
+MAX_META_LEN = 4096
 CONNECT_RETRIES = 500
 CONNECT_RETRY_PERIOD_S = 0.2  # reference: 500 x 200ms (config.go:16-18)
 
@@ -127,6 +133,10 @@ def _read_exact(sock: socket.socket, n: int) -> bytes:
 
 def _encode(token: int, conn_type: int, src: str, name: str, payload: bytes) -> bytes:
     sb, nb = src.encode(), name.encode()
+    if len(payload) > MAX_FRAME:
+        raise ValueError(
+            f"payload of {len(payload)} bytes exceeds the 3 GiB frame limit"
+        )
     return (
         struct.pack("<IIBH", MAGIC, token, conn_type, len(sb))
         + sb
@@ -141,10 +151,16 @@ def _decode(sock: socket.socket) -> _Msg:
     magic, token, conn_type, src_len = struct.unpack("<IIBH", _read_exact(sock, 11))
     if magic != MAGIC:
         raise ValueError(f"bad magic {magic:#x}")
+    if src_len > MAX_META_LEN:
+        raise ValueError(f"src field of {src_len} bytes over limit")
     src = _read_exact(sock, src_len).decode()
     (name_len,) = struct.unpack("<H", _read_exact(sock, 2))
+    if name_len > MAX_META_LEN:
+        raise ValueError(f"name field of {name_len} bytes over limit")
     name = _read_exact(sock, name_len).decode()
     (payload_len,) = struct.unpack("<I", _read_exact(sock, 4))
+    if payload_len > MAX_FRAME:
+        raise ValueError(f"payload of {payload_len} bytes over the frame limit")
     payload = _read_exact(sock, payload_len)
     return _Msg(token, conn_type, src, name, payload)
 

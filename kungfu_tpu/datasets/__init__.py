@@ -1,1 +1,2 @@
 from kungfu_tpu.datasets.adaptor import ElasticDataset  # noqa: F401
+from kungfu_tpu.datasets.mnist import load_mnist, synthetic_mnist  # noqa: F401

@@ -74,6 +74,7 @@ class AdaptiveStrategyDriver:
         self.min_checks_between_swaps = max(1, min_steps_between_swaps)
         self._step = 0
         self._checks_since_swap = self.min_checks_between_swaps
+        self._alt_idx = 0  # rotation cursor over `alternatives`
         self.swaps = 0  # observability: number of performed swaps
 
     # -- loop hook --------------------------------------------------------
@@ -105,8 +106,14 @@ class AdaptiveStrategyDriver:
 
     # -- the fenced swap --------------------------------------------------
     def _next_strategy(self, engine) -> Optional[Strategy]:
+        """True rotation: advance a cursor through ``alternatives`` so
+        persistent interference eventually tries every one (a first-match
+        scan would ping-pong between the first two forever)."""
         cur = engine.strategy
-        for s in self.alternatives:
+        n = len(self.alternatives)
+        for _ in range(n):
+            s = self.alternatives[self._alt_idx % n]
+            self._alt_idx += 1
             if s != cur:
                 return s
         return None

@@ -45,9 +45,12 @@ constexpr int kConnCollective = 3;
 constexpr int kConnPeerToPeer = 4;
 
 // framing sanity limits: the wire is unauthenticated, so a u32 length
-// from a stray/hostile connection must not drive a 4 GiB allocation
-// (std::bad_alloc in a stream thread would std::terminate the worker)
-constexpr uint32_t kMaxFrame = 1u << 30;  // 1 GiB payload (model blobs fit)
+// from a stray/hostile connection must not drive a near-4 GiB allocation
+// (std::bad_alloc in a stream thread would std::terminate the worker).
+// 3 GiB admits any realistic single blob (a ~700M-param f32 model);
+// SENDERS enforce the same bound loudly (error, not a silent remote
+// connection drop), keeping the failure next to its cause.
+constexpr uint32_t kMaxFrame = 3u << 30;  // shared with comm/host.py MAX_FRAME
 constexpr uint16_t kMaxMetaLen = 4096;    // src / name fields
 
 // callback: return 0 if consumed, nonzero to fall through to the queue
@@ -440,9 +443,10 @@ class Channel {
     void set_control_cb(msg_cb cb) { control_cb_ = cb; }
     void set_p2p_cb(msg_cb cb) { p2p_cb_ = cb; }
 
-    // 0 ok, -1 unreachable
+    // 0 ok, -1 unreachable, -3 payload over kMaxFrame
     int send(const std::string &peer, const std::string &name,
              const uint8_t *payload, uint32_t len, int conn_type, int retries) {
+        if (len > kMaxFrame) { return -3; }
         std::string host;
         uint16_t port = 0;
         if (!split_peer(peer, host, port)) { return -1; }

@@ -115,6 +115,12 @@ class NativeTransport:
             self._h, peer_spec.encode(), name.encode(), payload, len(payload),
             conn_type, retries,
         )
+        if rc == -3:
+            raise ValueError(
+                f"payload of {len(payload)} bytes exceeds the 3 GiB frame "
+                "limit — split the blob (the engine chunks at 1 MiB; this "
+                "can only come from an oversized p2p/control message)"
+            )
         if rc != 0:
             raise ConnectionError(
                 f"cannot reach {peer_spec} after {retries} retries")

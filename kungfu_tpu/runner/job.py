@@ -69,6 +69,11 @@ class Job:
             if n > 1 and rank is not None:
                 first = cluster.workers[0]
                 coord_port = first.port + COORDINATOR_PORT_OFFSET
+                if coord_port > 65535:
+                    # user-supplied port ranges above 45535 would derive an
+                    # impossible port and fail at jax.distributed init —
+                    # wrap back into the dynamic range instead
+                    coord_port = 20000 + (coord_port % 25536)
                 env[envs.COORDINATOR] = f"{first.host}:{coord_port}"
                 env[envs.NUM_PROCESSES] = str(n)
                 env[envs.PROCESS_ID] = str(rank)

@@ -71,3 +71,35 @@ class TestCrossFormat:
         # and the older npz is still individually restorable
         _, step, meta = ckpt.restore_checkpoint(str(tmp_path), tree, step=1)
         assert (step, meta["fmt"]) == (1, "npz")
+
+
+class TestStructureDrift:
+    def test_orbax_leaf_count_mismatch_raises(self, tmp_path, monkeypatch):
+        """A model whose structure changed since the checkpoint must fail
+        loudly, not silently truncate/mispair parameters (advisor round 1)."""
+        if ckpt._orbax() is None:
+            pytest.skip("orbax not installed")
+        monkeypatch.setenv("KF_TPU_CKPT_BACKEND", "orbax")
+        ckpt.save_checkpoint(str(tmp_path), 0, _tree())
+        grown = dict(_tree(), extra=np.zeros(2, np.float32))
+        with pytest.raises(ValueError, match="structure"):
+            ckpt.restore_checkpoint(str(tmp_path), grown)
+
+    def test_orbax_renamed_key_same_count_raises(self, tmp_path, monkeypatch):
+        """Equal leaf counts with renamed keys must also fail — count-only
+        checks would mispair arrays by flatten order."""
+        if ckpt._orbax() is None:
+            pytest.skip("orbax not installed")
+        monkeypatch.setenv("KF_TPU_CKPT_BACKEND", "orbax")
+        ckpt.save_checkpoint(str(tmp_path), 0, _tree())
+        renamed = _tree()
+        renamed["b_renamed"] = renamed.pop("b")
+        with pytest.raises(ValueError, match="structure"):
+            ckpt.restore_checkpoint(str(tmp_path), renamed)
+
+    def test_npz_mismatch_fails_loudly(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KF_TPU_CKPT_BACKEND", "npz")
+        ckpt.save_checkpoint(str(tmp_path), 0, _tree())
+        grown = dict(_tree(), extra=np.zeros(2, np.float32))
+        with pytest.raises(KeyError):
+            ckpt.restore_checkpoint(str(tmp_path), grown)

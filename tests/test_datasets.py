@@ -99,3 +99,84 @@ class TestElasticResume:
         x = np.arange(12)
         ds = ElasticDataset([x], batch_size=4, shuffle=False)
         np.testing.assert_array_equal(ds.next_batch()[0], [0, 1, 2, 3])
+
+
+class TestMnistLoader:
+    """Real-data loader (reference v1/helpers/mnist analog): IDX parsing,
+    hash pinning, cache use, and the air-gapped synthetic fallback."""
+
+    @staticmethod
+    def _write_idx(path, arr):
+        import struct
+
+        arr = np.asarray(arr, np.uint8)
+        magic = 0x800 | arr.ndim
+        with open(path, "wb") as f:
+            f.write(struct.pack(">I", magic))
+            f.write(struct.pack(f">{arr.ndim}I", *arr.shape))
+            f.write(arr.tobytes())
+
+    def _make_cache(self, directory, n=32):
+        rng = np.random.RandomState(0)
+        images = rng.randint(0, 256, size=(n, 28, 28), dtype=np.uint8)
+        labels = rng.randint(0, 10, size=(n,), dtype=np.uint8)
+        self._write_idx(directory / "train-images-idx3-ubyte", images)
+        self._write_idx(directory / "train-labels-idx1-ubyte", labels)
+        return images, labels
+
+    def test_cached_raw_idx_needs_verify_off(self, tmp_path):
+        from kungfu_tpu.datasets.mnist import load_mnist
+
+        images, labels = self._make_cache(tmp_path)
+        # raw extracted files have no pin: a verified load refuses them...
+        with pytest.raises((ValueError, RuntimeError)):
+            load_mnist("train", cache_dir=str(tmp_path),
+                       synthetic_fallback=False, timeout=0.01)
+        # ...and the explicit opt-out accepts them
+        x, y = load_mnist("train", cache_dir=str(tmp_path), verify=False,
+                          timeout=0.01)
+        assert x.shape == (32, 784) and x.dtype == np.float32
+        np.testing.assert_allclose(x[0], images[0].reshape(-1) / 255.0)
+        np.testing.assert_array_equal(y, labels.astype(np.int32))
+
+    def test_gz_hash_pin_rejects_tampering(self, tmp_path):
+        import gzip
+
+        from kungfu_tpu.datasets import mnist as M
+
+        images = np.zeros((4, 28, 28), np.uint8)
+        labels = np.zeros((4,), np.uint8)
+        self._write_idx(tmp_path / "img.tmp", images)
+        self._write_idx(tmp_path / "lab.tmp", labels)
+        for tmp, gz in [("img.tmp", "train-images-idx3-ubyte.gz"),
+                        ("lab.tmp", "train-labels-idx1-ubyte.gz")]:
+            with open(tmp_path / tmp, "rb") as fi, gzip.open(tmp_path / gz, "wb") as fo:
+                fo.write(fi.read())
+            (tmp_path / tmp).unlink()
+        # wrong digest (not the pinned canonical files) -> strict mode raises
+        with pytest.raises((ValueError, RuntimeError)):
+            M.load_mnist("train", cache_dir=str(tmp_path),
+                         synthetic_fallback=False, timeout=0.01)
+        # default mode degrades to the synthetic stand-in
+        x, y = M.load_mnist("train", cache_dir=str(tmp_path), timeout=0.01)
+        xs, ys = M.synthetic_mnist()
+        np.testing.assert_array_equal(x, xs)
+        # verify=False accepts the crafted files
+        x, y = M.load_mnist("train", cache_dir=str(tmp_path), verify=False,
+                            synthetic_fallback=False, timeout=0.01)
+        assert x.shape == (4, 784)
+
+    def test_airgapped_fallback(self, tmp_path):
+        from kungfu_tpu.datasets.mnist import load_mnist, synthetic_mnist
+
+        x, y = load_mnist("train", cache_dir=str(tmp_path / "empty"), timeout=0.01)
+        xs, ys = synthetic_mnist()
+        np.testing.assert_array_equal(x, xs)
+        np.testing.assert_array_equal(y, ys)
+
+    def test_airgapped_strict_raises(self, tmp_path):
+        from kungfu_tpu.datasets.mnist import load_mnist
+
+        with pytest.raises(RuntimeError):
+            load_mnist("train", cache_dir=str(tmp_path / "empty"),
+                       synthetic_fallback=False, timeout=0.01)
