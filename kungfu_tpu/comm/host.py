@@ -518,6 +518,7 @@ class NativeHostChannel(_ChannelOps):
         self._t.set_control_handler(self._run_handlers(self._control_handlers))
         self._t.set_p2p_handler(self._run_handlers(self._p2p_handlers))
         self._ingress_seen: Dict[str, int] = {}
+        self._egress_seen: Dict[str, int] = {}
         self._ingress_stop = threading.Event()
         self._ingress_thread: Optional[threading.Thread] = None
         if monitor is not None:
@@ -540,16 +541,25 @@ class NativeHostChannel(_ChannelOps):
         return run
 
     def _ingress_poll(self) -> None:
+        # both directions are counted in C++ (the native engine executor
+        # sends without crossing this wrapper); this thread feeds deltas
+        # into the NetMonitor at its own granularity
         while not self._ingress_stop.wait(0.5):
             try:
-                totals = self._t.ingress_totals()
+                ingress = self._t.ingress_totals()
+                egress = self._t.egress_totals()
             except Exception:  # noqa: BLE001 - channel torn down mid-poll
                 return
-            for src, total in totals.items():
+            for src, total in ingress.items():
                 delta = total - self._ingress_seen.get(src, 0)
                 if delta > 0:
                     self._ingress_seen[src] = total
                     self.monitor.ingress(src, delta)
+            for peer, total in egress.items():
+                delta = total - self._egress_seen.get(peer, 0)
+                if delta > 0:
+                    self._egress_seen[peer] = total
+                    self.monitor.egress(peer, delta)
 
     # -- lifecycle -------------------------------------------------------
     def close(self) -> None:
@@ -589,8 +599,9 @@ class NativeHostChannel(_ChannelOps):
         conn_type: ConnType = ConnType.COLLECTIVE,
         retries: int = CONNECT_RETRIES,
     ) -> None:
-        if self.monitor is not None:
-            self.monitor.egress(str(peer), len(payload))
+        # egress is counted in the C++ send (shared with the native engine
+        # executor) and polled by _ingress_poll — no wrapper-side count,
+        # which would double it
         self._t.send(str(peer), name, payload, int(conn_type), retries)
 
     def recv(

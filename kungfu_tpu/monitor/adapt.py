@@ -77,6 +77,7 @@ def set_tree(engine, forest: List[int]) -> None:
         engine.stats = [[0, 0.0]]
         engine._window = [[0, 0.0]]
         engine.best_throughputs = [0.0]
+    engine._graph_ser.clear()  # native executor serializations are stale
     engine.strategy = None
     _log.info("installed explicit tree %s", forest)
 

@@ -450,6 +450,10 @@ class Channel {
         std::string host;
         uint16_t port = 0;
         if (!split_peer(peer, host, port)) { return -1; }
+        {
+            std::lock_guard<std::mutex> lk(stats_mu_);
+            egress_[peer] += len;
+        }
         std::string data = encode_msg(token_.load(), static_cast<uint8_t>(conn_type),
                                       self_, name, payload, len);
         std::shared_ptr<PoolEntry> entry;
@@ -644,10 +648,21 @@ class Channel {
 
     // newline-separated "src bytes" ingress totals; returns bytes written
     int ingress_snapshot(char *out, int cap) {
+        return counter_snapshot(ingress_, out, cap);
+    }
+
+    // egress totals — counted in send() so traffic from the native engine
+    // executor (which never crosses the python send wrapper) is included
+    int egress_snapshot(char *out, int cap) {
+        return counter_snapshot(egress_, out, cap);
+    }
+
+    int counter_snapshot(const std::map<std::string, uint64_t> &counters,
+                         char *out, int cap) {
         std::string s;
         {
             std::lock_guard<std::mutex> lk(stats_mu_);
-            for (auto &kv : ingress_) {
+            for (auto &kv : counters) {
                 s += kv.first + " " + std::to_string(kv.second) + "\n";
             }
         }
@@ -837,14 +852,100 @@ class Channel {
     std::mutex pool_mu_;
     std::map<std::string, std::shared_ptr<PoolEntry>> pool_;
 
-    // egress accounting lives on the Python side (NativeHostChannel.send
-    // feeds the NetMonitor directly); only ingress is counted natively
     std::mutex stats_mu_;
     std::map<std::string, uint64_t> ingress_;
+    std::map<std::string, uint64_t> egress_;
 
     msg_cb control_cb_ = nullptr;
     msg_cb p2p_cb_ = nullptr;
 };
+
+// ---------------------------------------------------------------------
+// Native graph-collective executor — the reference's runGraphs hot loop
+// (srcs/go/kungfu/session/session.go:222-321) run entirely in C++: chunk
+// split (np.array_split-compatible so python/native peers interoperate),
+// chunk→graph-pair hash, recv/accumulate(send) reduce stage, broadcast
+// stage.  Receives use the channel's registered-buffer path; accumulation
+// calls the native reduce kernel (reduce.cpp, same .so).  One ctypes
+// crossing per COLLECTIVE instead of per message.
+// ---------------------------------------------------------------------
+
+struct MeGraph {
+    // me-centric adjacency of one (reduce, bcast) pair
+    bool r_selfloop = false;
+    std::vector<int32_t> r_prevs, r_nexts;
+    bool b_selfloop = false;
+    std::vector<int32_t> b_prevs, b_nexts;
+};
+
+uint64_t engine_name_hash(const std::string &name) {
+    // must match kungfu_tpu.comm.engine.name_based_hash (sum of ord^2)
+    uint64_t h = 0;
+    for (unsigned char c : name) { h += uint64_t(c) * uint64_t(c); }
+    return h;
+}
+
+}  // namespace
+
+extern "C" {
+
+// from reduce.cpp (same shared object)
+int kf_transform2(void *dst, const void *src, int64_t n, int32_t dtype,
+                  int32_t op);
+}
+
+namespace {
+
+// returns 0 ok, 1 timeout, 2 closed, -1 bad args, -4 reduce error
+int engine_run_chunk(Channel *ch, const std::vector<std::string> &peers,
+                     const MeGraph &g, uint8_t *chunk, uint64_t chunk_bytes,
+                     int64_t elems, int32_t dtype, int32_t op,
+                     const std::string &tag, double timeout_s,
+                     std::vector<uint8_t> &scratch) {
+    const std::string rtag = tag + ".r";
+    const std::string btag = tag + ".b";
+    uint32_t got = 0;
+    bool have = g.r_selfloop;  // chunk buffer already holds our contribution
+    for (int32_t prev : g.r_prevs) {
+        int rc;
+        if (!have) {
+            rc = ch->recv_into(peers[prev], rtag, kConnCollective, timeout_s,
+                               chunk, static_cast<uint32_t>(chunk_bytes), &got);
+            have = true;
+        } else {
+            if (scratch.size() < chunk_bytes) { scratch.resize(chunk_bytes); }
+            rc = ch->recv_into(peers[prev], rtag, kConnCollective, timeout_s,
+                               scratch.data(), static_cast<uint32_t>(chunk_bytes),
+                               &got);
+            if (rc == 0 &&
+                kf_transform2(chunk, scratch.data(), elems, dtype, op) != 0) {
+                return -4;
+            }
+        }
+        if (rc != 0) { return rc; }
+    }
+    for (int32_t nxt : g.r_nexts) {
+        if (ch->send(peers[nxt], rtag, chunk,
+                     static_cast<uint32_t>(chunk_bytes), kConnCollective,
+                     500) != 0) {
+            return 2;
+        }
+    }
+    if (!g.b_selfloop && !g.b_prevs.empty()) {
+        int rc = ch->recv_into(peers[g.b_prevs[0]], btag, kConnCollective,
+                               timeout_s, chunk,
+                               static_cast<uint32_t>(chunk_bytes), &got);
+        if (rc != 0) { return rc; }
+    }
+    for (int32_t nxt : g.b_nexts) {
+        if (ch->send(peers[nxt], btag, chunk,
+                     static_cast<uint32_t>(chunk_bytes), kConnCollective,
+                     500) != 0) {
+            return 2;
+        }
+    }
+    return 0;
+}
 
 }  // namespace
 
@@ -915,6 +1016,124 @@ void kf_host_set_p2p_cb(void *h, msg_cb cb) {
 
 int kf_host_ingress_snapshot(void *h, char *out, int cap) {
     return static_cast<Channel *>(h)->ingress_snapshot(out, cap);
+}
+
+int kf_host_egress_snapshot(void *h, char *out, int cap) {
+    return static_cast<Channel *>(h)->egress_snapshot(out, cap);
+}
+
+// Chunked graph allreduce over the channel, fully native (one ctypes
+// crossing per collective).  buf is reduced IN PLACE.
+//
+//   peers_csv:    "host:port,..." in rank order
+//   graph_data:   per pair [r_selfloop, n_rp, rp..., n_rn, rn...,
+//                           b_selfloop, n_bp, bp..., n_bn, bn...] (i32),
+//                 me-centric adjacency; pair_offsets[n_pairs+1] slices it
+//   hash_mode:    0 = chunk-index round robin, 1 = name hash (shard.go)
+//   stats_out:    [n_pairs*2] += (bytes, seconds) per pair (may be null)
+//
+// returns 0 ok, 1 timeout, 2 closed/unreachable, -1 bad args, -4 reduce
+int kf_engine_all_reduce(void *h, const char *peers_csv, uint8_t *buf,
+                         uint64_t nbytes, int64_t elem_size, int32_t dtype,
+                         int32_t op, const int32_t *graph_data,
+                         const int32_t *pair_offsets, int32_t n_pairs,
+                         const char *tag, int32_t hash_mode,
+                         uint64_t chunk_size, double timeout_s,
+                         int32_t max_threads, double *stats_out) {
+    auto *ch = static_cast<Channel *>(h);
+    if (n_pairs <= 0 || elem_size <= 0 || nbytes % elem_size != 0) {
+        return -1;
+    }
+    std::vector<std::string> peers;
+    {
+        std::string s(peers_csv);
+        size_t pos = 0;
+        while (pos <= s.size()) {
+            size_t c = s.find(',', pos);
+            if (c == std::string::npos) { c = s.size(); }
+            if (c > pos) { peers.emplace_back(s.substr(pos, c - pos)); }
+            pos = c + 1;
+        }
+    }
+    std::vector<MeGraph> graphs(n_pairs);
+    for (int32_t p = 0; p < n_pairs; ++p) {
+        const int32_t *d = graph_data + pair_offsets[p];
+        MeGraph &g = graphs[p];
+        size_t i = 0;
+        g.r_selfloop = d[i++] != 0;
+        for (int32_t k = d[i++]; k > 0; --k) { g.r_prevs.push_back(d[i++]); }
+        for (int32_t k = d[i++]; k > 0; --k) { g.r_nexts.push_back(d[i++]); }
+        g.b_selfloop = d[i++] != 0;
+        for (int32_t k = d[i++]; k > 0; --k) { g.b_prevs.push_back(d[i++]); }
+        for (int32_t k = d[i++]; k > 0; --k) { g.b_nexts.push_back(d[i++]); }
+    }
+
+    // chunk boundaries must replicate np.array_split over ELEMENTS so
+    // python-backend peers slice identically
+    const uint64_t total_elems = nbytes / uint64_t(elem_size);
+    uint64_t n_chunks = (nbytes + chunk_size - 1) / chunk_size;
+    if (n_chunks == 0) { n_chunks = 1; }
+    if (n_chunks > total_elems && total_elems > 0) { n_chunks = total_elems; }
+    const uint64_t base = total_elems / n_chunks;
+    const uint64_t rem = total_elems % n_chunks;
+
+    std::mutex stats_mu;
+    std::atomic<int> first_err{0};
+    const std::string tag_s(tag);
+    const uint64_t name_h = engine_name_hash(tag_s);
+
+    auto run_chunk = [&](uint64_t ci, uint64_t elem_off, uint64_t elems,
+                         std::vector<uint8_t> &scratch) {
+        const int32_t gi = static_cast<int32_t>(
+            (hash_mode == 1 ? name_h : ci) % uint64_t(n_pairs));
+        uint8_t *cbuf = buf + elem_off * uint64_t(elem_size);
+        const uint64_t cbytes = elems * uint64_t(elem_size);
+        auto t0 = std::chrono::steady_clock::now();
+        int rc = engine_run_chunk(ch, peers, graphs[gi], cbuf, cbytes,
+                                  static_cast<int64_t>(elems), dtype, op,
+                                  tag_s + ".c" + std::to_string(ci), timeout_s,
+                                  scratch);
+        if (rc != 0) {
+            int expect = 0;
+            first_err.compare_exchange_strong(expect, rc);
+            return;
+        }
+        if (stats_out != nullptr) {
+            double dt = std::chrono::duration<double>(
+                            std::chrono::steady_clock::now() - t0)
+                            .count();
+            std::lock_guard<std::mutex> lk(stats_mu);
+            stats_out[2 * gi] += double(cbytes);
+            stats_out[2 * gi + 1] += dt;
+        }
+    };
+
+    if (n_chunks == 1) {
+        std::vector<uint8_t> scratch;
+        run_chunk(0, 0, total_elems, scratch);
+        return first_err.load();
+    }
+    const int nthreads = std::max(
+        1, std::min<int>(max_threads > 0 ? max_threads : 8,
+                         static_cast<int>(n_chunks)));
+    std::atomic<uint64_t> next{0};
+    std::vector<std::thread> workers;
+    workers.reserve(nthreads);
+    for (int t = 0; t < nthreads; ++t) {
+        workers.emplace_back([&] {
+            std::vector<uint8_t> scratch;
+            for (;;) {
+                uint64_t ci = next.fetch_add(1);
+                if (ci >= n_chunks) { return; }
+                uint64_t off = ci < rem ? ci * (base + 1)
+                                        : rem * (base + 1) + (ci - rem) * base;
+                uint64_t elems = ci < rem ? base + 1 : base;
+                run_chunk(ci, off, elems, scratch);
+            }
+        });
+    }
+    for (auto &w : workers) { w.join(); }
+    return first_err.load();
 }
 
 }  // extern "C"

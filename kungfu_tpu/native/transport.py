@@ -71,6 +71,19 @@ def _lib():
         lib.kf_host_ingress_snapshot.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
         ]
+        lib.kf_host_egress_snapshot.restype = ctypes.c_int
+        lib.kf_host_egress_snapshot.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.kf_engine_all_reduce.restype = ctypes.c_int
+        lib.kf_engine_all_reduce.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+            ctypes.c_uint64, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32, ctypes.c_char_p, ctypes.c_int32,
+            ctypes.c_uint64, ctypes.c_double, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_double),
+        ]
         _proto_done = True
     return lib
 
@@ -197,11 +210,38 @@ class NativeTransport:
         self._cbs.append(trampoline)
         setter(self._h, trampoline)
 
+    def engine_all_reduce(self, peers_csv: str, buf, elem_size: int,
+                          dtype_code: int, op_code: int, graph_data,
+                          pair_offsets, n_pairs: int, tag: str,
+                          hash_mode: int, chunk_size: int, timeout: float,
+                          max_threads: int, stats) -> int:
+        """Fully-native chunked graph allreduce; ``buf`` (writable
+        contiguous, e.g. numpy) is reduced in place.  ``graph_data`` /
+        ``pair_offsets`` / ``stats`` are int32/int32/float64 numpy arrays.
+        Returns the raw C return code (0 ok / 1 timeout / 2 closed ...)."""
+        mv = memoryview(buf)
+        addr = ctypes.addressof(ctypes.c_char.from_buffer(buf))
+        return self._libref.kf_engine_all_reduce(
+            self._h, peers_csv.encode(), addr, mv.nbytes, elem_size,
+            dtype_code, op_code,
+            graph_data.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            pair_offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n_pairs, tag.encode(), hash_mode, chunk_size, timeout,
+            max_threads,
+            stats.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        )
+
     def ingress_totals(self) -> dict:
+        return self._counter_totals(self._libref.kf_host_ingress_snapshot)
+
+    def egress_totals(self) -> dict:
+        return self._counter_totals(self._libref.kf_host_egress_snapshot)
+
+    def _counter_totals(self, snapshot_fn) -> dict:
         cap = 1 << 16
         while True:
             buf = ctypes.create_string_buffer(cap)
-            n = self._libref.kf_host_ingress_snapshot(self._h, buf, cap)
+            n = snapshot_fn(self._h, buf, cap)
             if n >= 0:
                 break
             cap = -n + 1

@@ -223,7 +223,13 @@ class TestAdaptIntegration:
                 engines = [p.engine() for p in ps]
                 data = np.ones(1000, np.float32)
                 self.run_all([lambda e=e: e.all_reduce(data) for e in engines])
-                totals = ps[0].net_monitor.totals()
+                # native-backend egress arrives via the counter poll thread
+                deadline = time.time() + 5
+                while time.time() < deadline:
+                    totals = ps[0].net_monitor.totals()
+                    if sum(totals["egress"].values()) > 0:
+                        break
+                    time.sleep(0.2)
                 assert sum(totals["egress"].values()) > 0
                 assert len(ps[0].get_egress_rates()) == 2
                 # /metrics endpoint is live at port+10000
