@@ -1,0 +1,114 @@
+"""Live device-plane elastic worker over a provisioned world.
+
+Demonstrates the round-3 elasticity model (the reference's live-resize
+promise, ``peer/peer.go:236-276`` + ``gpu/scheduler.cpp:43-72``, made
+TPU-native): the jax.distributed world is booted ONCE over ALL provisioned
+slots (``KF_WORLD_PEERS``); each elastic resize re-carves the Communicator
+mesh over the *active* workers' devices.  Surviving workers keep training
+on the device plane across every epoch — no process relaunch; dropped
+workers go *standby* (still holding their world slot) and re-join a later
+epoch without restarting.
+
+Run under the launcher (CPU test cluster, 4 provisioned slots, 2 initial)::
+
+    python -m kungfu_tpu.runner.cli -np 2 -H 127.0.0.1:4 -w -device-world \
+        -builtin-config-port 9123 python examples/device_elastic.py \
+        -- --schedule 2,4,2
+
+Every epoch each active worker runs a device-plane allreduce over the
+active sub-mesh and prints one ``KFEPOCH`` line; the test asserts the psum
+spans exactly the active set and that worker 0's PID never changes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--schedule", default="2,4,2",
+                    help="active cluster size per epoch (config version e = epoch e)")
+    ap.add_argument("--timeout", type=float, default=120.0,
+                    help="per-wait timeout seconds")
+    ns = ap.parse_args()
+    schedule = [int(s) for s in ns.schedule.split(",")]
+    shutdown_version = len(schedule)
+
+    from kungfu_tpu.peer import Peer
+
+    peer = Peer()
+    peer.start()
+    world = peer.config.world_peers
+    if world is None:
+        print("KFERROR: KF_WORLD_PEERS not set (run with -device-world)", flush=True)
+        return 2
+    my_world_rank = world.rank(peer.config.self_id)
+    deadline = time.time() + ns.timeout * max(len(schedule), 1)
+
+    try:
+        while time.time() < deadline:
+            if peer.detached:
+                break
+            if peer.standby:
+                try:
+                    _, version = peer.observe_stage()
+                except (OSError, ValueError, KeyError):
+                    time.sleep(0.2)
+                    continue
+                if version >= shutdown_version:
+                    break
+                peer.await_rejoin(timeout=2.0)
+                continue
+
+            v = peer.cluster_version
+            comm = peer.communicator()
+            # device-plane allreduce over the ACTIVE sub-mesh: each peer
+            # contributes (world_rank + 1), so the result identifies
+            # exactly which slots participated
+            x = np.full((comm.addressable_n,), float(my_world_rank + 1), np.float32)
+            got = float(np.asarray(comm.all_reduce(x)).ravel()[0])
+            expect = float(sum(world.rank(w) + 1 for w in peer.cluster.workers))
+            print(
+                f"KFEPOCH v={v} size={peer.size()} rank={peer.rank()} "
+                f"world_rank={my_world_rank} psum={got} expect={expect} "
+                f"pid={os.getpid()} ok={got == expect}",
+                flush=True,
+            )
+            if got != expect:
+                return 1
+
+            if v + 1 < len(schedule):
+                if peer.rank() == 0:
+                    peer.propose_new_size(schedule[v + 1])
+                # all current actives may fetch a not-yet-updated config and
+                # reach consensus on the OLD version — retry until this
+                # peer adopts the next stage (or leaves the active set)
+                while (
+                    peer.cluster_version <= v
+                    and not peer.standby
+                    and time.time() < deadline
+                ):
+                    peer.resize_cluster_from_url()
+            else:
+                if peer.rank() == 0:
+                    # shutdown sentinel: re-PUT the final cluster to bump
+                    # the version past the schedule so standbys exit
+                    peer.propose_new_size(peer.size())
+                break
+        else:
+            print("KFERROR: timeout", flush=True)
+            return 3
+    finally:
+        peer.close()
+    print(f"KFDONE world_rank={my_world_rank} pid={os.getpid()}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,6 +27,15 @@ Eager semantics (single-controller): a "peer" is a mesh device; values are
 stacked result (e.g. ``all_reduce(x)[i] == x.sum(0)`` for every ``i``).
 Inside user jit code, use :mod:`kungfu_tpu.ops` with the communicator's
 axis names instead — that is the hot path.
+
+Multi-controller semantics (mesh spans >1 process, e.g. a provisioned
+elastic world or a real multi-host slice): the global stacked array is
+never materialized on one host — each process passes and receives its
+**addressable slice** (leading axis = its own device count in this mesh).
+The conversion is pure layout (``host_local_array_to_global_array``), the
+collective itself still compiles to one XLA program over the sub-mesh;
+processes outside the mesh don't participate at all, which is what makes
+re-carved mesh epochs (live elastic resize) possible.
 """
 
 from __future__ import annotations
@@ -86,6 +95,19 @@ class Communicator:
         )
         self.axis = GLOBAL_AXES  # pass to kungfu_tpu.ops inside user jit code
         self._fns = {}
+        # multi-controller: eager stacked convention degrades to the
+        # addressable slice (leading axis = this process's device count)
+        self._multiproc = len({d.process_index for d in devs}) > 1
+        if self._multiproc:
+            pi = jax.process_index()
+            self._local_n = sum(1 for d in devs if d.process_index == pi)
+            if self._local_n == 0:
+                raise ValueError(
+                    "current process owns no device in this communicator "
+                    "(standby peers must not build communicators)"
+                )
+        else:
+            self._local_n = n
 
     @staticmethod
     def _infer_local_size(cluster: Optional[Cluster], n: int) -> int:
@@ -121,6 +143,13 @@ class Communicator:
     def local_size(self) -> int:
         return self._local
 
+    @property
+    def addressable_n(self) -> int:
+        """Leading-axis size of eager collective arguments: ``size`` in
+        single-controller mode, this process's device count in
+        multi-controller mode."""
+        return self._local_n
+
     def __repr__(self):
         return (
             f"Communicator(v{self.version}, {self._n} devices as "
@@ -136,8 +165,29 @@ class Communicator:
         fn = self._fns.get(key)
         if fn is None:
             fn = build()
+            if self._multiproc:
+                fn = self._local_slice_wrap(fn)
             self._fns[key] = fn
         return fn
+
+    def _local_slice_wrap(self, fn):
+        """Multi-controller calling convention: the caller passes its
+        addressable slice; we lift it to a global array over the mesh, run
+        the compiled collective, and hand back the addressable slice of
+        the result.  Layout-only — no extra communication."""
+        from jax.experimental import multihost_utils as mh
+
+        spec = self._spec_in()
+
+        def wrapped(a):
+            # jax arrays pass through (layout-only resharding); only host
+            # data pays a numpy materialization
+            local = a if isinstance(a, jax.Array) else np.asarray(a)
+            g = mh.host_local_array_to_global_array(local, self.mesh, spec)
+            out = fn(g)
+            return mh.global_array_to_host_local_array(out, self.mesh, spec)
+
+        return wrapped
 
     def _shard_jit(self, body, out_replicated=False):
         spec = self._spec_in()
@@ -150,7 +200,7 @@ class Communicator:
         """Stacked allreduce: out[i] = reduce_j x[j].  Pytrees supported."""
         if op not in _REDUCE_OPS:
             raise ValueError(f"op {op!r} not in {_REDUCE_OPS}")
-        _tree_stack_check(self._n, x)
+        _tree_stack_check(self._local_n, x)
         return jax.tree_util.tree_map(lambda a: self._all_reduce_leaf(a, op, GLOBAL_AXES), x)
 
     def _all_reduce_leaf(self, a, op, axes):
@@ -186,7 +236,7 @@ class Communicator:
             raise ValueError(f"op {op!r} not in {_REDUCE_OPS}")
         if not 0 <= root < self._n:
             raise ValueError(f"root {root} out of range [0, {self._n})")
-        _tree_stack_check(self._n, x)
+        _tree_stack_check(self._local_n, x)
 
         def leaf(a):
             a = jnp.asarray(a)
@@ -217,7 +267,7 @@ class Communicator:
         """out[i] = x[root] for all i."""
         if not 0 <= root < self._n:
             raise ValueError(f"root {root} out of range [0, {self._n})")
-        _tree_stack_check(self._n, x)
+        _tree_stack_check(self._local_n, x)
 
         def leaf(a):
             a = jnp.asarray(a)
@@ -239,7 +289,7 @@ class Communicator:
     def all_gather(self, x):
         """out[i] = stack_j x[j] — every peer sees all slices; eager result
         has shape [n, n, ...] (reference ``allgather.go:17-45``)."""
-        _tree_stack_check(self._n, x)
+        _tree_stack_check(self._local_n, x)
 
         def leaf(a):
             a = jnp.asarray(a)
@@ -277,12 +327,12 @@ class Communicator:
         return self._axis_reduce(x, op, (HOST_AXIS,))
 
     def _axis_reduce(self, x, op, axes):
-        _tree_stack_check(self._n, x)
+        _tree_stack_check(self._local_n, x)
         return jax.tree_util.tree_map(lambda a: self._all_reduce_leaf(jnp.asarray(a), op, axes), x)
 
     def local_broadcast(self, x):
         """Broadcast each host's local-rank-0 slice to its host peers."""
-        _tree_stack_check(self._n, x)
+        _tree_stack_check(self._local_n, x)
 
         def leaf(a):
             a = jnp.asarray(a)
@@ -316,14 +366,16 @@ class Communicator:
 
     # -- sync primitives --------------------------------------------------
     def barrier(self) -> None:
-        """1-element allreduce + block (reference ``session.go:102-113``)."""
-        x = jnp.ones((self._n, 1), dtype=jnp.int32)
+        """1-element allreduce + block (reference ``session.go:102-113``).
+        In multi-controller mode this synchronizes exactly the processes
+        whose devices are in this mesh epoch."""
+        x = jnp.ones((self._local_n, 1), dtype=jnp.int32)
         jax.block_until_ready(self.all_reduce(x))
 
     def consensus(self, x) -> bool:
         """True iff every peer's slice is bit-identical — allreduce MIN ==
         allreduce MAX (reference ``session.go:124-155``)."""
-        _tree_stack_check(self._n, x)
+        _tree_stack_check(self._local_n, x)
         ok = True
         for leaf in jax.tree_util.tree_leaves(x):
             a = jnp.asarray(leaf)
@@ -349,8 +401,11 @@ class Communicator:
                 "cannot witness cross-peer agreement — use "
                 "Peer.consensus_bytes for host-plane consensus"
             )
-        if len(digests) != self._n:
-            raise ValueError(f"expected {self._n} digests, got {len(digests)}")
+        if len(digests) != self._local_n:
+            raise ValueError(
+                f"expected {self._local_n} digests (one per addressable "
+                f"peer slot), got {len(digests)}"
+            )
         width = max((len(d) for d in digests), default=0)
         rows = [
             np.frombuffer(d.ljust(width, b"\0"), dtype=np.uint8).astype(np.int32)

@@ -39,6 +39,14 @@ class Peer:
         self.cluster: Cluster = self.config.cluster
         self.cluster_version: int = self.config.init_version
         self.detached: bool = False
+        #: in the provisioned device world but not in the active worker
+        #: list — alive, holding its jax.distributed slot, waiting to be
+        #: re-included by a future resize (no reference analog: the
+        #: reference kills/spawns processes, we re-carve the mesh)
+        self.standby: bool = (
+            self.config.world_peers is not None
+            and self.cluster.workers.rank(self.config.self_id) is None
+        )
         self._channel: Optional[HostChannel] = None
         self._comm: Optional[Communicator] = None
         self._comm_version = -1
@@ -96,36 +104,132 @@ class Peer:
                 self._init_jax_distributed()
             from kungfu_tpu.utils.affinity import bind_local_rank
 
-            bind_local_rank(self.local_rank(), self.local_size())
+            world = self.config.world_peers
+            if world is not None:
+                # world mode: pin by the STABLE world-slot position so the
+                # binding survives resizes (and standby peers — which have
+                # no active local rank — still get a valid share)
+                lr = world.local_rank(self.config.self_id)
+                bind_local_rank(
+                    0 if lr is None else lr,
+                    max(world.local_size(self.config.self_id), 1),
+                )
+            else:
+                bind_local_rank(self.local_rank(), self.local_size())
             log_event("peer-started")
 
     def _init_jax_distributed(self) -> None:
         """Bring up the jax.distributed world ONCE per process.
 
-        Contract on membership change (the reference's ``ResetNcclHelper``
-        analog, defined here because jax.distributed cannot re-initialize
-        in-process with a different world): the multi-host device world is
-        fixed for a process's lifetime.  Elastic resize changes the
-        *worker-process* membership — the watch runner kills/spawns
-        processes, and each NEW process boots with fresh
-        ``KF_COORDINATOR``/``KF_NUM_PROCESSES`` envs.  A surviving process
-        keeps its original jax.distributed world and only rebuilds its
-        Communicator (mesh epoch); if it left the worker list it detaches
-        and exits.  ``_propose`` warns when a resize would need a different
-        device world than this process was booted with."""
+        The device world is fixed for a process's lifetime (jax.distributed
+        cannot re-initialize in-process).  Two operating modes:
+
+        * **Provisioned world** (``KF_WORLD_PEERS`` set): the world spans
+          ALL provisioned slots — every slot's process boots here at job
+          start, whether or not it is in the initial worker list.  Elastic
+          resize then re-carves the Communicator mesh over the *active*
+          workers' devices (``_carve_active_devices``); inactive in-world
+          peers go ``standby`` instead of detaching.  This is the live
+          resize the reference promises (``peer/peer.go:236-276`` +
+          ``gpu/scheduler.cpp:43-72``): survivors keep training on the
+          device plane, no process relaunch.
+
+        * **Fixed world** (no ``KF_WORLD_PEERS``): world == the initial
+          worker set; a resize beyond it only takes effect in relaunched
+          workers and ``_propose`` warns on the survivors."""
         import jax
 
+        platform = os.environ.get("KF_JAX_PLATFORM") or ""
+        if platform == "cpu":
+            # CPU-backend multi-process clusters (the fake-cluster test
+            # trick, SURVEY §4) need an explicit cross-process collectives
+            # impl; TPU uses ICI/DCN natively
+            try:
+                jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            except Exception as e:  # older jaxlib without gloo
+                _log.warning("cannot enable gloo cpu collectives: %s", e)
+            ndev = os.environ.get(envs.NUM_DEVICES)
+            if ndev:
+                try:
+                    jax.config.update("jax_num_cpu_devices", int(ndev))
+                except Exception as e:
+                    _log.warning("cannot set cpu device count: %s", e)
         with stall_detector("jax.distributed.initialize"):
             jax.distributed.initialize(
                 coordinator_address=self.config.coordinator,
                 num_processes=self.config.num_processes,
                 process_id=self.config.process_id,
             )
+            # force backend bring-up NOW: global device discovery exchanges
+            # every process's local topology through the coordinator — a
+            # standby peer that never touched jax would otherwise stall
+            # every active peer's first jax.devices() call forever
+            n = len(jax.devices())
         self._jax_initialized = True
-        # the device world is sized by PROCESS count (one jax process per
-        # worker), not host count — a same-host-count resize still strands
-        # surviving processes on a stale world
         self._jax_world_procs = self.config.num_processes
+        _log.info(
+            "jax.distributed world up: %d processes, %d devices",
+            self.config.num_processes, n,
+        )
+
+    def _carve_active_devices(self):
+        """Devices of the ACTIVE workers, in worker-rank order — the mesh
+        epoch is a sub-mesh of the provisioned world (grow/shrink =
+        re-carving, not re-initializing).  Returns (devices, local_size),
+        or (None, None) to fall back to the full-world mesh."""
+        world = self.config.world_peers
+        if world is None:
+            return None, None
+        import jax
+
+        by_proc = {}
+        for d in jax.devices():
+            by_proc.setdefault(d.process_index, []).append(d)
+        devs, per = [], None
+        for w in self.cluster.workers:
+            wr = world.rank(w)
+            if wr is None or wr not in by_proc:
+                _log.warning(
+                    "worker %s is outside the provisioned device world "
+                    "(%d slots) — cannot carve a device mesh for this "
+                    "membership; falling back to the full-world mesh", w,
+                    len(world),
+                )
+                return None, None
+            ds = by_proc[wr]
+            if per is None:
+                per = len(ds)
+            elif len(ds) != per:
+                _log.warning(
+                    "uneven device counts per world slot (%d vs %d) — "
+                    "falling back to the full-world mesh", len(ds), per,
+                )
+                return None, None
+            devs.extend(ds)
+        # the mesh's local axis must span a HOST (the local_*/cross_*
+        # hierarchy contract, see Communicator._infer_local_size), not a
+        # process: a host may hold several world slots
+        hosts = [w.host for w in self.cluster.workers]
+        counts = {}
+        seen = set()
+        contiguous = True
+        for i, h in enumerate(hosts):
+            counts[h] = counts.get(h, 0) + 1
+            if i > 0 and h != hosts[i - 1] and h in seen:
+                contiguous = False  # host's workers split into >1 run
+            seen.add(h)
+        sizes = set(counts.values())
+        if len(sizes) == 1 and contiguous:
+            local_size = sizes.pop() * (per or 1)
+        else:
+            _log.warning(
+                "active workers are unevenly or non-contiguously placed "
+                "across hosts %s: mesh degrades to flat 1x%d — local_* "
+                "collectives will span ALL devices and cross_* collectives "
+                "become no-ops", counts, len(devs),
+            )
+            local_size = len(devs)
+        return devs, local_size
 
     def close(self) -> None:
         with self._lock:
@@ -148,7 +252,7 @@ class Peer:
 
     # -- identity --------------------------------------------------------
     def rank(self) -> int:
-        if self.detached:
+        if self.detached or self.standby:
             return -1
         r = self.cluster.workers.rank(self.config.self_id)
         if r is None:
@@ -177,9 +281,20 @@ class Peer:
         after membership changes (analog of ``Peer.CurrentSession`` +
         ``updateTo``, peer.go:138-166)."""
         with self._lock:
+            if self.standby:
+                raise RuntimeError(
+                    "standby peer is not in the active worker list; call "
+                    "await_rejoin() before communicator()"
+                )
             if self._comm is None or self._comm_version != self.cluster_version:
+                devices = local_size = None
+                if self._jax_initialized:
+                    devices, local_size = self._carve_active_devices()
                 self._comm = Communicator(
-                    cluster=self.cluster, version=self.cluster_version
+                    cluster=self.cluster,
+                    version=self.cluster_version,
+                    devices=devices,
+                    local_size=local_size,
                 )
                 self._comm_version = self.cluster_version
                 _log.info("new %r", self._comm)
@@ -272,22 +387,32 @@ class Peer:
                     self._channel.set_token(version)
                     # pooled sockets to removed peers must not leak
                     self._channel.reset_connections()
-                self.detached = (
-                    new_cluster.workers.rank(self.config.self_id) is None
+                world = self.config.world_peers
+                active = new_cluster.workers.rank(self.config.self_id) is not None
+                in_world = (
+                    world is not None
+                    and world.rank(self.config.self_id) is not None
                 )
+                # in-world peers never detach: they go standby and can be
+                # re-carved into a later mesh epoch without a relaunch
+                self.detached = not active and not in_world
+                self.standby = not active and in_world
                 self._comm = None  # next communicator() call builds the new epoch
-                if self._jax_initialized and not self.detached:
+                if self._jax_initialized and active and world is None:
                     new_procs = len(new_cluster.workers)
                     if new_procs != getattr(self, "_jax_world_procs", new_procs):
-                        # see _init_jax_distributed: the device world is
-                        # per-process-lifetime; collectives in this process
-                        # keep spanning the ORIGINAL world's devices
+                        # fixed-world mode only (no KF_WORLD_PEERS): the
+                        # device world is per-process-lifetime; collectives
+                        # in this process keep spanning the ORIGINAL world.
+                        # With a provisioned world this path is unreachable —
+                        # communicator() re-carves the sub-mesh instead.
                         _log.warning(
                             "resize to %d worker processes but this "
                             "process's jax.distributed world has %d — "
                             "surviving processes keep their original device "
                             "world; the new world takes effect in "
-                            "relaunched workers only",
+                            "relaunched workers only (set KF_WORLD_PEERS "
+                            "to provision a max world for live resize)",
                             new_procs, self._jax_world_procs,
                         )
             log_event(f"cluster-resized-v{version}-n{new_cluster.size()}")
@@ -295,8 +420,13 @@ class Peer:
 
     def _notify_runners(self, new_cluster: Cluster, version: int) -> None:
         """Send the new Stage to every runner so they can spawn/kill local
-        workers (reference ``peer.go:195-209`` → ``runner/handler.go``)."""
-        if self._channel is None or self.rank() != 0:
+        workers (reference ``peer.go:195-209`` → ``runner/handler.go``).
+        Skipped when no runner spawned us (mp-spawn / direct-driven test
+        clusters have no runner daemon to notify)."""
+        if self._channel is None or self.config.parent is None:
+            return
+        # rank in the OLD membership; standby/detached peers don't notify
+        if self.cluster.workers.rank(self.config.self_id) != 0:
             return
         stage = json.dumps(
             {"version": version, "cluster": json.loads(new_cluster.to_json())}
@@ -307,6 +437,71 @@ class Peer:
                 self._channel.send(runner, "update", stage, ConnType.CONTROL)
             except (TimeoutError, ConnectionError) as e:
                 _log.warning("cannot notify runner %s: %s", runner, e)
+
+    # -- standby / world (provisioned-world live elasticity) --------------
+    def world_barrier(self, name: str = "world") -> None:
+        """Host-plane barrier over ALL provisioned slots (active + standby).
+        Used for job-wide phases (start/shutdown) that must include peers
+        currently outside the worker list."""
+        world = self.config.world_peers
+        if world is None or len(world) <= 1 or self._channel is None:
+            return
+        with trace_scope("peer.world_barrier"), stall_detector("world_barrier"):
+            self._channel.barrier(world, name=f"wbarrier.{name}")
+
+    def observe_stage(self):
+        """Fetch the config server's current (cluster, version) without
+        applying it — standby peers poll this to decide when to rejoin or
+        shut down."""
+        if not self.config.config_server:
+            raise RuntimeError("observe_stage requires KF_CONFIG_SERVER")
+        from kungfu_tpu.elastic.resize import fetch_cluster
+
+        return fetch_cluster(self.config.config_server)
+
+    def await_rejoin(self, timeout: float = 300.0, poll_period: float = 0.2) -> bool:
+        """Standby peer blocks until the config server publishes a stage
+        that includes it, then adopts that stage (version fence + fresh
+        mesh epoch).  Returns True on rejoin; False if a newer stage
+        excludes us and ``timeout`` elapses.
+
+        The active set reached consensus on the stage before publishing
+        (``fetch_cluster_with_consensus``); a joining standby peer takes
+        the versioned config server as the source of truth — its first
+        collective with the new membership synchronizes it with the
+        survivors (device-plane collectives block until every participant
+        arrives, the moral of the reference's post-update ``sess.Barrier()``,
+        ``peer.go:144-166``)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                cluster, version = self.observe_stage()
+            except (OSError, ValueError, KeyError) as e:
+                _log.debug("stage fetch failed: %s", e)
+                time.sleep(poll_period)
+                continue
+            if version > self.cluster_version:
+                if cluster.workers.rank(self.config.self_id) is not None:
+                    with self._lock:
+                        self.cluster = cluster
+                        self.cluster_version = version
+                        if self._channel is not None:
+                            self._channel.set_token(version)
+                            self._channel.reset_connections()
+                        self.standby = False
+                        self.detached = False
+                        self._comm = None
+                    log_event(f"rejoined-v{version}-n{cluster.size()}")
+                    return True
+                # newer stage still excludes us: track the version so a
+                # subsequent rejoin fences on the right token
+                with self._lock:
+                    self.cluster = cluster
+                    self.cluster_version = version
+                    if self._channel is not None:
+                        self._channel.set_token(version)
+            time.sleep(poll_period)
+        return False
 
     # -- monitoring / adaptation (reference peer.hpp GetPeerLatencies /
     # CheckInterference / GetEgressRates / SetTree) ----------------------

@@ -45,6 +45,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-self", dest="self_host", default="127.0.0.1", help="this runner's host ip")
     p.add_argument("-strategy", default="AUTO", help="allreduce strategy name")
     p.add_argument("-w", dest="watch", action="store_true", help="elastic watch mode")
+    p.add_argument("-device-world", dest="device_world", action="store_true",
+                   help="provision ALL host-list slots as one jax.distributed "
+                        "world; elastic resize re-carves the device mesh over "
+                        "the active workers (live resize, no relaunch)")
     p.add_argument("-config-server", dest="config_server", default="", help="elastic config server URL")
     p.add_argument("-builtin-config-port", dest="builtin_config_port", type=int, default=0,
                    help="start a built-in config server on this port")
@@ -68,13 +72,16 @@ def parse_port_range(spec: str):
     return int(lo), int(hi)
 
 
-def build_cluster(ns) -> Cluster:
+def build_hostlist(ns) -> HostList:
     if ns.hostfile:
-        hl = parse_hostfile(ns.hostfile)
-    elif ns.hosts:
-        hl = HostList.parse(ns.hosts)
-    else:
-        hl = HostList.parse(f"{ns.self_host}:{max(ns.np, 1)}")
+        return parse_hostfile(ns.hostfile)
+    if ns.hosts:
+        return HostList.parse(ns.hosts)
+    return HostList.parse(f"{ns.self_host}:{max(ns.np, 1)}")
+
+
+def build_cluster(ns) -> Cluster:
+    hl = build_hostlist(ns)
     return Cluster(
         hl.gen_runner_list(DEFAULT_RUNNER_PORT),
         hl.gen_peer_list(ns.np, parse_port_range(ns.port_range)),
@@ -113,6 +120,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         config_server_url = f"http://127.0.0.1:{ns.builtin_config_port}/get"
         _log.info("builtin config server at %s", config_server_url)
 
+    world = None
+    if ns.device_world:
+        hl = build_hostlist(ns)
+        world = hl.gen_peer_list(hl.cap(), parse_port_range(ns.port_range))
+
     job = Job(
         prog=ns.prog,
         args=[a for a in ns.args if a != "--"],
@@ -121,6 +133,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         log_dir=ns.logdir,
         parent=PeerID(ns.self_host, DEFAULT_RUNNER_PORT),
         backend=ns.backend,
+        world=world,
     )
     try:
         if ns.auto_recover:

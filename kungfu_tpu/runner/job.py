@@ -16,6 +16,7 @@ from typing import Dict, List, Optional
 
 from kungfu_tpu.plan.cluster import Cluster
 from kungfu_tpu.plan.peer import PeerID
+from kungfu_tpu.plan.peerlist import PeerList
 from kungfu_tpu.plan.strategy import Strategy
 from kungfu_tpu.runner.proc import Proc
 from kungfu_tpu.utils import envs
@@ -35,6 +36,10 @@ class Job:
     parent: Optional[PeerID] = None
     extra_envs: Dict[str, str] = field(default_factory=dict)
     backend: str = "cpu"  # worker jax platform: "cpu" test clusters | "tpu"
+    #: full provisioned worker-slot list (device-world elastic mode): the
+    #: jax.distributed world is booted once over ALL slots; resize re-carves
+    #: the mesh over the active subset (see Peer._carve_active_devices)
+    world: Optional[PeerList] = None
     job_start: float = field(default_factory=time.time)
 
     def new_proc(self, worker: PeerID, cluster: Cluster, version: int = 0) -> Proc:
@@ -52,7 +57,26 @@ class Job:
             env[envs.PARENT_ID] = str(self.parent)
         if self.config_server:
             env[envs.CONFIG_SERVER] = self.config_server
-        if self.backend == "cpu":
+        if self.world is not None:
+            # provisioned device world: EVERY slot (active or standby) joins
+            # one jax.distributed world keyed by its stable world-slot index
+            wr = self.world.rank(worker)
+            if wr is None:
+                raise ValueError(f"worker {worker} is not a provisioned world slot")
+            first = self.world[0]
+            coord_port = first.port + COORDINATOR_PORT_OFFSET
+            if coord_port > 65535:
+                coord_port = 20000 + (coord_port % 25536)
+            env[envs.WORLD_PEERS] = str(self.world)
+            env[envs.COORDINATOR] = f"{first.host}:{coord_port}"
+            env[envs.NUM_PROCESSES] = str(len(self.world))
+            env[envs.PROCESS_ID] = str(wr)
+            if self.backend == "cpu":
+                env["JAX_PLATFORMS"] = "cpu"
+                env["KF_JAX_PLATFORM"] = "cpu"
+                # extra_envs is merged last and may override this default
+                env[envs.NUM_DEVICES] = "1"
+        elif self.backend == "cpu":
             # each worker is its own single-device CPU world; collectives
             # run on the host channel (CollectiveEngine).  KF_JAX_PLATFORM
             # is applied via jax.config at kf.init() time — some
@@ -96,9 +120,12 @@ class Job:
 
     def create_procs(self, cluster: Cluster, self_host: str, version: int = 0) -> List[Proc]:
         """Procs for all workers on ``self_host``
-        (reference ``job.go:74`` CreateProcs)."""
+        (reference ``job.go:74`` CreateProcs).  In device-world mode ALL
+        provisioned slots are spawned — slots outside the initial worker
+        list boot as standby peers."""
+        spawn_list = self.world if self.world is not None else cluster.workers
         return [
             self.new_proc(w, cluster, version)
-            for w in cluster.workers
+            for w in spawn_list
             if w.host == self_host
         ]

@@ -56,7 +56,9 @@ def watch_run(ns, cluster: Cluster, job: Job) -> int:
         idx += 1
 
     current = cluster
-    for w in cluster.workers.on_host(self_host):
+    device_world = job.world is not None
+    initial = job.world if device_world else cluster.workers
+    for w in initial.on_host(self_host):
         spawn(w, cluster, version)
 
     stop = False
@@ -107,14 +109,30 @@ def watch_run(ns, cluster: Cluster, job: Job) -> int:
             chan.set_token(new_version)
             old_local = set(current.workers.on_host(self_host))
             new_local = set(new_cluster.workers.on_host(self_host))
-            for w in old_local - new_local:
+            if device_world:
+                # provisioned world: in-world workers transition themselves
+                # (active <-> standby) — the runner only kills/spawns slots
+                # that leave/enter the provisioned world (normally none)
+                world_local = set(job.world.on_host(self_host))
+                removed = (old_local - new_local) - world_local
+                added = (new_local - old_local) - world_local
+            else:
+                removed = old_local - new_local
+                added = new_local - old_local
+            for w in removed:
                 r = running.get(w)
                 if r is not None:
                     _log.info("killing removed worker %s", w)
                     kill_group(r)
                     killed.add(w)
-            for w in sorted(new_local - old_local):
-                spawn(w, new_cluster, new_version)
+            for w in sorted(added):
+                try:
+                    spawn(w, new_cluster, new_version)
+                except ValueError as e:
+                    # e.g. a grow beyond the provisioned device world:
+                    # un-spawnable workers must not take down the healthy
+                    # job (the peer side falls back to the full-world mesh)
+                    _log.error("cannot spawn %s: %s", w, e)
             current, version = new_cluster, new_version
     finally:
         for w, r in list(running.items()):

@@ -21,6 +21,13 @@ Bootstrap envs (written by the runner, read once at init):
 ``KF_COORDINATOR``          jax.distributed coordinator address
 ``KF_NUM_PROCESSES``        jax.distributed process count
 ``KF_PROCESS_ID``           jax.distributed process index
+``KF_WORLD_PEERS``          full provisioned worker-slot list (max world).
+                            When set, the jax.distributed world is booted
+                            ONCE over ALL slots and elastic resize re-carves
+                            the device mesh over the *active* subset — no
+                            world re-init, surviving workers keep training
+                            (reference live-resize semantics,
+                            ``peer/peer.go:236-276``)
 ==========================  ====================================================
 
 Tuning envs (read anywhere, any time):
@@ -61,6 +68,7 @@ NUM_DEVICES = "KF_NUM_DEVICES"
 COORDINATOR = "KF_COORDINATOR"
 NUM_PROCESSES = "KF_NUM_PROCESSES"
 PROCESS_ID = "KF_PROCESS_ID"
+WORLD_PEERS = "KF_WORLD_PEERS"
 
 # tuning envs
 ENABLE_MONITORING = "KF_CONFIG_ENABLE_MONITORING"
@@ -74,6 +82,7 @@ ALL_BOOTSTRAP_ENVS = [
     SELF_SPEC, INIT_PEERS, INIT_RUNNERS, PARENT_ID, INIT_CLUSTER_VERSION,
     ALLREDUCE_STRATEGY, CONFIG_SERVER, JOB_START_TIMESTAMP,
     PROC_START_TIMESTAMP, NUM_DEVICES, COORDINATOR, NUM_PROCESSES, PROCESS_ID,
+    WORLD_PEERS,
 ]
 
 
@@ -98,6 +107,9 @@ class Config:
     coordinator: str = ""
     num_processes: int = 1
     process_id: int = 0
+    #: full provisioned worker-slot list; None = fixed world (world == the
+    #: initial worker list, resize beyond it needs relaunched processes)
+    world_peers: Optional[PeerList] = None
     job_start: float = field(default_factory=time.time)
     proc_start: float = field(default_factory=time.time)
 
@@ -141,6 +153,14 @@ def parse_config_from_env(env=None) -> Config:
     cluster = Cluster(runners, workers)
     cluster.validate()
     parent = parse_peer_id(env[PARENT_ID]) if env.get(PARENT_ID) else None
+    world_spec = env.get(WORLD_PEERS, "")
+    world = PeerList.parse(world_spec) if world_spec else None
+    if world is not None and world.rank(self_id) is None:
+        raise ValueError(f"{WORLD_PEERS} set but {self_id} is not a slot in {world}")
+    # with a provisioned world, the jax process identity is the WORLD slot
+    # index (stable across resizes), not the elastic worker rank
+    num_processes = int(env.get(NUM_PROCESSES, str(len(world)) if world else "1"))
+    process_id = int(env.get(PROCESS_ID, str(world.rank(self_id)) if world else "0"))
     return Config(
         self_id=self_id,
         cluster=cluster,
@@ -149,8 +169,9 @@ def parse_config_from_env(env=None) -> Config:
         init_version=int(env.get(INIT_CLUSTER_VERSION, "0")),
         config_server=env.get(CONFIG_SERVER, ""),
         coordinator=env.get(COORDINATOR, ""),
-        num_processes=int(env.get(NUM_PROCESSES, "1")),
-        process_id=int(env.get(PROCESS_ID, "0")),
+        num_processes=num_processes,
+        process_id=process_id,
+        world_peers=world,
         job_start=float(env.get(JOB_START_TIMESTAMP, time.time())),
         proc_start=float(env.get(PROC_START_TIMESTAMP, time.time())),
     )
