@@ -384,7 +384,14 @@ class ShardedTrainer:
         return jax.jit(f)(state["params"], ids, targets)
 
 
-def dp_train_step(loss_fn, tx, comm, replicated_params: bool = True):
+def dp_train_step(
+    loss_fn,
+    tx,
+    comm,
+    replicated_params: bool = True,
+    has_aux: bool = False,
+    donate: bool = False,
+):
     """Pure data-parallel training step over a
     :class:`~kungfu_tpu.comm.device.Communicator` mesh.
 
@@ -399,41 +406,76 @@ def dp_train_step(loss_fn, tx, comm, replicated_params: bool = True):
     AdaptiveSGD: each replica owns diverging weights) expects params and
     opt_state **stacked** on a leading ``comm.size`` axis.
 
-    Returns ``step(params, opt_state, batch) -> (params, opt_state, loss)``
-    jitted over the mesh; ``batch`` leading axis must be divisible by
-    ``comm.size``.
+    ``has_aux=True`` threads non-trained model state (BatchNorm running
+    stats): ``loss_fn(params, aux, batch) -> (loss, new_aux)``; the new
+    aux is pmean'd over the mesh so replicas stay identical, and the step
+    signature becomes ``step(params, aux, opt_state, batch) -> (params,
+    aux, opt_state, loss)``.
+
+    ``donate=True`` donates the train-state buffers to XLA (in-place
+    update — halves HBM traffic/footprint for the state); the caller must
+    not reuse the old params/opt_state after the call.
+
+    Returns ``step(params[, aux], opt_state, batch) -> (params[, aux],
+    opt_state, loss)`` jitted over the mesh; ``batch`` leading axis must
+    be divisible by ``comm.size``.
     """
     mesh, axis = comm.mesh, comm.axis
     pspec = P() if replicated_params else P(axis)
 
-    def per_device(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+    def body(params, aux, opt_state, batch):
+        if has_aux:
+            (loss, new_aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, aux, batch
+            )
+            # per-shard batch statistics diverge across replicas; average
+            # them like the gradients so the replicated copy stays in sync
+            new_aux = jax.tree_util.tree_map(
+                lambda a: jax.lax.pmean(a, axis)
+                if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+                else a,
+                new_aux,
+            )
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            new_aux = aux
         updates, new_state = tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
-        return new_params, new_state, jax.lax.pmean(loss, axis)
+        return new_params, new_aux, new_state, jax.lax.pmean(loss, axis)
 
-    def per_device_stacked(params, opt_state, batch):
+    def body_stacked(params, aux, opt_state, batch):
         # strip/restore the per-replica leading axis around the same body
         squeeze = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
         unsqueeze = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
-        p, s, l = per_device(squeeze(params), squeeze(opt_state), batch)
-        return unsqueeze(p), unsqueeze(s), l
+        p, a, s, l = body(squeeze(params), squeeze(aux), squeeze(opt_state), batch)
+        return unsqueeze(p), unsqueeze(a), unsqueeze(s), l
 
     def batch_spec(x):
         return P(axis) if hasattr(x, "ndim") and x.ndim > 0 else P()
 
-    def step(params, opt_state, batch):
+    inner = body if replicated_params else body_stacked
+
+    def step4(params, aux, opt_state, batch):
         bspecs = jax.tree_util.tree_map(batch_spec, batch)
         f = shard_map(
-            per_device if replicated_params else per_device_stacked,
+            inner,
             mesh=mesh,
-            in_specs=(pspec, pspec, bspecs),
-            out_specs=(pspec, pspec, P()),
+            in_specs=(pspec, pspec, pspec, bspecs),
+            out_specs=(pspec, pspec, pspec, P()),
             check_vma=False,
         )
-        return f(params, opt_state, batch)
+        return f(params, aux, opt_state, batch)
 
-    return jax.jit(step)
+    if has_aux:
+        donate_args = (0, 1, 2) if donate else ()
+        return jax.jit(step4, donate_argnums=donate_args)
+
+    def step3(params, opt_state, batch):
+        p, _, s, l = step4(params, (), opt_state, batch)
+        return p, s, l
+
+    donate_args = (0, 1) if donate else ()
+    return jax.jit(step3, donate_argnums=donate_args)
 
 
 def stack_for_replicas(tree, n: int):

@@ -342,6 +342,14 @@ class Peer:
         (reference ``peer/legacy.go:18-39``)."""
         if not self.config.config_server:
             raise RuntimeError("propose_new_size requires KF_CONFIG_SERVER")
+        world = self.config.world_peers
+        if world is not None and new_size > len(world):
+            # a phantom worker (valid PeerID, no process) would wedge every
+            # later host-plane collective waiting for it to come up
+            raise ValueError(
+                f"cannot grow to {new_size}: the provisioned device world "
+                f"has {len(world)} slots"
+            )
         if self.rank() != 0:
             return
         new_cluster = self.cluster.resize(new_size)
