@@ -368,8 +368,11 @@ def payload_allreduce(args) -> dict:
 
     if n == 1:
         # single chip: no collective possible; measure an on-chip
-        # read+write of the buffer as a floor and report honestly
-        step = lambda y: (y + y) * 0.5
+        # read+write of the buffer as a floor.  NOT (y+y)*0.5 — the
+        # algebraic simplifier folds that to the identity and the loop
+        # would time nothing; a decay factor != 1 survives optimization
+        decay = jnp.float32(1.0 - 2.0 ** -12)
+        step = lambda y: y * decay
     else:
         from jax.sharding import Mesh, PartitionSpec as P
         from jax.experimental.shard_map import shard_map

@@ -86,14 +86,20 @@ def bench_host(model: str, np_workers: int, strategy: str, iters: int, warmup: i
     engines = [CollectiveEngine(c, peers, parse_strategy(strategy)) for c in chans]
     sizes = fake_model_sizes(model)
     nbytes = sum(s * 4 for s in sizes)
-    buf = np.random.default_rng(0).standard_normal(sum(sizes)).astype(np.float32)
+    bufs = [
+        np.random.default_rng(0).standard_normal(sum(sizes)).astype(np.float32)
+        for _ in range(np_workers)
+    ]
     times = []
     try:
         for i in range(warmup + iters):
             t0 = time.perf_counter()
 
             def run(e):
-                e.all_reduce(buf, op="sum", name=f"bench.{i}")
+                # per-engine private buffer, reduced in place (the NCCL
+                # in-place convention the reference benchmark also uses)
+                e.all_reduce(bufs[engines.index(e)], op="sum",
+                             name=f"bench.{i}", inplace=True)
 
             ts = [threading.Thread(target=run, args=(e,)) for e in engines]
             for t in ts:

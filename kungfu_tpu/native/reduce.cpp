@@ -146,22 +146,72 @@ inline uint16_t f32_to_bf16(float f) {
   return static_cast<uint16_t>(bits >> 16);
 }
 
+// branchless bf16 round-to-nearest-even narrow: vectorizes (mask+blend)
+// where the branchy f32_to_bf16 forces scalar code on the hot path
+inline uint16_t f32_to_bf16_branchless(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  uint32_t lsb = (bits >> 16) & 1u;
+  uint32_t rounded = (bits + 0x7FFFu + lsb) >> 16;
+  uint32_t nan_out = (bits >> 16) | 0x40u;  // quiet the NaN
+  bool is_nan = (bits & 0x7FFFFFFFu) > 0x7F800000u;
+  return static_cast<uint16_t>(is_nan ? nan_out : rounded);
+}
+
+// op hoisted out of the loop so each case is a tight widen/op/narrow
+// loop the vectorizer can handle
+template <float (*ToF)(uint16_t), uint16_t (*FromF)(float), typename F>
+void loop_16(uint16_t* dst, const uint16_t* src, size_t n, F f) {
+  for (size_t i = 0; i < n; ++i) {
+    dst[i] = FromF(f(ToF(dst[i]), ToF(src[i])));
+  }
+}
+
 template <float (*ToF)(uint16_t), uint16_t (*FromF)(float)>
 int run_16(uint16_t* dst, const uint16_t* src, size_t n, int32_t op) {
-  for (size_t i = 0; i < n; ++i) {
-    float a = ToF(dst[i]);
-    float b = ToF(src[i]);
-    float r;
-    switch (op) {
-      case OP_SUM: r = a + b; break;
-      case OP_MIN: r = nan_min(a, b); break;
-      case OP_MAX: r = nan_max(a, b); break;
-      case OP_PROD: r = a * b; break;
-      default: return -1;
-    }
-    dst[i] = FromF(r);
+  switch (op) {
+    case OP_SUM:
+      loop_16<ToF, FromF>(dst, src, n, [](float a, float b) { return a + b; });
+      return 0;
+    case OP_MIN:
+      loop_16<ToF, FromF>(dst, src, n, [](float a, float b) { return nan_min(a, b); });
+      return 0;
+    case OP_MAX:
+      loop_16<ToF, FromF>(dst, src, n, [](float a, float b) { return nan_max(a, b); });
+      return 0;
+    case OP_PROD:
+      loop_16<ToF, FromF>(dst, src, n, [](float a, float b) { return a * b; });
+      return 0;
   }
-  return 0;
+  return -1;
+}
+
+// Runtime SIMD dispatch for the hot dtypes (the reference's explicit AVX
+// f16 kernels, base/f16.c, done the portable way): target_clones emits
+// SSE2/AVX2/AVX-512 variants of the whole inlined loop and the dynamic
+// linker picks the widest one this CPU supports — no -march opt-in, no
+// SIGILL risk on heterogeneous shared-filesystem fleets (the Makefile
+// ARCHFLAGS concern).
+#if defined(__x86_64__) && defined(__GNUC__) && !defined(__clang__)
+#define KF_SIMD_CLONES \
+  __attribute__((target_clones("default", "avx2", "avx512f")))
+#else
+#define KF_SIMD_CLONES
+#endif
+
+KF_SIMD_CLONES
+int run_f32(float* dst, const float* src, size_t n, int32_t op) {
+  return run_typed(dst, src, n, op);
+}
+
+KF_SIMD_CLONES
+int run_f64(double* dst, const double* src, size_t n, int32_t op) {
+  return run_typed(dst, src, n, op);
+}
+
+KF_SIMD_CLONES
+int run_bf16(uint16_t* dst, const uint16_t* src, size_t n, int32_t op) {
+  return run_16<bf16_to_f32, f32_to_bf16_branchless>(dst, src, n, op);
 }
 
 }  // namespace
@@ -181,13 +231,13 @@ int kf_transform2(void* dst, const void* src, int64_t n, int32_t dtype,
     case DT_U16: return run_typed(static_cast<uint16_t*>(dst), static_cast<const uint16_t*>(src), m, op);
     case DT_U32: return run_typed(static_cast<uint32_t*>(dst), static_cast<const uint32_t*>(src), m, op);
     case DT_U64: return run_typed(static_cast<uint64_t*>(dst), static_cast<const uint64_t*>(src), m, op);
-    case DT_F32: return run_typed(static_cast<float*>(dst), static_cast<const float*>(src), m, op);
-    case DT_F64: return run_typed(static_cast<double*>(dst), static_cast<const double*>(src), m, op);
+    case DT_F32: return run_f32(static_cast<float*>(dst), static_cast<const float*>(src), m, op);
+    case DT_F64: return run_f64(static_cast<double*>(dst), static_cast<const double*>(src), m, op);
     case DT_F16:
       return run_16<f16_to_f32, f32_to_f16>(
           static_cast<uint16_t*>(dst), static_cast<const uint16_t*>(src), m, op);
     case DT_BF16:
-      return run_16<bf16_to_f32, f32_to_bf16>(
+      return run_bf16(
           static_cast<uint16_t*>(dst), static_cast<const uint16_t*>(src), m, op);
   }
   return -1;

@@ -21,8 +21,11 @@
 #include <sys/socket.h>
 #include <sys/stat.h>
 #include <sys/types.h>
+#include <sys/uio.h>
 #include <sys/un.h>
 #include <unistd.h>
+
+#include <cerrno>
 
 #include <atomic>
 #include <condition_variable>
@@ -108,11 +111,10 @@ uint32_t get_u32(const uint8_t *p) {
            (static_cast<uint32_t>(p[3]) << 24);
 }
 
-std::string encode_msg(uint32_t token, uint8_t conn_type, const std::string &src,
-                       const std::string &name, const uint8_t *payload,
-                       uint32_t payload_len) {
+std::string encode_head(uint32_t token, uint8_t conn_type, const std::string &src,
+                        const std::string &name, uint32_t payload_len) {
     std::string out;
-    out.reserve(17 + src.size() + name.size() + payload_len);
+    out.reserve(17 + src.size() + name.size());
     put_u32(out, kMagic);
     put_u32(out, token);
     out.push_back(static_cast<char>(conn_type));
@@ -121,8 +123,46 @@ std::string encode_msg(uint32_t token, uint8_t conn_type, const std::string &src
     put_u16(out, static_cast<uint16_t>(name.size()));
     out.append(name);
     put_u32(out, payload_len);
+    return out;
+}
+
+std::string encode_msg(uint32_t token, uint8_t conn_type, const std::string &src,
+                       const std::string &name, const uint8_t *payload,
+                       uint32_t payload_len) {
+    std::string out = encode_head(token, conn_type, src, name, payload_len);
     if (payload_len > 0) { out.append(reinterpret_cast<const char *>(payload), payload_len); }
     return out;
+}
+
+// gather-write header + payload without staging them into one buffer (the
+// payload copy dominated send cost for MB-scale gradient chunks)
+bool writev_all(int fd, const void *head, size_t head_n, const void *payload,
+                size_t payload_n) {
+    struct iovec iov[2];
+    iov[0].iov_base = const_cast<void *>(head);
+    iov[0].iov_len = head_n;
+    iov[1].iov_base = const_cast<void *>(payload);
+    iov[1].iov_len = payload_n;
+    int iovcnt = payload_n > 0 ? 2 : 1;
+    struct iovec *cur = iov;
+    while (iovcnt > 0) {
+        ssize_t w = ::writev(fd, cur, iovcnt);
+        if (w < 0) {
+            if (errno == EINTR) { continue; }
+            return false;
+        }
+        size_t n = static_cast<size_t>(w);
+        while (iovcnt > 0 && n >= cur->iov_len) {
+            n -= cur->iov_len;
+            ++cur;
+            --iovcnt;
+        }
+        if (iovcnt > 0 && n > 0) {
+            cur->iov_base = static_cast<char *>(cur->iov_base) + n;
+            cur->iov_len -= n;
+        }
+    }
+    return true;
 }
 
 // header through payload_len; the payload itself is read separately so
@@ -201,10 +241,20 @@ std::string unix_sock_path(const std::string &host, uint16_t port) {
     return dir + "/" + host + "-" + std::to_string(port) + ".sock";
 }
 
+// deep socket buffers: a sender must be able to dump a full default
+// chunk (1 MiB) and move on instead of context-switching every ~208 KiB
+// (the kernel default) while the single-core receiver drains
+void set_deep_buffers(int fd) {
+    int sz = 4 << 20;
+    ::setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &sz, sizeof(sz));
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &sz, sizeof(sz));
+}
+
 int connect_unix_once(const std::string &path, double timeout_s) {
     if (path.empty()) { return -1; }
     int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
     if (fd < 0) { return -1; }
+    set_deep_buffers(fd);
     if (timeout_s > 0) {
         struct timeval tv;
         tv.tv_sec = static_cast<long>(timeout_s);
@@ -258,6 +308,7 @@ int connect_once(const std::string &host, uint16_t port, double timeout_s) {
     if (fd < 0) { return -1; }
     int one = 1;
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    set_deep_buffers(fd);
     return fd;
 }
 
@@ -454,8 +505,10 @@ class Channel {
             std::lock_guard<std::mutex> lk(stats_mu_);
             egress_[peer] += len;
         }
-        std::string data = encode_msg(token_.load(), static_cast<uint8_t>(conn_type),
-                                      self_, name, payload, len);
+        // header staged separately; the payload goes straight from the
+        // caller's buffer to the kernel via writev (no MB-scale memcpy)
+        std::string head = encode_head(token_.load(), static_cast<uint8_t>(conn_type),
+                                       self_, name, len);
         std::shared_ptr<PoolEntry> entry;
         {
             std::lock_guard<std::mutex> lk(pool_mu_);
@@ -469,7 +522,7 @@ class Channel {
             if (fd < 0) { return -1; }
             entry->install_fd(fd);
         }
-        if (!write_all(entry->fd, data.data(), data.size())) {
+        if (!writev_all(entry->fd, head.data(), head.size(), payload, len)) {
             // stale pooled socket (peer restarted): reconnect once.
             // retire before the (potentially long) reconnect so a
             // concurrent reset_connections sees fd=-1, not a dead number
@@ -477,7 +530,7 @@ class Channel {
             int fd = connect_retry(host, port, retries);
             if (fd < 0) { return -1; }
             entry->install_fd(fd);
-            if (!write_all(entry->fd, data.data(), data.size())) {
+            if (!writev_all(entry->fd, head.data(), head.size(), payload, len)) {
                 entry->retire_fd();
                 return -1;
             }
@@ -526,6 +579,123 @@ class Channel {
                 cv_.wait(lk);
             } else if (cv_.wait_until(lk, deadline) == std::cv_status::timeout) {
                 return 1;
+            }
+        }
+    }
+
+    // Pre-register a receive buffer for (src, name): the stream thread
+    // writes the payload straight into rb->buf on arrival (zero-copy),
+    // BEFORE the caller blocks in recv_await — so a sender that races
+    // ahead of the receiver still lands in place instead of detouring
+    // through the queue (allocation + two copies).  If a matching payload
+    // is already queued it is consumed immediately (rb->state = 1).
+    // 0 ok, 2 closed, -2 queued-size mismatch (payload left queued),
+    // -3 duplicate registration for the key.
+    // The caller MUST follow up with recv_await or recv_cancel on the
+    // same rb — the map holds a raw pointer into the caller's frame.
+    int recv_register(const std::string &src, const std::string &name,
+                      int conn_type, RegBuf *rb) {
+        QueueKey key{static_cast<uint8_t>(conn_type), src, name,
+                     conn_type == kConnCollective ? token_.load() : 0};
+        std::unique_lock<std::mutex> lk(q_mu_);
+        if (!running_.load()) { return 2; }
+        auto it = queues_.find(key);
+        if (it != queues_.end() && !it->second.empty()) {
+            if (it->second.front().size() != rb->cap) { return -2; }
+            std::string payload = std::move(it->second.front());
+            it->second.pop_front();
+            // copy outside q_mu_ (an MB-scale memcpy under the global
+            // queue lock would stall every stream thread); rb is not in
+            // the map, so no other thread can touch it
+            lk.unlock();
+            std::memcpy(rb->buf, payload.data(), payload.size());
+            rb->got = rb->cap;
+            rb->state = 1;
+            return 0;
+        }
+        if (!regbufs_.emplace(key, rb).second) { return -3; }
+        return 0;
+    }
+
+    // Abandon a registration made by recv_register (error-path cleanup).
+    // Blocks while the stream thread holds a claim on the buffer — after
+    // return, no live pointer to rb remains anywhere in the channel.
+    void recv_cancel(const std::string &src, const std::string &name,
+                     int conn_type, RegBuf *rb) {
+        QueueKey key{static_cast<uint8_t>(conn_type), src, name,
+                     conn_type == kConnCollective ? token_.load() : 0};
+        std::unique_lock<std::mutex> lk(q_mu_);
+        while (rb->state == 3) { cv_.wait(lk); }
+        auto it = regbufs_.find(key);
+        if (it != regbufs_.end() && it->second == rb) { regbufs_.erase(it); }
+    }
+
+    // Wait for a buffer registered with recv_register to fill.
+    // 0 ok, 1 timeout, 2 closed, -2 queued-size mismatch.  On ANY return
+    // the registration is gone (no dangling pointer).
+    int recv_await(const std::string &src, const std::string &name,
+                   int conn_type, double timeout_s, RegBuf *rb,
+                   uint32_t *got) {
+        QueueKey key{static_cast<uint8_t>(conn_type), src, name,
+                     conn_type == kConnCollective ? token_.load() : 0};
+        const bool forever = timeout_s < 0;
+        std::unique_lock<std::mutex> lk(q_mu_);
+        ++recv_inflight_;
+        struct Guard {
+            Channel *ch;
+            ~Guard() {
+                if (--ch->recv_inflight_ == 0) { ch->cv_.notify_all(); }
+            }
+        } guard{this};
+        auto deadline =
+            std::chrono::steady_clock::now() +
+            (forever ? std::chrono::steady_clock::duration::zero()
+                     : std::chrono::duration_cast<std::chrono::steady_clock::duration>(
+                           std::chrono::duration<double>(timeout_s)));
+        auto deregister = [&] {
+            auto it = regbufs_.find(key);
+            if (it != regbufs_.end() && it->second == rb) { regbufs_.erase(it); }
+        };
+        for (;;) {
+            // resolution order matters: while CLAIMED (state 3) the stream
+            // thread is writing into buf and holds a pointer to the
+            // caller's frame — nothing may return until the claim resolves
+            if (rb->state == 1) {
+                deregister();
+                *got = rb->got;
+                return 0;
+            }
+            if (rb->state == 2) {
+                deregister();
+                return 2;
+            }
+            if (rb->state == 0) {
+                // a queued payload (arrived with a non-matching key state,
+                // or a duplicate keyed send) wins over waiting
+                auto it = queues_.find(key);
+                if (it != queues_.end() && !it->second.empty()) {
+                    deregister();
+                    if (it->second.front().size() != rb->cap) { return -2; }
+                    std::string payload = std::move(it->second.front());
+                    it->second.pop_front();
+                    lk.unlock();
+                    std::memcpy(rb->buf, payload.data(), payload.size());
+                    lk.lock();
+                    *got = rb->cap;
+                    return 0;
+                }
+                if (!running_.load()) {
+                    deregister();
+                    return 2;
+                }
+            }
+            if (forever || rb->state == 3) {
+                cv_.wait(lk);
+            } else if (cv_.wait_until(lk, deadline) == std::cv_status::timeout) {
+                if (rb->state == 0) {
+                    deregister();
+                    return 1;
+                }
             }
         }
     }
@@ -702,6 +872,7 @@ class Channel {
                 int one = 1;
                 ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
             }
+            set_deep_buffers(fd);
             {
                 std::lock_guard<std::mutex> lk(conns_mu_);
                 // reap finished connections so short-lived clients (pings
@@ -905,24 +1076,68 @@ int engine_run_chunk(Channel *ch, const std::vector<std::string> &peers,
     const std::string rtag = tag + ".r";
     const std::string btag = tag + ".b";
     uint32_t got = 0;
-    bool have = g.r_selfloop;  // chunk buffer already holds our contribution
-    for (int32_t prev : g.r_prevs) {
-        int rc;
-        if (!have) {
-            rc = ch->recv_into(peers[prev], rtag, kConnCollective, timeout_s,
-                               chunk, static_cast<uint32_t>(chunk_bytes), &got);
-            have = true;
+    const bool have = g.r_selfloop;  // chunk already holds our contribution
+    const size_t nprev = g.r_prevs.size();
+
+    // pre-register EVERY reduce-phase receive before touching the wire:
+    // a peer that sends before we get around to its recv lands straight
+    // in its target buffer instead of detouring through the queue (an
+    // allocation plus two full copies per miss).  Targets are disjoint,
+    // so stream threads fill them concurrently; accumulation stays in
+    // deterministic rank order below.
+    std::vector<RegBuf> rbs(nprev);
+    std::vector<uint8_t *> tgt(nprev, nullptr);
+    size_t scratch_need = 0;
+    for (size_t i = 0; i < nprev; ++i) {
+        if (!have && i == 0) {
+            tgt[i] = chunk;  // first contribution lands in place
         } else {
-            if (scratch.size() < chunk_bytes) { scratch.resize(chunk_bytes); }
-            rc = ch->recv_into(peers[prev], rtag, kConnCollective, timeout_s,
-                               scratch.data(), static_cast<uint32_t>(chunk_bytes),
-                               &got);
-            if (rc == 0 &&
-                kf_transform2(chunk, scratch.data(), elems, dtype, op) != 0) {
-                return -4;
+            scratch_need += chunk_bytes;
+        }
+    }
+    if (scratch.size() < scratch_need) { scratch.resize(scratch_need); }
+    {
+        size_t off = 0;
+        for (size_t i = 0; i < nprev; ++i) {
+            if (tgt[i] == nullptr) {
+                tgt[i] = scratch.data() + off;
+                off += chunk_bytes;
             }
         }
-        if (rc != 0) { return rc; }
+    }
+    int rc = 0;
+    size_t registered = 0;
+    for (; registered < nprev; ++registered) {
+        auto &rb = rbs[registered];
+        rb.buf = tgt[registered];
+        rb.cap = static_cast<uint32_t>(chunk_bytes);
+        rc = ch->recv_register(peers[g.r_prevs[registered]], rtag,
+                               kConnCollective, &rb);
+        if (rc != 0) { break; }
+    }
+    auto cancel_tail = [&](size_t from) {
+        // error path: every outstanding registration must be withdrawn
+        // before the stack frame holding the RegBufs unwinds
+        for (size_t j = from; j < registered; ++j) {
+            ch->recv_cancel(peers[g.r_prevs[j]], rtag, kConnCollective, &rbs[j]);
+        }
+    };
+    if (rc != 0) {
+        cancel_tail(0);
+        return rc == -3 ? -1 : rc;
+    }
+    for (size_t i = 0; i < nprev; ++i) {
+        rc = ch->recv_await(peers[g.r_prevs[i]], rtag, kConnCollective,
+                            timeout_s, &rbs[i], &got);
+        if (rc != 0) {
+            cancel_tail(i + 1);
+            return rc;
+        }
+        if (tgt[i] != chunk &&
+            kf_transform2(chunk, tgt[i], elems, dtype, op) != 0) {
+            cancel_tail(i + 1);
+            return -4;
+        }
     }
     for (int32_t nxt : g.r_nexts) {
         if (ch->send(peers[nxt], rtag, chunk,
@@ -931,10 +1146,13 @@ int engine_run_chunk(Channel *ch, const std::vector<std::string> &peers,
             return 2;
         }
     }
+    // the broadcast receive reuses the chunk buffer, so it registers only
+    // after the reduce sends complete (our bcast parent cannot have the
+    // result earlier anyway — it transitively needs our contribution)
     if (!g.b_selfloop && !g.b_prevs.empty()) {
-        int rc = ch->recv_into(peers[g.b_prevs[0]], btag, kConnCollective,
-                               timeout_s, chunk,
-                               static_cast<uint32_t>(chunk_bytes), &got);
+        rc = ch->recv_into(peers[g.b_prevs[0]], btag, kConnCollective,
+                           timeout_s, chunk,
+                           static_cast<uint32_t>(chunk_bytes), &got);
         if (rc != 0) { return rc; }
     }
     for (int32_t nxt : g.b_nexts) {

@@ -342,16 +342,20 @@ class Peer:
         (reference ``peer/legacy.go:18-39``)."""
         if not self.config.config_server:
             raise RuntimeError("propose_new_size requires KF_CONFIG_SERVER")
+        if self.rank() != 0:
+            return
         world = self.config.world_peers
         if world is not None and new_size > len(world):
             # a phantom worker (valid PeerID, no process) would wedge every
-            # later host-plane collective waiting for it to come up
-            raise ValueError(
-                f"cannot grow to {new_size}: the provisioned device world "
-                f"has {len(world)} slots"
+            # later host-plane collective waiting for it to come up; clamp
+            # rather than raise — schedules drive this from per-step hooks
+            # and an over-ask must not kill the training run
+            _log.warning(
+                "proposed size %d exceeds the provisioned device world "
+                "(%d slots) — clamping to the world capacity",
+                new_size, len(world),
             )
-        if self.rank() != 0:
-            return
+            new_size = len(world)
         new_cluster = self.cluster.resize(new_size)
         req = urllib.request.Request(
             self.config.config_server,

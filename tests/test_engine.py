@@ -89,6 +89,32 @@ class TestEngine:
             for c in chans:
                 c.close()
 
+    @pytest.mark.parametrize("native_on", ["1", "0"])
+    @pytest.mark.parametrize("op", ["sum", "mean"])
+    def test_allreduce_inplace(self, monkeypatch, native_on, op):
+        """inplace=True reduces into the caller's buffer (NCCL in-place
+        analog) on BOTH the native executor and the Python fallback; with
+        op='mean' the buffer must hold the divided result, not the sum."""
+        monkeypatch.setenv("KF_NATIVE_ENGINE", native_on)
+        peers, chans = make_cluster(2)
+        try:
+            engines = [CollectiveEngine(c, peers, Strategy.STAR) for c in chans]
+            data = [np.arange(8, dtype=np.float32) * (i + 1) for i in range(2)]
+            want = data[0] + data[1]
+            if op == "mean":
+                want = want / 2
+            outs = run_all(
+                [lambda e=e, d=d: (e.all_reduce(d, op=op, inplace=True), d)
+                 for e, d in zip(engines, data)]
+            )
+            for out, buf in outs:
+                np.testing.assert_allclose(out, want, rtol=1e-6)
+                # the input buffer was clobbered with the result
+                np.testing.assert_allclose(buf, want, rtol=1e-6)
+        finally:
+            for c in chans:
+                c.close()
+
     def test_mean(self):
         peers, chans = make_cluster(4)
         try:
