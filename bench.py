@@ -303,6 +303,25 @@ def payload_kernels(args) -> dict:
         "shape": [B, H, S, D],
     }
 
+    # grad path (round 3: the Pallas dQ + dK/dV backward kernels): chain
+    # q -> q - eps * dq, which forces a full fwd+bwd per iteration
+    def grad_step(attn):
+        def f(q_):
+            dq = jax.grad(lambda qq: jnp.sum(attn(qq).astype(jnp.float32) ** 2))(q_)
+            return (q_ - 1e-3 * dq).astype(q_.dtype)
+        return f
+
+    t_pallas_g = measure_chained(
+        grad_step(lambda qq: flash_attention(qq, k, v, causal=True)), q
+    )
+    t_xla_g = measure_chained(grad_step(lambda qq: xla_attn(qq, k, v)), q)
+    results["flash_attention_fwd_bwd"] = {
+        "pallas_ms": round(t_pallas_g * 1e3, 3),
+        "xla_naive_ms": round(t_xla_g * 1e3, 3),
+        "speedup": round(t_xla_g / t_pallas_g, 3),
+        "shape": [B, H, S, D],
+    }
+
     # fused softmax-xent: pallas kernel vs XLA logsumexp path
     from kungfu_tpu.ops.pallas.xent import softmax_cross_entropy
 
@@ -330,6 +349,27 @@ def payload_kernels(args) -> dict:
         "pallas_ms": round(t_pallas_x * 1e3, 3),
         "xla_ms": round(t_xla_x * 1e3, 3),
         "speedup": round(t_xla_x / t_pallas_x, 3),
+        "shape": [N, V],
+    }
+
+    # grad path (round 3: the Pallas dlogits kernel)
+    def xent_grad_step(scalar_loss):
+        def f(lg):
+            dl = jax.grad(scalar_loss)(lg)
+            return (lg - 0.1 * dl).astype(lg.dtype)
+        return f
+
+    t_pallas_xg = measure_chained(
+        xent_grad_step(lambda x: softmax_cross_entropy(x, labels).mean()),
+        logits,
+    )
+    t_xla_xg = measure_chained(
+        xent_grad_step(lambda x: xla_xent(x, labels)), logits
+    )
+    results["fused_xent_fwd_bwd"] = {
+        "pallas_ms": round(t_pallas_xg * 1e3, 3),
+        "xla_ms": round(t_xla_xg * 1e3, 3),
+        "speedup": round(t_xla_xg / t_pallas_xg, 3),
         "shape": [N, V],
     }
 

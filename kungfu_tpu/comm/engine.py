@@ -56,29 +56,14 @@ _log = get_logger("engine")
 CHUNK_SIZE = 1 << 20  # 1 MiB, reference session.go:292-316
 
 
-def _env_int(name: str, default: int) -> int:
-    import os
-
-    try:
-        return int(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
-
-
-def _env_float(name: str, default: float) -> float:
-    import os
-
-    try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
-
-
 def engine_chunk_size() -> int:
     """Chunk size for graph sharding (``KF_CONFIG_CHUNK_SIZE`` bytes).
-    Non-positive values fall back to the default (0 would divide-by-zero
-    the chunk count, and SIGFPE the native executor)."""
-    v = _env_int("KF_CONFIG_CHUNK_SIZE", CHUNK_SIZE)
+    MUST be identical on every peer — chunk boundaries and tags derive
+    from it, and a mismatch surfaces as collective timeouts.  The
+    launcher propagates the launcher-shell env to all workers, so set it
+    where the job is launched, not per worker.  Non-positive values fall
+    back to the default (0 would divide-by-zero the chunk count)."""
+    v = envs.parse_int_env(envs.CHUNK_SIZE, CHUNK_SIZE)
     return v if v > 0 else CHUNK_SIZE
 
 
@@ -88,8 +73,8 @@ def engine_threads() -> int:
     costs ~20% (measured), on real hosts chunk parallelism wins."""
     import os
 
-    return _env_int(
-        "KF_CONFIG_ENGINE_THREADS", min(8, max(1, os.cpu_count() or 1))
+    return envs.parse_int_env(
+        envs.ENGINE_THREADS, min(8, max(1, os.cpu_count() or 1))
     )
 
 
@@ -97,7 +82,7 @@ def engine_timeout_s() -> float:
     """Native executor per-collective timeout (``KF_CONFIG_ENGINE_TIMEOUT``
     seconds) — round-2 VERDICT: a large slow-network collective must be
     tunable past the old hardcoded 60 s."""
-    return _env_float("KF_CONFIG_ENGINE_TIMEOUT", 60.0)
+    return envs.parse_float_env(envs.ENGINE_TIMEOUT, 60.0)
 
 REDUCE_OPS = native.REDUCE_OPS  # single source of op names
 
@@ -202,15 +187,18 @@ class CollectiveEngine:
         (e.g. interference votes) out of the throughput window so the
         adaptation signal only sees data-plane transfers.
 
-        ``inplace=True`` reduces directly in ``x``'s buffer (must be a
-        contiguous ndarray) and returns it — skips one full defensive
-        copy, the NCCL in-place allreduce analog; the input values are
-        clobbered."""
+        ``inplace=True`` reduces directly in ``x``'s buffer and returns
+        ``x`` — skips one full defensive copy, the NCCL in-place
+        allreduce analog; the input values are clobbered.  The contract
+        is honored for ANY writable ndarray (a non-contiguous view pays
+        a staging copy but still receives the result); a read-only input
+        raises instead of silently downgrading."""
         if op not in REDUCE_OPS and op != "mean":
             raise ValueError(f"op {op!r}")
         eff_op = "sum" if op == "mean" else op
-        if inplace and (not x.flags["C_CONTIGUOUS"] or not x.flags["WRITEABLE"]):
-            inplace = False
+        if inplace and not x.flags["WRITEABLE"]:
+            raise ValueError("inplace=True requires a writable array")
+        orig = x
         x = np.ascontiguousarray(x)
         flat = x.reshape(-1)
         tag = name or f"ar{self._next_seq()}"
@@ -222,12 +210,12 @@ class CollectiveEngine:
         if op == "mean":
             out = np.divide(out, len(self.peers), out=out if inplace else None)
         if inplace:
-            # the Python fallback (and a mean divide) may have produced a
-            # fresh array — the inplace contract says x's buffer holds the
-            # result either way
-            if not np.shares_memory(out, x):
-                np.copyto(x, out)
-            return x
+            # the Python fallback, a mean divide, or a non-contiguous
+            # staging copy may have produced a fresh array — the inplace
+            # contract says the CALLER's buffer holds the result either way
+            if not np.shares_memory(out, orig):
+                np.copyto(orig, out)
+            return orig
         return out
 
     def broadcast(self, x: np.ndarray, root: int = 0, name: str = "") -> np.ndarray:

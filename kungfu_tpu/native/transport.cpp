@@ -1079,52 +1079,56 @@ int engine_run_chunk(Channel *ch, const std::vector<std::string> &peers,
     const bool have = g.r_selfloop;  // chunk already holds our contribution
     const size_t nprev = g.r_prevs.size();
 
-    // pre-register EVERY reduce-phase receive before touching the wire:
-    // a peer that sends before we get around to its recv lands straight
-    // in its target buffer instead of detouring through the queue (an
-    // allocation plus two full copies per miss).  Targets are disjoint,
-    // so stream threads fill them concurrently; accumulation stays in
-    // deterministic rank order below.
+    // pre-register reduce-phase receives before touching the wire: a
+    // peer that sends before we get around to its recv lands straight in
+    // its target buffer instead of detouring through the queue (an
+    // allocation plus two full copies per miss).  Registration runs a
+    // SLIDING WINDOW of kRegWindow buffers — high-fan-in graphs (a STAR
+    // root at np=64) would otherwise hold O(fan_in * chunk) scratch;
+    // the window keeps the zero-copy overlap with O(1) extra memory.
+    constexpr size_t kRegWindow = 4;
     std::vector<RegBuf> rbs(nprev);
     std::vector<uint8_t *> tgt(nprev, nullptr);
-    size_t scratch_need = 0;
-    for (size_t i = 0; i < nprev; ++i) {
-        if (!have && i == 0) {
-            tgt[i] = chunk;  // first contribution lands in place
-        } else {
-            scratch_need += chunk_bytes;
-        }
+    const size_t n_scratch = std::min(nprev, kRegWindow);
+    if (scratch.size() < n_scratch * chunk_bytes) {
+        scratch.resize(n_scratch * chunk_bytes);
     }
-    if (scratch.size() < scratch_need) { scratch.resize(scratch_need); }
-    {
-        size_t off = 0;
-        for (size_t i = 0; i < nprev; ++i) {
-            if (tgt[i] == nullptr) {
-                tgt[i] = scratch.data() + off;
-                off += chunk_bytes;
-            }
-        }
+    std::vector<uint8_t *> free_slots;
+    for (size_t s_i = 0; s_i < n_scratch; ++s_i) {
+        free_slots.push_back(scratch.data() + s_i * chunk_bytes);
     }
     int rc = 0;
-    size_t registered = 0;
-    for (; registered < nprev; ++registered) {
-        auto &rb = rbs[registered];
-        rb.buf = tgt[registered];
+    size_t reg_hi = 0;  // prevs [await_i, reg_hi) are registered
+    auto register_next = [&]() -> int {
+        auto &rb = rbs[reg_hi];
+        if (!have && reg_hi == 0) {
+            tgt[reg_hi] = chunk;  // first contribution lands in place
+        } else {
+            tgt[reg_hi] = free_slots.back();
+            free_slots.pop_back();
+        }
+        rb.buf = tgt[reg_hi];
         rb.cap = static_cast<uint32_t>(chunk_bytes);
-        rc = ch->recv_register(peers[g.r_prevs[registered]], rtag,
-                               kConnCollective, &rb);
-        if (rc != 0) { break; }
-    }
+        int r = ch->recv_register(peers[g.r_prevs[reg_hi]], rtag,
+                                  kConnCollective, &rb);
+        if (r == 0) { ++reg_hi; }
+        return r;
+    };
     auto cancel_tail = [&](size_t from) {
         // error path: every outstanding registration must be withdrawn
         // before the stack frame holding the RegBufs unwinds
-        for (size_t j = from; j < registered; ++j) {
+        for (size_t j = from; j < reg_hi; ++j) {
             ch->recv_cancel(peers[g.r_prevs[j]], rtag, kConnCollective, &rbs[j]);
         }
     };
-    if (rc != 0) {
-        cancel_tail(0);
-        return rc == -3 ? -1 : rc;
+    while (reg_hi < nprev) {
+        const bool needs_slot = have || reg_hi > 0;  // else lands in chunk
+        if (needs_slot && free_slots.empty()) { break; }
+        rc = register_next();
+        if (rc != 0) {
+            cancel_tail(0);
+            return rc == -3 ? -1 : rc;
+        }
     }
     for (size_t i = 0; i < nprev; ++i) {
         rc = ch->recv_await(peers[g.r_prevs[i]], rtag, kConnCollective,
@@ -1133,10 +1137,19 @@ int engine_run_chunk(Channel *ch, const std::vector<std::string> &peers,
             cancel_tail(i + 1);
             return rc;
         }
-        if (tgt[i] != chunk &&
-            kf_transform2(chunk, tgt[i], elems, dtype, op) != 0) {
-            cancel_tail(i + 1);
-            return -4;
+        if (tgt[i] != chunk) {
+            if (kf_transform2(chunk, tgt[i], elems, dtype, op) != 0) {
+                cancel_tail(i + 1);
+                return -4;
+            }
+            free_slots.push_back(tgt[i]);  // slot drained, reusable
+        }
+        while (reg_hi < nprev && !free_slots.empty()) {
+            rc = register_next();
+            if (rc != 0) {
+                cancel_tail(i + 1);
+                return rc == -3 ? -1 : rc;
+            }
         }
     }
     for (int32_t nxt : g.r_nexts) {

@@ -13,17 +13,25 @@ compute, and the K/V index maps clamp to the last needed block so
 Pallas's revisit-elision skips their HBM→VMEM copies too — causal
 attention does ~half the FLOPs *and* ~half the K/V traffic.
 
-Backward: blocked jnp (``lax.scan`` over K blocks) using the saved
-logsumexp rows — the standard flash-attention recomputation:
+Backward (round 3): two Pallas kernels using the saved logsumexp rows —
+the standard flash-attention recomputation
 
-    P  = exp(Q K^T * scale - L)        (recomputed per block)
+    P  = exp(Q K^T * scale - L)        (recomputed per tile)
     dV = P^T dO
     dP = dO V^T
     dS = P * (dP - rowsum(dO * O))
     dQ = dS K * scale ;  dK = dS^T Q * scale
 
-so backward memory is also O(S * block) — autodiff through the Pallas
-call would instead save every tile.  The whole op is a ``custom_vjp``.
+split the way TPU memory wants it: a **dQ kernel** on a (bh, q-block,
+kv-block) grid accumulating dQ in VMEM scratch while K/V tiles stream,
+and a **dK/dV kernel** on a (bh, kv-block, q-block) grid accumulating
+dK/dV while Q/dO/L/delta tiles stream — both O(block) VMEM, both with
+the same causal skip + index-clamp revisit-elision as the forward (a
+causal backward does ~half the FLOPs and ~half the tile traffic).  The
+blocked-jnp backward is kept as the non-TPU fallback and as the
+reference implementation the kernel tests compare against.  The whole
+op is a ``custom_vjp`` — autodiff through the Pallas forward would
+instead save every tile.
 
 The reference framework has no attention at all (SURVEY §2.4/§5.7 — it
 moves gradient buffers only); this kernel is part of the TPU build's
@@ -206,6 +214,206 @@ def _bwd_blocked(q, k, v, out, lse, dout, causal, block_k):
     return dq.astype(q.dtype), dk.astype(q.dtype), dv.astype(q.dtype)
 
 
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, acc_s, *, scale, causal, seq_len, block_q, block_k):
+    """dQ on a (bh, q-block, kv-block) grid; K/V stream along the inner
+    dim, dQ accumulates in VMEM scratch (mirror of the forward)."""
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    n_k = pl.num_programs(2)
+    if causal:
+        j_hi = jnp.minimum(_causal_hi(qi, block_q, block_k), n_k - 1)
+    else:
+        j_hi = n_k - 1
+
+    @pl.when(kj == 0)
+    def _():
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    @pl.when(kj <= j_hi)
+    def _():
+        q = q_ref[0].astype(jnp.float32) * scale      # [bq, D]
+        kb = k_ref[0]                                  # [bk, D]
+        vb = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)             # [bq, D]
+        lse = lse_ref[0][:, None]                      # [bq, 1]
+        delta = delta_ref[0][:, None]                  # [bq, 1]
+        s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32)
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = k_pos < seq_len
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_pos)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)     # [bq, bk]
+        dp = jnp.dot(do.astype(vb.dtype), vb.T,
+                     preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        acc_s[:] = acc_s[:] + jnp.dot(
+            ds.astype(kb.dtype), kb, preferred_element_type=jnp.float32
+        ) * scale
+
+    @pl.when(kj == j_hi)
+    def _():
+        dq_ref[0] = acc_s[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_s, dv_s, *, scale, causal, seq_len,
+                    block_q, block_k):
+    """dK/dV on a (bh, kv-block, q-block) grid; Q/dO/L/delta stream along
+    the inner dim, dK/dV accumulate in VMEM scratch."""
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    n_q = pl.num_programs(2)
+    if causal:
+        # first q block that attends to kv block kj
+        i_lo = jax.lax.div(kj * block_k, block_q)
+    else:
+        i_lo = 0
+
+    @pl.when(qi == 0)
+    def _():
+        dk_s[:] = jnp.zeros_like(dk_s)
+        dv_s[:] = jnp.zeros_like(dv_s)
+
+    @pl.when(qi >= i_lo)
+    def _():
+        q = q_ref[0].astype(jnp.float32) * scale       # [bq, D]
+        kb = k_ref[0]                                   # [bk, D]
+        vb = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)              # [bq, D]
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32)
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = k_pos < seq_len
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_pos)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)      # [bq, bk]
+        dv_s[:] = dv_s[:] + jnp.dot(
+            p.astype(do_ref.dtype).T, do.astype(do_ref.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jnp.dot(do.astype(vb.dtype), vb.T,
+                     preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        # q already carries `scale`, so dS^T (q*scale) == dK
+        dk_s[:] = dk_s[:] + jnp.dot(
+            ds.astype(q_ref.dtype).T, q.astype(q_ref.dtype),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == n_q - 1)
+    def _():
+        dk_ref[0] = dk_s[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[:].astype(dv_ref.dtype)
+
+
+def _bwd_pallas(q, k, v, out, lse, dout, causal, block_q, block_k, interpret):
+    """Pallas backward: dq via a kv-streaming kernel, dk/dv via a
+    q-streaming kernel; [BH, S, D] operands."""
+    bh, s, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    delta = jnp.sum(
+        dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # [BH, S]
+
+    s_pad = ((s + block_q - 1) // block_q) * block_q
+    s_pad = ((s_pad + block_k - 1) // block_k) * block_k
+    if s_pad != s:
+        pad3 = [(0, 0), (0, s_pad - s), (0, 0)]
+        q, k, v, dout = (jnp.pad(t, pad3) for t in (q, k, v, dout))
+        # padded q rows: lse=+inf makes their P rows exp(s - inf) = 0
+        lse = jnp.pad(lse, [(0, 0), (0, s_pad - s)], constant_values=1e30)
+        delta = jnp.pad(delta, [(0, 0), (0, s_pad - s)])
+    n_q = s_pad // block_q
+    n_k = s_pad // block_k
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    row_spec = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))
+    if causal:
+        def kv_index(b, i, j):
+            return (b, jnp.minimum(j, _causal_hi(i, block_q, block_k)), 0)
+    else:
+        def kv_index(b, i, j):
+            return (b, j, 0)
+    kv_spec = pl.BlockSpec((1, block_k, d), kv_index)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal, seq_len=s,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=(bh, n_q, n_k),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=[q_spec],
+        out_shape=[jax.ShapeDtypeStruct((bh, s_pad, d), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, dout, lse, delta)[0]
+
+    # dk/dv grid: (bh, kv-block, q-block); clamp the q index upward for
+    # causal so all-masked q blocks repeat their predecessor's tile and
+    # Pallas elides the copies
+    if causal:
+        def q_index(b, j, i):
+            return (b, jnp.maximum(i, jax.lax.div(j * block_k, block_q)), 0)
+
+        def qrow_index(b, j, i):
+            return (b, jnp.maximum(i, jax.lax.div(j * block_k, block_q)))
+    else:
+        def q_index(b, j, i):
+            return (b, i, 0)
+
+        def qrow_index(b, j, i):
+            return (b, i)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal, seq_len=s,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=(bh, n_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_q), qrow_index),
+            pl.BlockSpec((1, block_q), qrow_index),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s_pad, d), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, dout, lse, delta)
+    return dq[:, :s], dk[:, :s], dv[:, :s]
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, block_q, block_k, interpret):
     out, _ = _fwd_call(q, k, v, causal, block_q, block_k, interpret)
@@ -219,7 +427,17 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, dout):
     q, k, v, out, lse = res
-    return _bwd_blocked(q, k, v, out, lse, dout, causal, block_k)
+    import os
+
+    # compiled path (TPU): the Pallas backward kernels.  Interpret mode
+    # (CPU test clusters) defaults to the blocked-jnp reference backward
+    # — much faster than interpreting the kernels — unless KF_PALLAS_BWD
+    # =pallas forces them (how the kernel numerics tests run off-TPU).
+    if interpret and os.environ.get("KF_PALLAS_BWD", "") != "pallas":
+        return _bwd_blocked(q, k, v, out, lse, dout, causal, block_k)
+    return _bwd_pallas(
+        q, k, v, out, lse, dout, causal, block_q, block_k, interpret
+    )
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

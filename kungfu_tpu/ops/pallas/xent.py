@@ -6,11 +6,14 @@ second [B*S, V] tensor and autodiff saves more; this kernel streams the
 vocab once per row block, producing only per-token ``loss`` and
 ``logsumexp`` — O(N) extra memory instead of O(N*V).
 
-Backward recomputes the softmax blockwise from the logits and the saved
-logsumexp (``dlogits = (softmax - onehot(target)) * g / N_tokens``) in a
-``lax.scan`` over vocab blocks, so its live memory is also one block at
-a time (the [N, V] dlogits output itself is required by the head matmul
-backward and is unavoidable).
+Backward (round 3): a Pallas kernel over the same (row, vocab) grid
+recomputes the softmax per tile from the logits and the saved logsumexp
+(``dlogits = (softmax - onehot(target)) * g``) — purely elementwise per
+tile, no cross-tile state, so it is a single fused read(logits) →
+write(dlogits) sweep.  The blocked-jnp backward is kept as the non-TPU
+fallback and as the reference the kernel tests compare against.  (The
+[N, V] dlogits output itself is required by the head matmul backward
+and is unavoidable.)
 
 Interpret mode on CPU for tests; compiled on TPU.
 """
@@ -132,6 +135,45 @@ def _bwd_blocked(logits, targets, lse, g, block_v):
     return dblocks.transpose(1, 0, 2).reshape(n, v_pad)[:, :v]
 
 
+def _bwd_kernel(logits_ref, targets_ref, lse_ref, g_ref, dl_ref, *,
+                vocab, block_v):
+    """dlogits tile = (softmax - onehot) * g; stateless per grid step."""
+    j = pl.program_id(1)
+    blk = logits_ref[...].astype(jnp.float32)  # [block_n, block_v]
+    n = blk.shape[0]
+    k_pos = j * block_v + jax.lax.broadcasted_iota(jnp.int32, (n, block_v), 1)
+    lse = lse_ref[...][:, None]
+    g = g_ref[...][:, None]
+    p = jnp.where(k_pos < vocab, jnp.exp(blk - lse), 0.0)
+    onehot = (k_pos == targets_ref[...][:, None]).astype(jnp.float32)
+    dl_ref[...] = ((p - onehot) * g).astype(dl_ref.dtype)
+
+
+def _bwd_pallas(logits, targets, lse, g, block_n, block_v, interpret):
+    n, v = logits.shape
+    n_pad = ((n + block_n - 1) // block_n) * block_n
+    v_pad = ((v + block_v - 1) // block_v) * block_v
+    if n_pad != n or v_pad != v:
+        logits = jnp.pad(logits, [(0, n_pad - n), (0, v_pad - v)])
+        targets = jnp.pad(targets, [(0, n_pad - n)])
+        # padded rows: lse=+inf zeroes their softmax, g=0 their gradient
+        lse = jnp.pad(lse, [(0, n_pad - n)], constant_values=1e30)
+        g = jnp.pad(g, [(0, n_pad - n)])
+    row = pl.BlockSpec((block_n,), lambda i, j: (i,))
+    dlogits = pl.pallas_call(
+        functools.partial(_bwd_kernel, vocab=v, block_v=block_v),
+        grid=(n_pad // block_n, v_pad // block_v),
+        in_specs=[
+            pl.BlockSpec((block_n, block_v), lambda i, j: (i, j)),
+            row, row, row,
+        ],
+        out_specs=pl.BlockSpec((block_n, block_v), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((n_pad, v_pad), logits.dtype),
+        interpret=interpret,
+    )(logits, targets, lse, g)
+    return dlogits[:n, :v]
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
 def _xent(logits, targets, block_n, block_v, interpret):
     loss, _ = _fwd_call(logits, targets, block_n, block_v, interpret)
@@ -145,7 +187,17 @@ def _xent_fwd(logits, targets, block_n, block_v, interpret):
 
 def _xent_bwd(block_n, block_v, interpret, res, g):
     logits, targets, lse = res
-    dlogits = _bwd_blocked(logits, targets, lse, g, block_v)
+    import os
+
+    # compiled path (TPU): the Pallas backward kernel; interpret mode
+    # falls back to blocked jnp unless KF_PALLAS_BWD=pallas forces the
+    # kernel (how the numerics tests run off-TPU)
+    if interpret and os.environ.get("KF_PALLAS_BWD", "") != "pallas":
+        dlogits = _bwd_blocked(logits, targets, lse, g, block_v)
+    else:
+        dlogits = _bwd_pallas(
+            logits, targets, lse, g, block_n, block_v, interpret
+        )
     return dlogits.astype(logits.dtype), None
 
 

@@ -39,6 +39,12 @@ Tuning envs (read anywhere, any time):
 ``KF_CONFIG_LOG_LEVEL``            DEBUG/INFO/WARN/ERROR
 ``KF_CONFIG_STRATEGY_HASH_METHOD`` chunk→strategy hash: "simple"|"name"
 ``KF_CONFIG_WAIT_RUNNER_TIMEOUT``  seconds, default 30
+``KF_CONFIG_CHUNK_SIZE``           engine chunk bytes, default 1 MiB.
+                                   Must be identical cluster-wide (set at
+                                   the launcher; it propagates to workers)
+``KF_CONFIG_ENGINE_THREADS``       native executor threads, default
+                                   min(8, cores)
+``KF_CONFIG_ENGINE_TIMEOUT``       per-collective timeout s, default 60
 =================================  ============================================
 """
 
@@ -77,6 +83,9 @@ ENABLE_STALL_DETECTION = "KF_CONFIG_ENABLE_STALL_DETECTION"
 LOG_LEVEL = "KF_CONFIG_LOG_LEVEL"
 STRATEGY_HASH_METHOD = "KF_CONFIG_STRATEGY_HASH_METHOD"
 WAIT_RUNNER_TIMEOUT = "KF_CONFIG_WAIT_RUNNER_TIMEOUT"
+CHUNK_SIZE = "KF_CONFIG_CHUNK_SIZE"
+ENGINE_THREADS = "KF_CONFIG_ENGINE_THREADS"
+ENGINE_TIMEOUT = "KF_CONFIG_ENGINE_TIMEOUT"
 
 ALL_BOOTSTRAP_ENVS = [
     SELF_SPEC, INIT_PEERS, INIT_RUNNERS, PARENT_ID, INIT_CLUSTER_VERSION,
@@ -91,6 +100,20 @@ def parse_bool_env(name: str, default: bool = False) -> bool:
     if v is None:
         return default
     return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def parse_int_env(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def parse_float_env(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
 
 
 @dataclass
