@@ -1,0 +1,144 @@
+"""GNS-driven elasticity: the monitor decides the cluster size.
+
+The round-3 closing of the loop the reference designed its monitoring
+for (SURVEY §5.5: gradient noise scale, "the signal meant to drive
+resize decisions"; BASELINE config 5 "elastic resize + GNS monitor"):
+every step the workers estimate the gradient noise scale over the host
+collective plane, smooth it with an EMA, and hand it to a
+:class:`~kungfu_tpu.policy.policies.GNSResizePolicy` driven by a
+:class:`~kungfu_tpu.policy.runner.PolicyRunner` — when the noise scale
+says larger batches still help, the policy proposes a grow through the
+config server and the elastic protocol re-carves the cluster, all in
+one run with no operator in the loop.
+
+``--synthetic-gns`` substitutes a deterministic GNS ramp for the
+measured value (the real estimator still runs and is printed) — the
+injection knob the e2e test uses, in the spirit of the reference's
+crash-injection test flags; the monitor→propose→resize pipeline it
+drives is the real one end to end.
+
+Run (grow 1→2 when the noise scale rises)::
+
+    python -m kungfu_tpu.runner.cli -w -builtin-config-port 9332 \
+        -np 1 -H 127.0.0.1:2 python3 examples/gns_elastic.py \
+        -- --steps 10 --synthetic-gns 24,24,24,96,96,96,96,96,96,96
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=0.2)
+    ap.add_argument("--max-size", type=int, default=2)
+    ap.add_argument("--synthetic-gns", default="",
+                    help="comma list: per-step injected GNS values "
+                         "(test/demo knob; empty = act on the measured EMA)")
+    args = ap.parse_args()
+
+    import kungfu_tpu as kf
+    from kungfu_tpu.initializer import broadcast_parameters
+    from kungfu_tpu.models import mnist_slp
+    from kungfu_tpu.ops.monitor import host_noise_scale
+    from kungfu_tpu.policy import GNSResizePolicy, PolicyRunner
+    from examples.mnist_slp import synthetic_mnist
+
+    peer = kf.init()
+    rank = kf.current_rank()
+    print(f"worker {rank}/{kf.cluster_size()} up (v{peer.cluster_version})",
+          flush=True)
+
+    model = mnist_slp()
+    params = broadcast_parameters(model.init(jax.random.PRNGKey(5)), peer)
+    x, y = synthetic_mnist()
+    loss_grad = jax.jit(jax.value_and_grad(model.loss))
+    opt = optax.sgd(args.lr)
+    opt_state = opt.init(params)
+
+    policy = GNSResizePolicy(
+        min_size=1, max_size=args.max_size, threshold=0.4, cooldown_steps=2
+    )
+    runner = PolicyRunner([policy], peer=peer, batch_size=args.batch_size)
+    injected = (
+        [float(v) for v in args.synthetic_gns.split(",")]
+        if args.synthetic_gns else []
+    )
+
+    runner.before_train()
+    ema, alpha = 0.0, 0.3
+    while runner.ctx.step < args.steps:
+        size, rank = kf.cluster_size(), kf.current_rank()
+        lo = ((runner.ctx.step * size + rank) * args.batch_size) % (
+            len(x) - args.batch_size
+        )
+        xb, yb = x[lo : lo + args.batch_size], y[lo : lo + args.batch_size]
+        loss, grads = loss_grad(params, (xb, yb))
+        engine = peer.engine()
+        gns_raw = 0.0
+        if engine is not None:
+            flat, spec = kf.ops.fuse(grads)
+            local = np.asarray(flat)
+            red = engine.all_reduce(local, op="mean")
+            grads = kf.ops.defuse(jnp.asarray(red), spec)
+            # the real monitor: measured every step even when the test
+            # injects a synthetic ramp below
+            gns_raw = host_noise_scale(engine, local, red, args.batch_size)
+        ema = (1 - alpha) * ema + alpha * gns_raw
+        step_gns = (
+            injected[min(runner.ctx.step, len(injected) - 1)]
+            if injected else ema
+        )
+        if engine is not None:
+            # the acted-on signal must be IDENTICAL on every rank (a
+            # joiner's step counter / fresh EMA would otherwise drive a
+            # divergent policy decision): adopt the cluster max
+            step_gns = float(
+                engine.all_reduce(
+                    np.array([step_gns], np.float64), op="max", record=False
+                )[0]
+            )
+
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+
+        prev_size = peer.size()
+        params, stop = runner.after_step(
+            params, gradient_noise_scale=step_gns
+        )
+        if stop:
+            print(f"worker {rank}: detached at step {runner.ctx.step}",
+                  flush=True)
+            return 0
+        if peer.size() != prev_size:
+            opt_state = opt.init(params)
+            print(
+                f"worker {kf.current_rank()}: GNS-resized "
+                f"{prev_size}->{peer.size()} at step {runner.ctx.step}",
+                flush=True,
+            )
+        print(
+            f"step {runner.ctx.step} rank {kf.current_rank()} size "
+            f"{peer.size()} loss {float(loss):.4f} real_gns={gns_raw:.3f} "
+            f"acted_on={step_gns:.3f}",
+            flush=True,
+        )
+    runner.after_train()
+    print(
+        f"worker {kf.current_rank()}: done size={peer.size()} "
+        f"steps={runner.ctx.step} OK",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,2 +1,3 @@
 from kungfu_tpu.datasets.adaptor import ElasticDataset  # noqa: F401
+from kungfu_tpu.datasets.cifar import load_cifar10  # noqa: F401
 from kungfu_tpu.datasets.mnist import load_mnist, synthetic_mnist  # noqa: F401

@@ -98,6 +98,28 @@ class ElasticDataset:
         self.consumed = offset + gb
         return tuple(a[sl] for a in self.arrays)
 
+    def sync_consumed(self, peer) -> int:
+        """Adopt the cluster-wide MAX consumed-samples offset (host-plane
+        allreduce).  A worker that just joined (or restarted without a
+        local checkpoint) holds offset 0 while survivors are mid-stream;
+        without this sync each rank would slice a DIFFERENT global batch
+        and the data-parallel step would silently mix sample windows.
+
+        Call it at the same engine-op sequence point on every member:
+        right after ``broadcast_parameters`` at startup, and right after
+        ``set_cluster`` in the resize branch (see
+        ``examples/cifar_elastic.py``)."""
+        engine = peer.engine()
+        if engine is not None:
+            # control-plane traffic: record=False keeps the rendezvous
+            # wait at resize boundaries out of the strategy-adaptation
+            # throughput windows
+            out = engine.all_reduce(
+                np.array([self.consumed], np.int64), op="max", record=False
+            )
+            self.skip(int(out[0]))
+        return self.consumed
+
     def epochs(self, n_epochs: int) -> Iterator[Tuple[np.ndarray, ...]]:
         """Iterate whole epochs from the current offset."""
         gb = self.global_batch

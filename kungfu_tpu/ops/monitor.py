@@ -23,6 +23,38 @@ def _sq_norm(tree):
     return sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
 
 
+def host_noise_scale(engine, local_flat, avg_flat, local_batch_size) -> float:
+    """Gradient-noise-scale estimate over the HOST collective plane (the
+    multi-process analog of :func:`global_noise_scale` — same OpenAI
+    estimator, with the cross-peer mean of the local square norms running
+    on the :class:`~kungfu_tpu.comm.engine.CollectiveEngine`).
+
+    ``local_flat``: this worker's fused local gradient (numpy);
+    ``avg_flat``: the allreduced MEAN gradient the step just applied.
+    Every worker must call this at the same step point — the inner mean
+    is a collective.  Returns the raw per-step estimate; smooth with an
+    EMA before acting on it (reference ``grad_noise_scale.py:41-88``)."""
+    import numpy as np
+
+    n = len(engine.peers)
+    b_small = float(local_batch_size)
+    b_big = b_small * n
+    g_local_sq = float(np.sum(np.square(np.asarray(local_flat, np.float64))))
+    g_local_sq = float(
+        engine.all_reduce(
+            np.array([g_local_sq], np.float64), op="mean", record=False
+        )[0]
+    )
+    g_global_sq = float(np.sum(np.square(np.asarray(avg_flat, np.float64))))
+    if n == 1:
+        # b_small == b_big: the two-batch estimator is undefined on a
+        # single worker; report 0 (callers treat <=0 as "no signal")
+        return 0.0
+    g2 = (b_big * g_global_sq - b_small * g_local_sq) / (b_big - b_small)
+    s = (g_local_sq - g_global_sq) / (1.0 / b_small - 1.0 / b_big)
+    return s / (abs(g2) + 1e-30)
+
+
 def global_noise_scale(local_grads, avg_grads, local_batch_size, axis):
     """Gradient noise scale estimate from one step.
 

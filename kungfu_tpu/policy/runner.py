@@ -36,6 +36,10 @@ class PolicyRunner:
             batch_size=batch_size,
             cluster_size=peer.size() if peer is not None else 1,
         )
+        #: resize intent awaiting the NEXT step's fused step-sync/unanimity
+        #: collective (multi-worker mode defers execution by one step so
+        #: the whole control plane costs ONE small allreduce per step)
+        self._pending_target: Optional[int] = None
 
     # -- lifecycle callbacks (reference before/after train/epoch) --------
     def before_train(self) -> None:
@@ -68,13 +72,42 @@ class PolicyRunner:
         **metrics: float,
     ) -> Tuple[object, bool]:
         """Run after each optimizer step.  Returns ``(params, stop)``;
-        ``params`` are re-broadcast from rank 0 when membership changed."""
+        ``params`` are re-broadcast from rank 0 when membership changed.
+
+        Multi-worker resize intents execute ONE STEP after the policy
+        raises them: the step-sync collective that opens each call also
+        carries the previous step's intent, fencing unanimity (divergent
+        per-rank monitor values must not let one rank start a resize the
+        others won't join — that deadlocks their consensus) without a
+        second control-plane round trip."""
         ctx = self.ctx
-        # cluster-wide step re-sync FIRST (same ordering as elastic_step:
-        # this is each step's one engine control op, and it aligns a
-        # joiner's local step 0 with the survivors before policies run)
-        if self.peer is not None:
+        agreed: Optional[int] = None
+        engine = self.peer.engine() if self.peer is not None else None
+        if engine is not None and self.peer.size() > 1:
+            # fused control op (same ordering slot as elastic_step's
+            # sync_step — each step's single engine control collective):
+            # [step, enc, -enc] under MAX gives the global step plus the
+            # unanimity check (max enc == -max(-enc) iff all ranks agree)
+            import numpy as np
+
+            enc = -1 if self._pending_target is None else int(self._pending_target)
+            out = engine.all_reduce(
+                np.array([ctx.step, enc, -enc], np.int64), op="max",
+                record=False,
+            )
+            ctx.step = int(out[0])
+            hi, lo = int(out[1]), -int(out[2])
+            if hi != lo:
+                _log.warning(
+                    "ranks disagree on the resize target (%d..%d) — "
+                    "dropping the intent", lo, hi,
+                )
+            elif hi != -1:
+                agreed = hi
+            self._pending_target = None
+        elif self.peer is not None:
             ctx.step = sync_step(self.peer, ctx.step)
+            agreed, self._pending_target = self._pending_target, None
         ctx.step += 1
         ctx.trained_samples += ctx.batch_size * ctx.cluster_size
         if gradient_noise_scale is not None:
@@ -87,13 +120,19 @@ class PolicyRunner:
             p.after_step(ctx)
 
         stop = ctx.stop_requested
-        target, ctx.requested_size, ctx.stop_requested = (
+        intent, ctx.requested_size, ctx.stop_requested = (
             ctx.requested_size, None, False,
         )
-        if target is None or self.peer is None:
+        if self.peer is None:
             return params, stop
+        # this step's intent rides the NEXT step's fused collective
+        if intent is not None:
+            self._pending_target = int(intent)
 
         peer = self.peer
+        target = agreed
+        if target is None:
+            return params, stop
         if target == peer.size():
             return params, stop
         if not peer.config.config_server:

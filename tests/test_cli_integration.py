@@ -44,6 +44,51 @@ class TestCLI:
         )
         assert r.returncode == 1
 
+    def test_gns_driven_grow_e2e(self):
+        """Round-3 VERDICT item 7: rising gradient noise scale triggers a
+        grow through monitor → policy → propose → config server → resize,
+        in one watch-mode run.  (The GNS ramp is injected via the chaos
+        knob; the acted-on pipeline and the per-step REAL estimator both
+        run.)"""
+        import re
+
+        r = run_cli(
+            ["-w", "-builtin-config-port", "9332", "-np", "1",
+             "-H", "127.0.0.1:2", "-timeout", "200", sys.executable,
+             "examples/gns_elastic.py", "--", "--steps", "10",
+             "--synthetic-gns", "24,24,24,96,96,96,96,96,96,96"]
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "GNS-resized 1->2" in r.stdout
+        done = re.findall(r"worker (\d+): done size=(\d+)", r.stdout)
+        assert len(done) == 2 and all(s == "2" for _, s in done), r.stdout
+        # the real estimator produced finite values on the 2-worker phase
+        import math
+
+        reals = [float(m) for m in re.findall(r"real_gns=([-\d.einf]+)", r.stdout)]
+        assert reals and all(math.isfinite(v) for v in reals), reals
+
+    def test_cifar_elastic_e2e(self):
+        """Loader + ElasticDataset + elastic resize in one watch-mode job
+        (round-3 VERDICT item 6): grow 1→2 mid-stream, both workers must
+        finish on the SAME global sample offset."""
+        import re
+
+        r = run_cli(
+            ["-w", "-builtin-config-port", "9331", "-np", "1",
+             "-H", "127.0.0.1:2", "-timeout", "200", sys.executable,
+             "examples/cifar_elastic.py", "--", "--schedule", "1:4,2:4"]
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        done = re.findall(
+            r"worker (\d+): done step=(\d+) resizes=(\d+) consumed=(\d+).*OK",
+            r.stdout,
+        )
+        assert len(done) == 2, r.stdout
+        consumed = {int(c) for _, _, _, c in done}
+        assert len(consumed) == 1  # the stream stayed aligned across the resize
+        assert any(int(rs) == 1 for _, _, rs, _ in done)  # survivor resized once
+
 
 class TestCLIParsing:
     def test_parser_flags(self):

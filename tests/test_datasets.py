@@ -1,6 +1,8 @@
 """Elastic dataset adaptor tests — parity with the reference's dataset
 adaptor integration test (tests/python/integration, datasets/adaptor.py)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -180,3 +182,100 @@ class TestMnistLoader:
         with pytest.raises(RuntimeError):
             load_mnist("train", cache_dir=str(tmp_path / "empty"),
                        synthetic_fallback=False, timeout=0.01)
+
+
+class TestCifar10:
+    def test_synthetic_fallback_deterministic(self, tmp_path, monkeypatch):
+        from kungfu_tpu.datasets.cifar import load_cifar10
+
+        monkeypatch.setenv("KF_DATA_DIR", str(tmp_path))
+        a = load_cifar10(timeout=0.01, n_synthetic_train=256, n_synthetic_test=64)
+        b = load_cifar10(timeout=0.01, n_synthetic_train=256, n_synthetic_test=64)
+        (xa, ya), (ta, tya) = a
+        (xb, yb), _ = b
+        assert xa.shape == (256, 32, 32, 3) and xa.dtype == np.float32
+        assert ta.shape == (64, 32, 32, 3)
+        assert ya.dtype == np.int32 and set(np.unique(ya)) <= set(range(10))
+        assert xa.min() >= 0.0 and xa.max() <= 1.0
+        np.testing.assert_array_equal(xa, xb)
+        np.testing.assert_array_equal(ya, yb)
+        # train/test draws differ
+        assert not np.array_equal(xa[:64], ta)
+
+    def test_synthetic_is_learnable(self, tmp_path, monkeypatch):
+        """Class templates must be separable enough for convergence tests."""
+        from kungfu_tpu.datasets.cifar import load_cifar10
+
+        monkeypatch.setenv("KF_DATA_DIR", str(tmp_path))
+        (x, y), _ = load_cifar10(timeout=0.01, n_synthetic_train=512)
+        x = x.reshape(len(x), -1)
+        # nearest-class-mean beats chance by a wide margin
+        means = np.stack([x[y == c].mean(0) for c in range(10)])
+        pred = np.argmin(
+            ((x[:, None, :] - means[None]) ** 2).sum(-1), axis=1
+        )
+        assert (pred == y).mean() > 0.5
+
+    def test_strict_mode_raises_without_cache(self, tmp_path, monkeypatch):
+        from kungfu_tpu.datasets.cifar import load_cifar10
+
+        monkeypatch.setenv("KF_DATA_DIR", str(tmp_path / "empty"))
+        with pytest.raises(OSError):
+            load_cifar10(synthetic_fallback=False, timeout=0.01)
+
+    def test_bad_pin_rejected(self, tmp_path, monkeypatch):
+        from kungfu_tpu.datasets import cifar
+
+        monkeypatch.setenv("KF_DATA_DIR", str(tmp_path))
+        d = cifar.data_dir()
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, cifar.ARCHIVE), "wb") as f:
+            f.write(b"not a tarball")
+        with pytest.raises(ValueError, match="sha256"):
+            load_tuple = cifar.load_cifar10(timeout=0.01)
+
+
+class TestSyncConsumed:
+    def test_joiner_adopts_survivor_offset(self):
+        import threading
+
+        from kungfu_tpu.comm.engine import CollectiveEngine
+        from kungfu_tpu.comm.host import HostChannel
+        from kungfu_tpu.datasets import ElasticDataset
+        from kungfu_tpu.plan import PeerID, PeerList, Strategy
+
+        peers = PeerList.of(PeerID("127.0.0.1", 27551), PeerID("127.0.0.1", 27552))
+        chans = [HostChannel(p, bind_host="127.0.0.1") for p in peers]
+        try:
+            engines = [CollectiveEngine(c, peers, Strategy.STAR) for c in chans]
+            x = np.arange(640, dtype=np.float32)
+            dss = [
+                ElasticDataset([x], 16, rank=i, size=2, seed=1)
+                for i in range(2)
+            ]
+            dss[0].skip(320)  # survivor is mid-stream; ds 1 is a fresh joiner
+
+            class FakePeer:
+                def __init__(self, e):
+                    self._e = e
+
+                def engine(self):
+                    return self._e
+
+            outs = [None, None]
+
+            def run(i):
+                outs[i] = dss[i].sync_consumed(FakePeer(engines[i]))
+
+            ts = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(60)
+            assert outs == [320, 320]
+            assert dss[1].consumed == 320
+        finally:
+            for e in engines:
+                e.close()
+            for c in chans:
+                c.close()
