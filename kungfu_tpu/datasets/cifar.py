@@ -44,10 +44,9 @@ IMAGE_SHAPE = (32, 32, 3)
 
 
 def data_dir() -> str:
-    base = os.environ.get(DATA_DIR_ENV) or os.path.join(
-        os.path.expanduser("~"), ".cache", "kungfu_tpu"
-    )
-    return os.path.join(base, "cifar10")
+    from kungfu_tpu.datasets.cache import cache_dir
+
+    return cache_dir("cifar10")
 
 
 def _sha256(path: str) -> str:

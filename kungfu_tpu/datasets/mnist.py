@@ -56,10 +56,9 @@ def _sha256(path: str) -> str:
 
 
 def data_dir() -> str:
-    base = os.environ.get(DATA_DIR_ENV) or os.path.join(
-        os.path.expanduser("~"), ".cache", "kungfu_tpu"
-    )
-    return os.path.join(base, "mnist")
+    from kungfu_tpu.datasets.cache import cache_dir
+
+    return cache_dir("mnist")
 
 
 def _fetch(name: str, dest: str, timeout: float) -> bool:

@@ -6,6 +6,13 @@ broadcast_parameters, ``collective.py:40-45``) and the async-handle flow of
 ``wait_all_handles``), here staged through a thread pool instead of CUDA
 streams.
 
+Scope (set expectations before reaching for this module): **torch rides
+the HOST plane** — CPU tensors over the TCP/unix-socket engine, matching
+the reference's CPU path and suitable for CPU clusters and tests.  The
+TPU device plane (ICI/XLA collectives) is the jax path
+(:mod:`kungfu_tpu.ops` / :mod:`kungfu_tpu.comm.device`); there is no
+torch-on-TPU data path here.
+
 All functions take an optional ``engine``; by default they use the global
 peer's engine (``kungfu_tpu.python``).  In single-process mode (no engine)
 every collective is the identity, so scripts run unchanged under
