@@ -36,6 +36,11 @@ def main() -> int:
                     help="active cluster size per epoch (config version e = epoch e)")
     ap.add_argument("--timeout", type=float, default=120.0,
                     help="per-wait timeout seconds")
+    ap.add_argument("--train", action="store_true",
+                    help="run REAL dp training steps on each mesh epoch "
+                         "(S-SGD over the re-carved Communicator), carrying "
+                         "the model across resizes")
+    ap.add_argument("--steps-per-epoch", type=int, default=2)
     ns = ap.parse_args()
     schedule = [int(s) for s in ns.schedule.split(",")]
     shutdown_version = len(schedule)
@@ -50,6 +55,54 @@ def main() -> int:
         return 2
     my_world_rank = world.rank(peer.config.self_id)
     deadline = time.time() + ns.timeout * max(len(schedule), 1)
+
+    params = opt = None
+    if ns.train:
+        import jax
+        import optax
+
+        from kungfu_tpu.models import mnist_slp
+        from kungfu_tpu.optimizers import synchronous_sgd
+
+        model = mnist_slp()
+        params = model.init(jax.random.PRNGKey(1))  # same init on all slots
+        opt = optax.sgd(0.1)
+
+    def train_epoch(comm, v):
+        """A few real S-SGD steps over THIS mesh epoch; params survive the
+        epoch transitions.  Epoch entry does the reference's post-resize
+        state re-sync: host-plane broadcast from rank 0 (joiners adopt the
+        survivors' weights), then an explicit re-placement onto the NEW
+        mesh epoch (arrays stay committed to the old epoch's devices and
+        jit rejects the mismatch otherwise)."""
+        import jax
+        import jax.numpy as jnp
+
+        from kungfu_tpu.initializer import broadcast_parameters
+        from kungfu_tpu.parallel.train import dp_train_step
+
+        nonlocal params
+        params = broadcast_parameters(params, peer)
+        sh = comm.replicated_sharding()
+        params = jax.tree_util.tree_map(
+            lambda a: jax.device_put(np.asarray(a), sh), params
+        )
+        tx = synchronous_sgd(opt, comm.axis)
+        step = dp_train_step(
+            lambda p, b: model.loss(p, b), tx, comm
+        )
+        opt_state = tx.init(params)
+        # FIXED seed: every epoch replays the same global batch sequence,
+        # so a changing loss across epochs proves the weights carried over
+        # (a silent re-init would repeat epoch 0's loss exactly)
+        rng = np.random.default_rng(1000)
+        gb = 8 * comm.size
+        loss = None
+        for _ in range(ns.steps_per_epoch):
+            xb = jnp.asarray(rng.normal(size=(gb, 784)), jnp.float32)
+            yb = jnp.asarray(rng.integers(0, 10, gb), jnp.int32)
+            params, opt_state, loss = step(params, opt_state, (xb, yb))
+        return float(loss)
 
     try:
         while time.time() < deadline:
@@ -74,14 +127,25 @@ def main() -> int:
             x = np.full((comm.addressable_n,), float(my_world_rank + 1), np.float32)
             got = float(np.asarray(comm.all_reduce(x)).ravel()[0])
             expect = float(sum(world.rank(w) + 1 for w in peer.cluster.workers))
+            if got != expect:
+                # fast-fail BEFORE training: a membership inconsistency
+                # would hang the training collectives until the harness
+                # timeout instead of exiting cleanly
+                print(
+                    f"KFEPOCH v={v} size={peer.size()} rank={peer.rank()} "
+                    f"world_rank={my_world_rank} psum={got} expect={expect} "
+                    f"pid={os.getpid()} ok=False",
+                    flush=True,
+                )
+                return 1
+            loss = train_epoch(comm, v) if ns.train else None
             print(
                 f"KFEPOCH v={v} size={peer.size()} rank={peer.rank()} "
                 f"world_rank={my_world_rank} psum={got} expect={expect} "
-                f"pid={os.getpid()} ok={got == expect}",
+                f"pid={os.getpid()} ok=True"
+                + (f" loss={loss:.4f}" if loss is not None else ""),
                 flush=True,
             )
-            if got != expect:
-                return 1
 
             if v + 1 < len(schedule):
                 if peer.rank() == 0:

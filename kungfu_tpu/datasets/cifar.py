@@ -28,7 +28,7 @@ from kungfu_tpu.utils.log import get_logger
 
 _log = get_logger("cifar")
 
-DATA_DIR_ENV = "KF_DATA_DIR"
+from kungfu_tpu.datasets.cache import DATA_DIR_ENV  # noqa: F401
 
 ARCHIVE = "cifar-10-python.tar.gz"
 #: canonical archive digest (stable since 2009)

@@ -31,7 +31,7 @@ from kungfu_tpu.utils.log import get_logger
 
 _log = get_logger("mnist")
 
-DATA_DIR_ENV = "KF_DATA_DIR"
+from kungfu_tpu.datasets.cache import DATA_DIR_ENV  # noqa: F401
 
 # canonical gzipped IDX files and their SHA-256 digests (stable since 1998)
 FILES = {
