@@ -42,6 +42,8 @@ def main() -> int:
                          "the model across resizes")
     ap.add_argument("--steps-per-epoch", type=int, default=2)
     ns = ap.parse_args()
+    if ns.steps_per_epoch < 1:
+        ap.error("--steps-per-epoch must be >= 1")
     schedule = [int(s) for s in ns.schedule.split(",")]
     shutdown_version = len(schedule)
 
@@ -127,25 +129,20 @@ def main() -> int:
             x = np.full((comm.addressable_n,), float(my_world_rank + 1), np.float32)
             got = float(np.asarray(comm.all_reduce(x)).ravel()[0])
             expect = float(sum(world.rank(w) + 1 for w in peer.cluster.workers))
-            if got != expect:
-                # fast-fail BEFORE training: a membership inconsistency
-                # would hang the training collectives until the harness
-                # timeout instead of exiting cleanly
-                print(
-                    f"KFEPOCH v={v} size={peer.size()} rank={peer.rank()} "
-                    f"world_rank={my_world_rank} psum={got} expect={expect} "
-                    f"pid={os.getpid()} ok=False",
-                    flush=True,
-                )
-                return 1
-            loss = train_epoch(comm, v) if ns.train else None
+            # fast-fail BEFORE training on a membership inconsistency — it
+            # would hang the training collectives until the harness timeout
+            ok = got == expect
+            loss = train_epoch(comm, v) if (ns.train and ok) else None
             print(
                 f"KFEPOCH v={v} size={peer.size()} rank={peer.rank()} "
                 f"world_rank={my_world_rank} psum={got} expect={expect} "
-                f"pid={os.getpid()} ok=True"
-                + (f" loss={loss:.4f}" if loss is not None else ""),
+                f"pid={os.getpid()} ok={ok}"
+                # full precision: replica-sync checks compare these exactly
+                + (f" loss={loss:.17g}" if loss is not None else ""),
                 flush=True,
             )
+            if not ok:
+                return 1
 
             if v + 1 < len(schedule):
                 if peer.rank() == 0:

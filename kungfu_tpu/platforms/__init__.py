@@ -1,0 +1,1 @@
+from kungfu_tpu.platforms.tpu_pod import PodInfo, parse_tpu_pod_env  # noqa: F401

@@ -1,0 +1,103 @@
+"""TPU pod/multislice environment adapter.
+
+The platform-adapter slot of the reference's cloud integration
+(``srcs/go/platforms/modelarts/modelarts.go:1`` — read the scheduler's
+env contract, produce self identity + the peer list) — re-targeted at
+the platform this framework actually runs on: GKE/GCE TPU pods.  The
+TPU runtime/scheduler publishes:
+
+=============================  =========================================
+``TPU_WORKER_HOSTNAMES``       comma-separated host list, rank order
+``TPU_WORKER_ID``              this host's index in that list
+``MEGASCALE_COORDINATOR_ADDRESS``  multislice coordinator (slice 0 host 0)
+``MEGASCALE_SLICE_ID`` /
+``MEGASCALE_NUM_SLICES``       multislice identity (optional)
+=============================  =========================================
+
+``parse_tpu_pod_env`` turns that contract into the launcher's inputs — a
+:class:`~kungfu_tpu.plan.hostspec.HostList` (one worker slot per host:
+one jax process drives all local chips), this runner's self host, and
+the coordinator — so ``kfrun -platform tpu-pod`` needs no ``-H``/
+``-self`` flags inside a pod.  Mirrors the reference's validation: both
+identity envs required, index bounds checked.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+from kungfu_tpu.plan.hostspec import HostList
+from kungfu_tpu.utils.log import get_logger
+
+_log = get_logger("tpu-pod")
+
+WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+WORKER_ID = "TPU_WORKER_ID"
+MEGASCALE_COORDINATOR = "MEGASCALE_COORDINATOR_ADDRESS"
+MEGASCALE_SLICE_ID = "MEGASCALE_SLICE_ID"
+MEGASCALE_NUM_SLICES = "MEGASCALE_NUM_SLICES"
+
+
+@dataclass(frozen=True)
+class PodInfo:
+    hosts: HostList          #: one slot per pod host, scheduler rank order
+    self_host: str           #: this runner's host
+    worker_id: int
+    coordinator: str = ""    #: multislice coordinator addr ("" = single slice)
+    slice_id: int = 0
+    num_slices: int = 1
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.hosts)
+
+
+def detected(env=None) -> bool:
+    env = env if env is not None else os.environ
+    return bool(env.get(WORKER_HOSTNAMES))
+
+
+def parse_tpu_pod_env(env=None, slots_per_host: int = 1) -> Optional[PodInfo]:
+    """Parse the pod contract; None when not running inside a TPU pod.
+    Raises on a malformed contract (set but inconsistent), like the
+    reference adapter."""
+    env = env if env is not None else os.environ
+    hostnames = env.get(WORKER_HOSTNAMES, "").strip()
+    if not hostnames:
+        return None
+    names = [h.strip() for h in hostnames.split(",") if h.strip()]
+    if not names:
+        raise ValueError(f"{WORKER_HOSTNAMES} is set but empty")
+    wid_s = env.get(WORKER_ID, "").strip()
+    if not wid_s:
+        if len(names) == 1:
+            wid = 0  # single-host pod: the id env is often omitted
+        else:
+            raise ValueError(
+                f"{WORKER_ID} not set but {WORKER_HOSTNAMES} lists "
+                f"{len(names)} hosts"
+            )
+    else:
+        wid = int(wid_s)
+    if not 0 <= wid < len(names):
+        raise ValueError(
+            f"{WORKER_ID}={wid} outside the {len(names)}-host list"
+        )
+    hosts = HostList.parse(
+        ",".join(f"{n}:{slots_per_host}" for n in names)
+    )
+    info = PodInfo(
+        hosts=hosts,
+        self_host=names[wid],
+        worker_id=wid,
+        coordinator=env.get(MEGASCALE_COORDINATOR, "").strip(),
+        slice_id=int(env.get(MEGASCALE_SLICE_ID, "0") or 0),
+        num_slices=int(env.get(MEGASCALE_NUM_SLICES, "1") or 1),
+    )
+    _log.info(
+        "TPU pod: %d hosts, self=%s (id %d), slice %d/%d",
+        info.num_hosts, info.self_host, wid, info.slice_id, info.num_slices,
+    )
+    return info

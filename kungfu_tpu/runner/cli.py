@@ -58,8 +58,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-logdir", default="")
     p.add_argument("-q", dest="quiet", action="store_true", help="suppress worker output")
     p.add_argument("-timeout", type=float, default=0.0, help="job timeout seconds (0 = none)")
-    p.add_argument("-backend", default="cpu", choices=["cpu", "tpu"],
-                   help="worker device backend (cpu = multi-process test cluster)")
+    p.add_argument("-backend", default=None, choices=["cpu", "tpu"],
+                   help="worker device backend (default cpu = multi-process "
+                        "test cluster; a detected cloud platform may set tpu)")
+    p.add_argument("-platform", default="auto", choices=["auto", "none", "tpu-pod"],
+                   help="cloud platform adapter: derive -H/-self/-backend from "
+                        "the scheduler's env (TPU_WORKER_HOSTNAMES et al.); "
+                        "'auto' uses it only when detected AND no -H given")
     p.add_argument("-n-epochs-flag", dest="n_epochs_flag", default="--n-epochs",
                    help="worker flag patched on auto-recovery restart")
     p.add_argument("prog", help="worker program")
@@ -105,8 +110,54 @@ def simple_run(ns, cluster: Cluster, job: Job) -> int:
     return 0
 
 
+def apply_platform(ns) -> None:
+    """Fill -H/-self/-backend from a detected cloud platform contract
+    (reference ``platforms/modelarts`` analog, TPU-pod flavored).
+
+    ``auto`` applies only when the user gave NO topology (-H/-hostfile)
+    and NO explicit -backend — any explicit flag opts out of the magic.
+    ``tpu-pod`` (forced) lets the pod contract win outright."""
+    if ns.platform == "none":
+        return
+    from kungfu_tpu.platforms import parse_tpu_pod_env
+
+    if ns.platform == "auto" and (
+        ns.hosts or ns.hostfile or ns.backend is not None
+    ):
+        return  # any explicit choice wins over detection
+    info = parse_tpu_pod_env()
+    if info is None:
+        if ns.platform == "tpu-pod":
+            raise SystemExit(
+                "kfrun: -platform tpu-pod but TPU_WORKER_HOSTNAMES is not set"
+            )
+        return
+    ns.hosts = str(info.hosts)
+    ns.hostfile = ""  # the pod contract IS the topology
+    ns.self_host = info.self_host
+    ns.backend = "tpu"
+    if ns.np <= 1:
+        ns.np = info.num_hosts
+    if info.num_slices > 1:
+        # cross-slice (DCN) device coordination is libtpu's: the
+        # MEGASCALE_* envs pass through to the workers via the inherited
+        # environment; this launcher only handles the per-slice topology
+        _log.info(
+            "multislice pod (slice %d/%d, coordinator %s): MEGASCALE envs "
+            "pass through to workers", info.slice_id, info.num_slices,
+            info.coordinator or "?",
+        )
+    _log.info(
+        "platform tpu-pod: -H %s -self %s (np=%d)",
+        ns.hosts, ns.self_host, ns.np,
+    )
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ns = build_parser().parse_args(argv)
+    apply_platform(ns)
+    if ns.backend is None:
+        ns.backend = "cpu"
     strategy = parse_strategy(ns.strategy)
     cluster = build_cluster(ns)
 

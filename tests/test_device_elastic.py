@@ -191,7 +191,7 @@ class TestLiveResize:
                 lines += fh.read().splitlines()
         losses = {}
         for ln in lines:
-            m = re.match(r"KFEPOCH v=(\d+) .*ok=True loss=([\d.]+)", ln)
+            m = re.match(r"KFEPOCH v=(\d+) .*ok=True loss=([\d.eE+-]+)", ln)
             if m:
                 losses.setdefault(int(m.group(1)), []).append(m.group(2))
         assert sorted(losses) == [0, 1, 2], lines

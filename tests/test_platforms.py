@@ -1,0 +1,90 @@
+"""TPU-pod platform adapter (the reference's modelarts-adapter slot,
+``platforms/modelarts/modelarts.go`` — scheduler env → cluster inputs)."""
+
+import pytest
+
+from kungfu_tpu.platforms import parse_tpu_pod_env
+from kungfu_tpu.platforms.tpu_pod import detected
+
+
+class TestParse:
+    def test_not_a_pod(self):
+        assert parse_tpu_pod_env(env={}) is None
+        assert not detected(env={})
+
+    def test_four_host_pod(self):
+        env = {
+            "TPU_WORKER_HOSTNAMES": "t1k-0,t1k-1,t1k-2,t1k-3",
+            "TPU_WORKER_ID": "2",
+        }
+        info = parse_tpu_pod_env(env=env)
+        assert info.num_hosts == 4
+        assert info.self_host == "t1k-2" and info.worker_id == 2
+        assert info.num_slices == 1 and info.coordinator == ""
+        assert [h.ip for h in info.hosts.hosts] == ["t1k-0", "t1k-1", "t1k-2", "t1k-3"]
+        assert all(h.slots == 1 for h in info.hosts.hosts)
+
+    def test_multislice(self):
+        env = {
+            "TPU_WORKER_HOSTNAMES": "a,b",
+            "TPU_WORKER_ID": "0",
+            "MEGASCALE_COORDINATOR_ADDRESS": "a:8476",
+            "MEGASCALE_SLICE_ID": "1",
+            "MEGASCALE_NUM_SLICES": "4",
+        }
+        info = parse_tpu_pod_env(env=env)
+        assert info.coordinator == "a:8476"
+        assert info.slice_id == 1 and info.num_slices == 4
+
+    def test_single_host_id_optional(self):
+        info = parse_tpu_pod_env(env={"TPU_WORKER_HOSTNAMES": "solo"})
+        assert info.worker_id == 0 and info.self_host == "solo"
+
+    def test_missing_id_multi_host_raises(self):
+        with pytest.raises(ValueError, match="TPU_WORKER_ID"):
+            parse_tpu_pod_env(env={"TPU_WORKER_HOSTNAMES": "a,b"})
+
+    def test_out_of_range_id_raises(self):
+        with pytest.raises(ValueError, match="outside"):
+            parse_tpu_pod_env(
+                env={"TPU_WORKER_HOSTNAMES": "a,b", "TPU_WORKER_ID": "2"}
+            )
+
+
+class TestCliWiring:
+    def test_platform_fills_topology(self, monkeypatch):
+        from kungfu_tpu.runner.cli import apply_platform, build_cluster, build_parser
+
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+        monkeypatch.setenv("TPU_WORKER_ID", "1")
+        ns = build_parser().parse_args(["-platform", "tpu-pod", "prog"])
+        apply_platform(ns)
+        assert ns.self_host == "h1" and ns.backend == "tpu" and ns.np == 2
+        cluster = build_cluster(ns)
+        assert cluster.size() == 2
+        assert {w.host for w in cluster.workers} == {"h0", "h1"}
+
+    def test_explicit_hosts_win_in_auto(self, monkeypatch):
+        from kungfu_tpu.runner.cli import apply_platform, build_parser
+
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+        monkeypatch.setenv("TPU_WORKER_ID", "0")
+        ns = build_parser().parse_args(["-np", "2", "-H", "127.0.0.1:2", "prog"])
+        apply_platform(ns)
+        assert ns.hosts == "127.0.0.1:2" and ns.self_host == "127.0.0.1"
+
+    def test_forced_platform_without_env_exits(self, monkeypatch):
+        from kungfu_tpu.runner.cli import apply_platform, build_parser
+
+        monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+        ns = build_parser().parse_args(["-platform", "tpu-pod", "prog"])
+        with pytest.raises(SystemExit):
+            apply_platform(ns)
+
+    def test_platform_none_ignores_env(self, monkeypatch):
+        from kungfu_tpu.runner.cli import apply_platform, build_parser
+
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+        ns = build_parser().parse_args(["-platform", "none", "prog"])
+        apply_platform(ns)
+        assert ns.hosts == "" and ns.self_host == "127.0.0.1"
