@@ -46,6 +46,10 @@ def get_peer_latencies(peer, samples: int = 1) -> List[float]:
             if channel.ping(target, timeout=5.0):
                 dt = time.perf_counter() - t0
                 best = dt if best is None else min(best, dt)
+            elif best is None:
+                # first ping already timed out: the peer is down, don't
+                # stack `samples` full timeouts before reporting +inf
+                break
         out.append(best if best is not None else float("inf"))
     return out
 

@@ -120,7 +120,11 @@ class AdaptiveStrategyDriver:
 
     def _swap(self, engine) -> None:
         if self.use_mst:
-            forest = minimum_spanning_tree_from_latencies(self.peer)
+            # min-of-3 pings per edge: one sample is corruptible by a
+            # scheduler spike on a loaded box (observed: a 30 ms-throttled
+            # edge beaten by a GIL stall on a fast edge, MST kept the slow
+            # link); min() filters spikes but keeps any real injected floor
+            forest = minimum_spanning_tree_from_latencies(self.peer, samples=3)
             # latency matrix is allgathered -> identical on all ranks ->
             # identical MST; peer.set_tree does consensus + barrier fencing
             self.peer.set_tree(forest)

@@ -51,6 +51,10 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 _NEG_INF = -1e30
+#: per-row scalars (lse, delta) cross the pallas_call boundary replicated
+#: across one full lane width — Mosaic's tiling only accepts (8k, 128)
+#: tiles, so a bare row vector is not a legal block shape on TPU
+_LANES = 128
 
 
 def _causal_hi(qi, block_q, block_k):
@@ -109,8 +113,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, m_s, l_s, acc_s, *,
     def _():
         l_safe = jnp.maximum(l_s[:], 1e-30)
         o_ref[0] = (acc_s[:] / l_safe).astype(o_ref.dtype)
-        # logsumexp rows, saved for the backward recomputation
-        l_ref[0] = (m_s[:] + jnp.log(l_safe))[:, 0]
+        # logsumexp rows, saved for the backward recomputation.  Stored
+        # lane-replicated [block_q, LANES]: Mosaic requires output tiles
+        # whose last two dims are (8k, 128) — a [block_q] row vector is
+        # not a legal tile, a lane-broadcast one is
+        l_ref[0] = jnp.broadcast_to(
+            m_s[:] + jnp.log(l_safe), (l_ref.shape[1], l_ref.shape[2])
+        )
 
 
 def _fwd_call(q, k, v, causal, block_q, block_k, interpret):
@@ -151,11 +160,11 @@ def _fwd_call(q, k, v, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s_pad, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s_pad), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s_pad, _LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),   # running max m
@@ -167,7 +176,7 @@ def _fwd_call(q, k, v, causal, block_q, block_k, interpret):
         ),
         interpret=interpret,
     )(q, k, v)
-    return out[:, :s], lse[:, :s]
+    return out[:, :s], lse[:, :s, 0]
 
 
 def _bwd_blocked(q, k, v, out, lse, dout, causal, block_k):
@@ -236,8 +245,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         kb = k_ref[0]                                  # [bk, D]
         vb = v_ref[0]
         do = do_ref[0].astype(jnp.float32)             # [bq, D]
-        lse = lse_ref[0][:, None]                      # [bq, 1]
-        delta = delta_ref[0][:, None]                  # [bq, 1]
+        lse = lse_ref[0][:, :1]                        # [bq, 1] (lane 0)
+        delta = delta_ref[0][:, :1]                    # [bq, 1]
         s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32)
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0
@@ -286,8 +295,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         kb = k_ref[0]                                   # [bk, D]
         vb = v_ref[0]
         do = do_ref[0].astype(jnp.float32)              # [bq, D]
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0][:, :1]                         # [bq, 1] (lane 0)
+        delta = delta_ref[0][:, :1]
         s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32)
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0
@@ -338,8 +347,12 @@ def _bwd_pallas(q, k, v, out, lse, dout, causal, block_q, block_k, interpret):
     n_q = s_pad // block_q
     n_k = s_pad // block_k
 
+    # per-row scalars enter the kernels lane-replicated (see _LANES)
+    lse = jnp.broadcast_to(lse[..., None], (bh, s_pad, _LANES))
+    delta = jnp.broadcast_to(delta[..., None], (bh, s_pad, _LANES))
+
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
-    row_spec = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))
+    row_spec = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0))
     if causal:
         def kv_index(b, i, j):
             return (b, jnp.minimum(j, _causal_hi(i, block_q, block_k)), 0)
@@ -370,15 +383,10 @@ def _bwd_pallas(q, k, v, out, lse, dout, causal, block_q, block_k, interpret):
     if causal:
         def q_index(b, j, i):
             return (b, jnp.maximum(i, jax.lax.div(j * block_k, block_q)), 0)
-
-        def qrow_index(b, j, i):
-            return (b, jnp.maximum(i, jax.lax.div(j * block_k, block_q)))
     else:
         def q_index(b, j, i):
             return (b, i, 0)
-
-        def qrow_index(b, j, i):
-            return (b, i)
+    qrow_index = q_index
 
     dk, dv = pl.pallas_call(
         functools.partial(
@@ -391,8 +399,8 @@ def _bwd_pallas(q, k, v, out, lse, dout, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), q_index),
-            pl.BlockSpec((1, block_q), qrow_index),
-            pl.BlockSpec((1, block_q), qrow_index),
+            pl.BlockSpec((1, block_q, _LANES), qrow_index),
+            pl.BlockSpec((1, block_q, _LANES), qrow_index),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),

@@ -39,7 +39,9 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="kfrun", description="launch kungfu_tpu workers"
     )
-    p.add_argument("-np", type=int, default=1, help="total number of workers")
+    p.add_argument("-np", type=int, default=None,
+                   help="total number of workers (default 1; on a detected "
+                        "TPU pod, one per pod host)")
     p.add_argument("-H", dest="hosts", default="", help="host spec list ip:slots,...")
     p.add_argument("-hostfile", default="", help="MPI-style hostfile")
     p.add_argument("-self", dest="self_host", default="127.0.0.1", help="this runner's host ip")
@@ -82,14 +84,14 @@ def build_hostlist(ns) -> HostList:
         return parse_hostfile(ns.hostfile)
     if ns.hosts:
         return HostList.parse(ns.hosts)
-    return HostList.parse(f"{ns.self_host}:{max(ns.np, 1)}")
+    return HostList.parse(f"{ns.self_host}:{max(ns.np or 1, 1)}")
 
 
 def build_cluster(ns) -> Cluster:
     hl = build_hostlist(ns)
     return Cluster(
         hl.gen_runner_list(DEFAULT_RUNNER_PORT),
-        hl.gen_peer_list(ns.np, parse_port_range(ns.port_range)),
+        hl.gen_peer_list(ns.np or 1, parse_port_range(ns.port_range)),
     )
 
 
@@ -132,11 +134,30 @@ def apply_platform(ns) -> None:
                 "kfrun: -platform tpu-pod but TPU_WORKER_HOSTNAMES is not set"
             )
         return
+    if ns.np is not None and ns.np > info.num_hosts:
+        if ns.platform == "tpu-pod":
+            raise SystemExit(
+                f"kfrun: -np {ns.np} exceeds the detected TPU pod's "
+                f"capacity ({info.num_hosts} hosts, 1 worker slot each)"
+            )
+        # auto mode: an explicit -np the pod can't host (1 slot/host)
+        # means the user wants a local multi-process cluster, not the pod
+        # topology — e.g. CPU-backend test runs on a TPU VM whose env
+        # still carries the pod contract
+        _log.info(
+            "platform auto: detected TPU pod (%d hosts) cannot host "
+            "-np %d; keeping the default localhost cluster",
+            info.num_hosts, ns.np,
+        )
+        return
     ns.hosts = str(info.hosts)
     ns.hostfile = ""  # the pod contract IS the topology
     ns.self_host = info.self_host
     ns.backend = "tpu"
-    if ns.np <= 1:
+    if ns.np is None:
+        # only the DEFAULT np expands to the whole pod; an explicit
+        # `-np 1` (distinguishable now that the argparse default is
+        # None) keeps its single worker
         ns.np = info.num_hosts
     if info.num_slices > 1:
         # cross-slice (DCN) device coordination is libtpu's: the
@@ -156,6 +177,8 @@ def apply_platform(ns) -> None:
 def main(argv: Optional[List[str]] = None) -> int:
     ns = build_parser().parse_args(argv)
     apply_platform(ns)
+    if ns.np is None:
+        ns.np = 1
     if ns.backend is None:
         ns.backend = "cpu"
     strategy = parse_strategy(ns.strategy)
