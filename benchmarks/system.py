@@ -28,28 +28,37 @@ import numpy as np
 import optax
 
 
+def _image_classifier(model, quick: bool):
+    """Shared harness for the ImageNet-shaped families (resnet50/vgg16)."""
+    img = 64 if quick else 224
+
+    def make_batch(rng, batch):
+        x = rng.standard_normal((batch, img, img, 3)).astype(np.float32)
+        y = rng.integers(0, 1000, size=(batch,))
+        return jnp.asarray(x), jnp.asarray(y)
+
+    # BN running stats ride in the tree with zero grads (train mode
+    # uses batch stats); their EMA update is skipped — irrelevant to
+    # a throughput measurement, keeps the loss a pure fn of (tree, batch)
+    def loss_fn(tree, batch):
+        x, y = batch
+        logits, _ = model.apply(tree["params"], tree["bn"], x, train=True)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+    params, bn = model.init(jax.random.PRNGKey(0))
+    return {"params": params, "bn": bn}, loss_fn, make_batch
+
+
 def build_model(name: str, quick: bool):
     if name == "resnet50":
         from kungfu_tpu.models.resnet import ResNet
 
-        img = 64 if quick else 224
-        model = ResNet(depth=50, num_classes=1000)
+        return _image_classifier(ResNet(depth=50, num_classes=1000), quick)
 
-        def make_batch(rng, batch):
-            x = rng.standard_normal((batch, img, img, 3)).astype(np.float32)
-            y = rng.integers(0, 1000, size=(batch,))
-            return jnp.asarray(x), jnp.asarray(y)
+    if name == "vgg16":
+        from kungfu_tpu.models.vgg import VGG
 
-        # BN running stats ride in the tree with zero grads (train mode
-        # uses batch stats); their EMA update is skipped — irrelevant to
-        # a throughput measurement, keeps the loss a pure fn of (tree, batch)
-        def loss_fn(tree, batch):
-            x, y = batch
-            logits, _ = model.apply(tree["params"], tree["bn"], x, train=True)
-            return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
-
-        params, bn = model.init(jax.random.PRNGKey(0))
-        return {"params": params, "bn": bn}, loss_fn, make_batch
+        return _image_classifier(VGG(depth=16, num_classes=1000), quick)
 
     if name == "transformer":
         from kungfu_tpu.models.transformer import Transformer, TransformerConfig
@@ -101,7 +110,7 @@ def build_optimizer(name: str, axis, batch: int):
 def main(argv=None) -> dict:
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="resnet50",
-                   choices=["resnet50", "transformer"])
+                   choices=["resnet50", "vgg16", "transformer"])
     p.add_argument("--optimizer", default="sync-sgd",
                    choices=["sync-sgd", "sma", "gns", "variance"])
     p.add_argument("--batch-size", type=int, default=0, help="per-device")

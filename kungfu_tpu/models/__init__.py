@@ -12,6 +12,8 @@ models because they are its benchmark workload:
 * :mod:`kungfu_tpu.models.mlp` — MNIST SLP/MLP (the reference's minimum
   end-to-end example, ``examples/tf1_mnist_session.py``).
 * :mod:`kungfu_tpu.models.resnet` — ResNet-50 (v1.5), NHWC, bf16 compute.
+* :mod:`kungfu_tpu.models.vgg` — VGG-16 (the reference benchmark trio's
+  second ImageNet family), NHWC, bf16, optional sync-BN.
 * :mod:`kungfu_tpu.models.transformer` — GPT-style transformer (the
   flagship; BERT-base-sized config included), ring-attention capable.
 * :mod:`kungfu_tpu.models.fake` — gradient-shaped fake models for
@@ -23,6 +25,7 @@ from kungfu_tpu.models import nn
 from kungfu_tpu.models.mlp import MLP, mnist_slp
 from kungfu_tpu.models.resnet import ResNet, resnet50
 from kungfu_tpu.models.transformer import Transformer, TransformerConfig, bert_base, gpt_small
+from kungfu_tpu.models.vgg import VGG, vgg16
 from kungfu_tpu.models.fake import fake_model_sizes, fake_grads
 
 __all__ = [
@@ -31,6 +34,8 @@ __all__ = [
     "mnist_slp",
     "ResNet",
     "resnet50",
+    "VGG",
+    "vgg16",
     "Transformer",
     "TransformerConfig",
     "bert_base",

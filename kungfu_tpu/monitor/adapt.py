@@ -40,16 +40,20 @@ def get_peer_latencies(peer, samples: int = 1) -> List[float]:
         if channel is None or target == peer.config.self_id:
             out.append(0.0)
             continue
-        best = None
+        best, fails = None, 0
         for _ in range(samples):
             t0 = time.perf_counter()
             if channel.ping(target, timeout=5.0):
                 dt = time.perf_counter() - t0
                 best = dt if best is None else min(best, dt)
-            elif best is None:
-                # first ping already timed out: the peer is down, don't
-                # stack `samples` full timeouts before reporting +inf
-                break
+            else:
+                fails += 1
+                # two consecutive timeouts with no success: the peer is
+                # down — don't stack all `samples` timeouts before
+                # reporting +inf.  (One timeout alone can be a stall on a
+                # live peer, which is exactly what min-of-N filters.)
+                if best is None and fails >= 2:
+                    break
         out.append(best if best is not None else float("inf"))
     return out
 

@@ -10,6 +10,7 @@ from kungfu_tpu.models import (
     ResNet,
     Transformer,
     TransformerConfig,
+    VGG,
     fake_grads,
     fake_model_sizes,
     mnist_slp,
@@ -71,6 +72,39 @@ class TestResNet:
         params, _ = m.init(jax.random.PRNGKey(0))
         n = nn.num_params(params)
         assert 25.4e6 < n < 25.8e6, n  # ~25.56M
+
+
+class TestVGG:
+    def test_tiny_forward_backward(self):
+        m = VGG(11, num_classes=10, hidden=64)
+        params, state = m.init(jax.random.PRNGKey(0))
+        x = np.random.RandomState(0).rand(2, 32, 32, 3).astype(np.float32)
+        y = np.array([1, 2])
+        (loss, new_state), grads = jax.value_and_grad(m.loss, has_aux=True)(
+            params, state, (x, y), train=True, dtype=jnp.float32
+        )
+        assert np.isfinite(float(loss))
+        assert not np.allclose(
+            np.asarray(new_state["conv0_bn"]["mean"]),
+            np.asarray(state["conv0_bn"]["mean"]),
+        )
+        logits, _ = m.apply(params, state, x, train=False, dtype=jnp.float32)
+        assert logits.shape == (2, 10)
+
+    def test_no_bn_variant(self):
+        m = VGG(11, num_classes=10, batch_norm=False, hidden=64)
+        params, state = m.init(jax.random.PRNGKey(0))
+        assert state == {}
+        x = np.random.RandomState(0).rand(2, 32, 32, 3).astype(np.float32)
+        logits, ns = m.apply(params, state, x, dtype=jnp.float32)
+        assert logits.shape == (2, 10) and ns == {}
+
+    def test_vgg16_param_count(self):
+        m = VGG(16, num_classes=1000)
+        params, _ = m.init(jax.random.PRNGKey(0))
+        n = nn.num_params(params)
+        # 14.71M conv + 2.10M fc1 + 4.10M head + BN affine (~8.5k x2)
+        assert 20.5e6 < n < 21.5e6, n
 
 
 class TestTransformer:
