@@ -388,6 +388,14 @@ def payload_kernels(args) -> dict:
 def payload_allreduce(args) -> dict:
     """Device-plane allreduce bus bandwidth (the headline comm number)."""
     import jax
+
+    if args.cpu_mesh:
+        # a virtual N-device CPU mesh: the same shard_map/psum collective
+        # code path the TPU runs, minus the ICI (scaling-shape artifact,
+        # not a bandwidth claim).  Must precede any backend init.
+        jax.config.update("jax_num_cpu_devices", args.cpu_mesh)
+        jax.config.update("jax_platforms", "cpu")
+
     import jax.numpy as jnp
     import numpy as np
 
@@ -457,6 +465,9 @@ def main() -> None:
     p.add_argument("--seq-len", type=int, default=2048)
     p.add_argument("--mbytes", type=int, default=64)
     p.add_argument("--quick", action="store_true")
+    p.add_argument("--cpu-mesh", dest="cpu_mesh", type=int, default=0,
+                   help="allreduce mode: force an N-device virtual CPU "
+                        "mesh so the multi-device psum path runs off-TPU")
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU backend (local smoke runs; the "
                         "jax env preloads the TPU plugin, so a simple "
@@ -482,6 +493,8 @@ def main() -> None:
     ]:
         if val is not None:
             fwd += [flag, str(val)]
+    if args.cpu_mesh:
+        fwd += ["--cpu-mesh", str(args.cpu_mesh)]
     if args.quick:
         fwd.append("--quick")
     if args.cpu:
@@ -492,7 +505,7 @@ def main() -> None:
         # keep the one-JSON-line contract even in total failure
         out = {
             "metric": {
-                "resnet": "resnet50_images_per_sec_per_chip",
+                "resnet": "resnet50_sync_sgd_images_per_sec_per_chip",
                 "kernels": "pallas_kernel_speedup_vs_xla",
                 "allreduce": "allreduce_bus_bandwidth",
             }[which],

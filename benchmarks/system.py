@@ -161,7 +161,7 @@ def main(argv=None) -> dict:
             times.append(dt)
 
     sps = global_batch * len(times) / sum(times)
-    unit = "images/sec" if args.model == "resnet50" else "sequences/sec"
+    unit = "sequences/sec" if args.model == "transformer" else "images/sec"
     result = {
         "metric": f"{args.model}_{args.optimizer}_throughput",
         "value": round(sps, 2),
