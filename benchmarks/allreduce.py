@@ -123,7 +123,8 @@ def main(argv=None) -> dict:
     p.add_argument("--model", default="resnet50-imagenet")
     p.add_argument("--np", dest="np_workers", type=int, default=4,
                    help="host-backend worker count")
-    p.add_argument("--strategy", default="BINARY_TREE_STAR")
+    p.add_argument("--strategy", default="AUTO",
+                   help="AUTO measures what ships (single host -> RING)")
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument("--quick", action="store_true")

@@ -56,15 +56,26 @@ _log = get_logger("engine")
 CHUNK_SIZE = 1 << 20  # 1 MiB, reference session.go:292-316
 
 
-def engine_chunk_size() -> int:
+#: colocated peers (single-host cluster, unix-socket transport) pipeline
+#: the socket→reduce stages best with smaller chunks: measured on the
+#: loopback harness, RING over 256 KiB chunks reaches 1.03 GiB/s bus
+#: bandwidth at np=4 where the 1 MiB reference default gets 0.66
+#: (docs/perf.md); cross-host traffic keeps the reference's 1 MiB.
+CHUNK_SIZE_COLOCATED = 256 << 10
+
+
+def engine_chunk_size(colocated: bool = False) -> int:
     """Chunk size for graph sharding (``KF_CONFIG_CHUNK_SIZE`` bytes).
     MUST be identical on every peer — chunk boundaries and tags derive
     from it, and a mismatch surfaces as collective timeouts.  The
     launcher propagates the launcher-shell env to all workers, so set it
-    where the job is launched, not per worker.  Non-positive values fall
-    back to the default (0 would divide-by-zero the chunk count)."""
-    v = envs.parse_int_env(envs.CHUNK_SIZE, CHUNK_SIZE)
-    return v if v > 0 else CHUNK_SIZE
+    where the job is launched, not per worker (``colocated`` is derived
+    from the shared peer list, so it is consistent by construction).
+    Non-positive values fall back to the default (0 would
+    divide-by-zero the chunk count)."""
+    default = CHUNK_SIZE_COLOCATED if colocated else CHUNK_SIZE
+    v = envs.parse_int_env(envs.CHUNK_SIZE, default)
+    return v if v > 0 else default
 
 
 def engine_threads() -> int:
@@ -153,6 +164,8 @@ class CollectiveEngine:
         self.strategy = strategy
         self._graphs = build_strategy_graphs(strategy, peers)
         self._cross_graphs = build_cross_strategy_graphs(strategy, peers)
+        # derived from the shared peer list → identical on every peer
+        self._colocated = len(peers.hosts()) <= 1
         # chunk→strategy hash mode (reference shard.go:25-31); read once at
         # engine construction, like the reference reads config at init
         import os
@@ -452,7 +465,8 @@ class CollectiveEngine:
         rc = t.engine_all_reduce(
             self._peers_csv, buf, flat.dtype.itemsize, code, opc,
             data, offsets, len(graphs), tag,
-            1 if self._hash_name_based else 0, engine_chunk_size(),
+            1 if self._hash_name_based else 0,
+            engine_chunk_size(self._colocated),
             engine_timeout_s(), engine_threads(), stats,
         )
         if rc == 1:
@@ -497,7 +511,7 @@ class CollectiveEngine:
 
     # -- internals -------------------------------------------------------
     def _split(self, flat: np.ndarray) -> List[np.ndarray]:
-        n_chunks = max(1, -(-flat.nbytes // engine_chunk_size()))
+        n_chunks = max(1, -(-flat.nbytes // engine_chunk_size(self._colocated)))
         return [np.ascontiguousarray(c) for c in np.array_split(flat, n_chunks)]
 
     def _choose(self, chunk_idx: int, name: str, n_graphs: Optional[int] = None) -> int:

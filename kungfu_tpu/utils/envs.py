@@ -39,9 +39,11 @@ Tuning envs (read anywhere, any time):
 ``KF_CONFIG_LOG_LEVEL``            DEBUG/INFO/WARN/ERROR
 ``KF_CONFIG_STRATEGY_HASH_METHOD`` chunk→strategy hash: "simple"|"name"
 ``KF_CONFIG_WAIT_RUNNER_TIMEOUT``  seconds, default 30
-``KF_CONFIG_CHUNK_SIZE``           engine chunk bytes, default 1 MiB.
-                                   Must be identical cluster-wide (set at
-                                   the launcher; it propagates to workers)
+``KF_CONFIG_CHUNK_SIZE``           engine chunk bytes; default 1 MiB,
+                                   or 256 KiB when all peers share one
+                                   host (measured, engine.py).  Must be
+                                   identical cluster-wide (set at the
+                                   launcher; it propagates to workers)
 ``KF_CONFIG_ENGINE_THREADS``       native executor threads, default
                                    min(8, cores)
 ``KF_CONFIG_ENGINE_TIMEOUT``       per-collective timeout s, default 60

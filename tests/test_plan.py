@@ -240,7 +240,9 @@ class TestStrategy:
             parse_strategy("nope")
 
     def test_auto(self):
-        assert auto_select(1) == Strategy.STAR
+        # single host diverges from the reference's STAR: colocated RING
+        # measured ~20% faster over unix sockets (strategy.py:auto_select)
+        assert auto_select(1) == Strategy.RING
         assert auto_select(3) == Strategy.BINARY_TREE_STAR
 
 
