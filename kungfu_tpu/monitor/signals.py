@@ -8,12 +8,20 @@ monitored runner); with it unset these are no-ops, so instrumented training
 scripts run unchanged under plain ``kfrun``.
 
 Failures to deliver are swallowed by design: a dying detector must not
-take the training job down with it.
+take the training job down with it.  Per-batch begin/end heartbeats are
+fire-and-forget (the next batch re-sends fresher liveness anyway), but
+``epoch``/``trainend`` are *bookkeeping* — a dropped epoch signal makes
+the post-failure restart resume from an older epoch (observed on a
+loaded box: the detector's accept backlog ate an epoch POST and the job
+re-trained an epoch it had finished) — so those retry a few times
+before giving up.
 """
 
 from __future__ import annotations
 
+import http.client
 import os
+import time
 from typing import Optional
 
 from kungfu_tpu.monitor.detector import DEFAULT_DETECTOR_PORT, post_signal
@@ -34,14 +42,22 @@ def _target() -> Optional[tuple]:
     return addr, DEFAULT_DETECTOR_PORT
 
 
-def _send(sig: dict) -> None:
+def _send(sig: dict, attempts: int = 1) -> None:
     target = _target()
     if target is None:
         return
-    try:
-        post_signal(target[0], target[1], sig, timeout=3)
-    except OSError as e:
-        _log.debug("signal %s not delivered: %s", sig.get("kind"), e)
+    for i in range(attempts):
+        try:
+            post_signal(target[0], target[1], sig, timeout=3)
+            return
+        # HTTPException is NOT an OSError (e.g. BadStatusLine from a
+        # half-dead detector); both must be swallowed or the monitoring
+        # sidecar's death takes the training job down with it
+        except (OSError, http.client.HTTPException) as e:
+            if i + 1 < attempts:
+                time.sleep(0.2 * (i + 1))
+            else:
+                _log.debug("signal %s not delivered: %s", sig.get("kind"), e)
 
 
 def monitor_batch_begin(rank: int) -> None:
@@ -53,8 +69,8 @@ def monitor_batch_end(rank: int) -> None:
 
 
 def monitor_epoch_end(rank: int, epoch: int) -> None:
-    _send({"kind": "epoch", "rank": rank, "epoch": epoch})
+    _send({"kind": "epoch", "rank": rank, "epoch": epoch}, attempts=3)
 
 
 def monitor_train_end(rank: int) -> None:
-    _send({"kind": "trainend", "rank": rank})
+    _send({"kind": "trainend", "rank": rank}, attempts=3)

@@ -5,8 +5,7 @@ strategies plus AUTO (selection rule: :func:`auto_select` — single host →
 RING, a measured divergence from the reference; multi-host →
 BINARY_TREE_STAR).  On TPU a *strategy* selects among compiled collective
 schedules (see :mod:`kungfu_tpu.comm.strategies`) rather than per-message
-routing graphs, but the names, the env/flag surface, and the AUTO selection
-rule (single host → STAR, multi host → BINARY_TREE_STAR) are preserved.
+routing graphs, but the names and the env/flag surface are preserved.
 """
 
 from __future__ import annotations
