@@ -8,7 +8,8 @@ all-to-all each way, instead of point-to-point sends).
 Gradients: ``all_to_all`` transposes to itself, so expert-weight gradients
 accumulate contributions from every rank's tokens without any explicit
 cross-rank sync over ``ep``; see
-:func:`kungfu_tpu.parallel.train.sync_grads` for the axis bookkeeping.
+:meth:`kungfu_tpu.parallel.train.ShardedTrainer.sync_grads` for the axis
+bookkeeping.
 
 Shapes (per device): tokens ``[T, D]``; global expert count ``E`` must be
 divisible by the axis size; each rank owns ``E_local = E / ep`` experts

@@ -9,8 +9,9 @@ collectives onto the ICI torus itself.  The graphs remain load-bearing for:
 
 * host-side control-plane collectives (consensus, barrier across processes);
 * the async gossip channel (PairAveraging peer selection);
-* strategy benchmarking/adaptation (choosing among compiled collective
-  schedules, see :mod:`kungfu_tpu.comm.strategies`).
+* strategy benchmarking/adaptation (host plane: routing graphs in
+  :mod:`kungfu_tpu.comm.engine`; device plane: compiled collective
+  schedules in :mod:`kungfu_tpu.comm.device`).
 """
 
 from kungfu_tpu.plan.graph import Graph, Node

@@ -3,9 +3,11 @@
 Parity with reference ``srcs/go/kungfu/base/strategy.go:10-22``: eight named
 strategies plus AUTO (selection rule: :func:`auto_select` — single host →
 RING, a measured divergence from the reference; multi-host →
-BINARY_TREE_STAR).  On TPU a *strategy* selects among compiled collective
-schedules (see :mod:`kungfu_tpu.comm.strategies`) rather than per-message
-routing graphs, but the names and the env/flag surface are preserved.
+BINARY_TREE_STAR).  The host plane (:mod:`kungfu_tpu.comm.engine`) keeps
+the reference's graph semantics — a strategy generates (reduce, bcast)
+routing graphs; on the device plane (:mod:`kungfu_tpu.comm.device`) a
+strategy instead selects among compiled collective schedules.  Names and
+the env/flag surface are preserved either way.
 """
 
 from __future__ import annotations
