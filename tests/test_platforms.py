@@ -88,3 +88,40 @@ class TestCliWiring:
         ns = build_parser().parse_args(["-platform", "none", "prog"])
         apply_platform(ns)
         assert ns.hosts == "" and ns.self_host == "127.0.0.1"
+
+    def test_auto_oversize_np_keeps_localhost(self, monkeypatch):
+        """An explicit -np the detected pod can't host (1 slot/host) opts
+        out of detection — the CPU-backend test-cluster case on a TPU VM
+        whose env still carries the pod contract."""
+        from kungfu_tpu.runner.cli import apply_platform, build_cluster, build_parser
+
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+        monkeypatch.setenv("TPU_WORKER_ID", "0")
+        ns = build_parser().parse_args(["-np", "4", "prog"])
+        apply_platform(ns)
+        assert ns.hosts == "" and ns.backend is None
+        assert build_cluster(ns).size() == 4  # localhost:4
+
+    def test_forced_oversize_np_exits_cleanly(self, monkeypatch):
+        from kungfu_tpu.runner.cli import apply_platform, build_parser
+
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+        monkeypatch.setenv("TPU_WORKER_ID", "0")
+        ns = build_parser().parse_args(["-platform", "tpu-pod", "-np", "4", "prog"])
+        with pytest.raises(SystemExit, match="exceeds the detected TPU pod"):
+            apply_platform(ns)
+
+    def test_explicit_np1_survives_detection(self, monkeypatch):
+        """-np 1 given explicitly must stay 1; only the argparse default
+        (None) expands to one worker per pod host."""
+        from kungfu_tpu.runner.cli import apply_platform, build_parser
+
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+        monkeypatch.setenv("TPU_WORKER_ID", "0")
+        ns = build_parser().parse_args(["-np", "1", "prog"])
+        apply_platform(ns)
+        assert ns.np == 1 and ns.backend == "tpu"  # pod applies, np kept
+
+        ns = build_parser().parse_args(["prog"])
+        apply_platform(ns)
+        assert ns.np == 2  # default expands to the pod
