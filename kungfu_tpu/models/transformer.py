@@ -186,20 +186,11 @@ class Transformer:
 
     def loss(self, params, batch, train: bool = True, rng=None, attn_fn=None, positions=None):
         """Next-token LM loss; batch = (ids, targets) both [B, S]."""
-        import os
+        from kungfu_tpu.ops.pallas.xent import token_nll
 
         ids, targets = batch
         logits = self.apply(params, ids, train=train, rng=rng, attn_fn=attn_fn, positions=positions)
-        mode = os.environ.get("KF_TPU_XENT", "auto").lower()
-        if mode == "fused" or (mode == "auto" and jax.default_backend() == "tpu"):
-            # fused Pallas kernel: streams the vocab, no [N, V] log-prob
-            # tensor or autodiff residuals in HBM
-            from kungfu_tpu.ops.pallas import softmax_cross_entropy
-
-            return jnp.mean(softmax_cross_entropy(logits, targets))
-        logp = jax.nn.log_softmax(logits)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
-        return jnp.mean(nll)
+        return token_nll(logits, targets)
 
 
 def bert_base() -> Transformer:

@@ -10,6 +10,6 @@ the CPU multi-process test cluster exercises the same code path.
 """
 
 from kungfu_tpu.ops.pallas.attention import flash_attention, make_flash_attn
-from kungfu_tpu.ops.pallas.xent import softmax_cross_entropy
+from kungfu_tpu.ops.pallas.xent import softmax_cross_entropy, token_nll
 
-__all__ = ["flash_attention", "make_flash_attn", "softmax_cross_entropy"]
+__all__ = ["flash_attention", "make_flash_attn", "softmax_cross_entropy", "token_nll"]

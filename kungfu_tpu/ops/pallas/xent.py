@@ -217,6 +217,25 @@ def _xent_bwd(block_n, block_v, interpret, res, g):
 _xent.defvjp(_xent_fwd, _xent_bwd)
 
 
+def token_nll(logits, targets):
+    """Mean next-token NLL with the fused/plain dispatch.
+
+    The single owner of the ``KF_TPU_XENT`` switch (``fused`` | ``plain``
+    | ``auto``; auto = fused on TPU): both the standalone
+    :meth:`~kungfu_tpu.models.transformer.Transformer.loss` head and the
+    sharded trainer's pipeline head route through here, so the mode
+    semantics can't drift between the two loss paths.  Fused keeps the
+    O(N·V) log-prob tensor and its autodiff residuals out of HBM."""
+    import os
+
+    mode = os.environ.get("KF_TPU_XENT", "auto").lower()
+    if mode == "fused" or (mode == "auto" and jax.default_backend() == "tpu"):
+        return jnp.mean(softmax_cross_entropy(logits, targets))
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
+    return jnp.mean(nll)
+
+
 def softmax_cross_entropy(
     logits,
     targets,

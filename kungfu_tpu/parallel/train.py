@@ -288,8 +288,11 @@ class ShardedTrainer:
         valid_outs = outs[Pp - 1 : Pp - 1 + n_micro]
         hf = nn.layernorm_apply(lparams["ln_f"], valid_outs)
         logits = nn.dense_apply(lparams["head"], hf).astype(jnp.float32)
-        logp = jax.nn.log_softmax(logits)
-        nll = -jnp.take_along_axis(logp, tgt_mb[..., None], axis=-1).mean()
+        # fused-or-plain NLL: on the sharded path the [n_micro, B_mb,
+        # S_loc, V] logits are the largest live tensor per device
+        from kungfu_tpu.ops.pallas.xent import token_nll
+
+        nll = token_nll(logits, tgt_mb)
         nll_term = jnp.where(pp_idx == Pp - 1, nll, 0.0)
 
         # aux from ticks where this stage processed a real microbatch

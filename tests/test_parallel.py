@@ -123,6 +123,27 @@ def test_sharded_loss_matches_reference(plan):
     np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
 
 
+def test_sharded_loss_fused_xent_matches(monkeypatch):
+    """KF_TPU_XENT=fused routes the sharded head through the Pallas
+    kernel (interpret mode off-TPU); the loss must match the plain
+    log_softmax path — both per-stage masking and the mean reduction."""
+    monkeypatch.setenv("KF_TPU_XENT", "fused")
+    plan = MeshPlan(dp=2, pp=2, sp=1, tp=2)
+    cfg = TransformerConfig(**CFG)
+    model = Transformer(cfg)
+    tparams = model.init(jax.random.PRNGKey(0))
+    batch = _batch()
+    monkeypatch.setenv("KF_TPU_XENT", "plain")
+    ref_loss = model.loss(tparams, batch, train=False)
+    monkeypatch.setenv("KF_TPU_XENT", "fused")
+
+    trainer = ShardedTrainer(cfg, plan, n_micro=2)
+    params = trainer.from_transformer_params(tparams)
+    state = {"params": params, "opt_state": trainer.tx.init(params), "step": 0}
+    loss = trainer.loss(state, batch)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
+
+
 @pytest.mark.parametrize("plan", [MeshPlan(dp=2, pp=1, sp=2, tp=2),
                                   MeshPlan(dp=2, pp=2, sp=1, tp=2)], ids=str)
 def test_sharded_step_matches_reference(plan):
