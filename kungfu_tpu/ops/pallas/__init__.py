@@ -9,7 +9,17 @@ Kernels run compiled on TPU and in interpreter mode on CPU (tests), so
 the CPU multi-process test cluster exercises the same code path.
 """
 
-from kungfu_tpu.ops.pallas.attention import flash_attention, make_flash_attn
+from kungfu_tpu.ops.pallas.attention import (
+    flash_attention,
+    flash_attention_with_lse,
+    make_flash_attn,
+)
 from kungfu_tpu.ops.pallas.xent import softmax_cross_entropy, token_nll
 
-__all__ = ["flash_attention", "make_flash_attn", "softmax_cross_entropy", "token_nll"]
+__all__ = [
+    "flash_attention",
+    "flash_attention_with_lse",
+    "make_flash_attn",
+    "softmax_cross_entropy",
+    "token_nll",
+]

@@ -1,0 +1,29 @@
+"""Varying-manual-axes (vma) helpers for jax>=0.9 shard_map typing.
+
+Under ``shard_map`` every value carries the set of mesh axes it varies
+over; pallas ``out_shape`` structs must declare it, and scan carries /
+switch branches must type-match their varying counterparts.  One shared
+implementation so the workaround changes in one place when jax's typing
+evolves.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def vma_of(*operands) -> frozenset:
+    """Union of the operands' varying manual axes (empty outside
+    ``shard_map``)."""
+    vs = set()
+    for o in operands:
+        vs |= set(getattr(jax.typeof(o), "vma", ()) or ())
+    return frozenset(vs)
+
+
+def match_vma(t, vma: frozenset):
+    """Mark ``t`` varying over any axes in ``vma`` it doesn't carry yet
+    (no-op for axes already varying — pcast rejects varying→varying)."""
+    cur = set(getattr(jax.typeof(t), "vma", ()) or ())
+    missing = tuple(a for a in vma if a not in cur)
+    return jax.lax.pcast(t, missing, to="varying") if missing else t

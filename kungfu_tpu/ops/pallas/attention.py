@@ -57,6 +57,10 @@ _NEG_INF = -1e30
 _LANES = 128
 
 
+from kungfu_tpu.ops.pallas._sharding import match_vma as _match_vma
+from kungfu_tpu.ops.pallas._sharding import vma_of as _vma
+
+
 def _causal_hi(qi, block_q, block_k):
     """Index of the LAST kv block a causal q-block ``qi`` attends to."""
     return jax.lax.div((qi + 1) * block_q + block_k - 1, block_k) - 1
@@ -163,8 +167,8 @@ def _fwd_call(q, k, v, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s_pad, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s_pad, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s_pad, d), q.dtype, vma=_vma(q, k, v)),
+            jax.ShapeDtypeStruct((bh, s_pad, _LANES), jnp.float32, vma=_vma(q, k, v)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),   # running max m
@@ -179,14 +183,17 @@ def _fwd_call(q, k, v, causal, block_q, block_k, interpret):
     return out[:, :s], lse[:, :s, 0]
 
 
-def _bwd_blocked(q, k, v, out, lse, dout, causal, block_k):
-    """Blocked flash backward in jnp; [BH, S, D] operands."""
+def _bwd_blocked(q, k, v, out, lse, dout, causal, block_k, delta=None):
+    """Blocked flash backward in jnp; [BH, S, D] operands.  ``delta``
+    defaults to rowsum(dO·O); callers with an lse cotangent pass the
+    shifted value (see ``_flash_pair_bwd``)."""
     bh, s, d = q.shape
     scale = 1.0 / (d ** 0.5)
     qf = q.astype(jnp.float32)
     of = out.astype(jnp.float32)
     dof = dout.astype(jnp.float32)
-    delta = jnp.sum(dof * of, axis=-1)  # [BH, S]
+    if delta is None:
+        delta = jnp.sum(dof * of, axis=-1)  # [BH, S]
 
     s_pad = ((s + block_k - 1) // block_k) * block_k
     if s_pad != s:
@@ -214,7 +221,7 @@ def _bwd_blocked(q, k, v, out, lse, dout, causal, block_k):
         dv_b = jnp.einsum("bqk,bqd->bkd", p, dof)
         return dq, (dk_b, dv_b)
 
-    dq0 = jnp.zeros((bh, s, d), jnp.float32)
+    dq0 = _match_vma(jnp.zeros((bh, s, d), jnp.float32), _vma(q, k, v, dout))
     dq, (dk_blocks, dv_blocks) = jax.lax.scan(
         fold, dq0, (jnp.arange(n_blk), kf.transpose(1, 0, 2, 3), vf.transpose(1, 0, 2, 3))
     )
@@ -327,14 +334,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_s[:].astype(dv_ref.dtype)
 
 
-def _bwd_pallas(q, k, v, out, lse, dout, causal, block_q, block_k, interpret):
+def _bwd_pallas(q, k, v, out, lse, dout, causal, block_q, block_k, interpret,
+                delta=None):
     """Pallas backward: dq via a kv-streaming kernel, dk/dv via a
-    q-streaming kernel; [BH, S, D] operands."""
+    q-streaming kernel; [BH, S, D] operands.  ``delta`` as in
+    :func:`_bwd_blocked`."""
     bh, s, d = q.shape
     scale = 1.0 / (d ** 0.5)
-    delta = jnp.sum(
-        dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
-    )  # [BH, S]
+    if delta is None:
+        delta = jnp.sum(
+            dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+        )  # [BH, S]
 
     s_pad = ((s + block_q - 1) // block_q) * block_q
     s_pad = ((s_pad + block_k - 1) // block_k) * block_k
@@ -369,7 +379,8 @@ def _bwd_pallas(q, k, v, out, lse, dout, causal, block_q, block_k, interpret):
         grid=(bh, n_q, n_k),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
         out_specs=[q_spec],
-        out_shape=[jax.ShapeDtypeStruct((bh, s_pad, d), q.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((bh, s_pad, d), q.dtype,
+                                        vma=_vma(q, k, v, dout))],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
@@ -407,8 +418,8 @@ def _bwd_pallas(q, k, v, out, lse, dout, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s_pad, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s_pad, d), q.dtype, vma=_vma(q, k, v, dout)),
+            jax.ShapeDtypeStruct((bh, s_pad, d), q.dtype, vma=_vma(q, k, v, dout)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -451,6 +462,52 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, dout):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_pair(q, k, v, causal, block_q, block_k, interpret):
+    """Like :func:`_flash` but returns ``(out, lse)`` — the pair a
+    cross-block online-softmax merge needs (ring attention folds each
+    rotating K/V block via its lse)."""
+    return _fwd_call(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_pair_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _fwd_call(q, k, v, causal, block_q, block_k, interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_pair_bwd(causal, block_q, block_k, interpret, res, cts):
+    """The lse cotangent needs no extra kernel: ∂lse_i/∂s_ij = p_ij, so
+    its contribution to dS is ``p * dlse`` — and the backward kernels
+    compute ``dS = p * (dp - delta)``, so shifting ``delta -= dlse``
+    carries it through both the Pallas and the blocked-jnp paths."""
+    q, k, v, out, lse = res
+    dout, dlse = cts
+    import os
+
+    if interpret and os.environ.get("KF_PALLAS_BWD", "") != "pallas":
+        bwd = _bwd_blocked_delta
+    else:
+        bwd = functools.partial(_bwd_pallas_delta, block_q=block_q,
+                                interpret=interpret)
+    delta = jnp.sum(
+        dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    ) - dlse.astype(jnp.float32)
+    return bwd(q, k, v, out, lse, dout, delta, causal, block_k)
+
+
+def _bwd_blocked_delta(q, k, v, out, lse, dout, delta, causal, block_k):
+    return _bwd_blocked(q, k, v, out, lse, dout, causal, block_k, delta=delta)
+
+
+def _bwd_pallas_delta(q, k, v, out, lse, dout, delta, causal, block_k, *,
+                      block_q, interpret):
+    return _bwd_pallas(q, k, v, out, lse, dout, causal, block_q, block_k,
+                       interpret, delta=delta)
+
+
+_flash_pair.defvjp(_flash_pair_fwd, _flash_pair_bwd)
+
+
 def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
@@ -485,6 +542,29 @@ def flash_attention(
         causal, block_q, block_k, interpret,
     )
     return out.reshape(b, h, s, d)
+
+
+def flash_attention_with_lse(
+    q,
+    k,
+    v,
+    causal: bool = True,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: Optional[bool] = None,
+):
+    """Fused attention returning ``(out, lse)`` for [BH, S, D] operands.
+
+    Differentiable in both outputs (the lse cotangent folds into the
+    backward's delta shift).  The lse rows let a caller merge multiple
+    attention calls over disjoint K/V blocks with the standard
+    online-softmax combine — :mod:`kungfu_tpu.parallel.ring` uses this
+    as its per-round block primitive."""
+    if interpret is None:
+        interpret = _use_interpret()
+    if q.ndim != 3:
+        raise ValueError(f"expected [BH, S, D], got {q.shape}")
+    return _flash_pair(q, k, v, causal, block_q, block_k, interpret)
 
 
 def make_flash_attn(block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K):

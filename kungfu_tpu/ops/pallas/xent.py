@@ -28,6 +28,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from kungfu_tpu.ops.pallas._sharding import vma_of as _vma
+
 #: measured on TPU v5e (docs/perf.md): (256, 2048) tiles run the fwd+bwd
 #: sweep ~1.5x faster than the round-3 (128, 512) defaults — big enough
 #: to pipeline HBM reads, small enough for VMEM double-buffering
@@ -100,8 +102,8 @@ def _fwd_call(logits, targets, block_n, block_v, interpret):
         ],
         out_specs=[row, row],
         out_shape=[
-            jax.ShapeDtypeStruct((n_pad, _LANES), jnp.float32),
-            jax.ShapeDtypeStruct((n_pad, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, _LANES), jnp.float32, vma=_vma(logits, targets)),
+            jax.ShapeDtypeStruct((n_pad, _LANES), jnp.float32, vma=_vma(logits, targets)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_n, 1), jnp.float32),
@@ -177,7 +179,8 @@ def _bwd_pallas(logits, targets, lse, g, block_n, block_v, interpret):
             row, row, row,
         ],
         out_specs=pl.BlockSpec((block_n, block_v), lambda i, j: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((n_pad, v_pad), logits.dtype),
+        out_shape=jax.ShapeDtypeStruct((n_pad, v_pad), logits.dtype,
+                                       vma=_vma(logits, targets, lse, g)),
         compiler_params=pltpu.CompilerParams(
             # stateless per tile: both grid dims are parallel
             dimension_semantics=("parallel", "parallel"),

@@ -26,12 +26,102 @@ import jax
 import jax.numpy as jnp
 
 
-def ring_attention(q, k, v, causal: bool = True, axis: str = "sp"):
+def ring_attention(q, k, v, causal: bool = True, axis: str = "sp",
+                   block_impl: str = "auto"):
     """q, k, v: [B, H, S_local, D] (sequence axis sharded over ``axis``).
 
     Returns [B, H, S_local, D] — the exact softmax attention output as if
     the full sequence were on one device.
+
+    ``block_impl`` picks the per-round block computation:
+
+    * ``einsum`` — jnp online-softmax fold (materializes one [S_local,
+      S_local] f32 logits tile per round);
+    * ``flash`` — the Pallas kernel via
+      :func:`~kungfu_tpu.ops.pallas.attention.flash_attention_with_lse`,
+      merged across rounds by lse: per-device memory drops to O(block)
+      and causal runs *skip* fully-masked rounds' compute entirely
+      (``lax.switch`` — the einsum path pays for them and discards);
+    * ``auto`` — flash on TPU, einsum elsewhere (interpret-mode Pallas
+      is too slow for the CPU test cluster).
     """
+    if block_impl not in ("auto", "flash", "einsum"):
+        raise ValueError(f"unknown block_impl {block_impl!r}")
+    if block_impl == "flash" or (
+        block_impl == "auto" and jax.default_backend() == "tpu"
+    ):
+        return _ring_flash(q, k, v, causal, axis)
+    return _ring_einsum(q, k, v, causal, axis)
+
+
+def _ring_flash(q, k, v, causal: bool, axis: str):
+    """Flash-block ring: each round folds one rotating K/V block through
+    the Pallas kernel; blocks merge by the standard online-softmax
+    combine over (out, lse)."""
+    from kungfu_tpu.ops.pallas._sharding import match_vma
+    from kungfu_tpu.ops.pallas.attention import flash_attention_with_lse
+
+    n_sp = jax.lax.axis_size(axis)
+    my_blk = jax.lax.axis_index(axis)
+    B, H, S, D = q.shape
+    q3 = q.reshape(B * H, S, D)
+    ring_vma = frozenset({axis})
+
+    def _full(kb, vb):
+        return flash_attention_with_lse(q3, kb, vb, causal=False)
+
+    def _diag(kb, vb):
+        return flash_attention_with_lse(q3, kb, vb, causal=True)
+
+    def _masked(kb, vb):
+        # future block under causal: zero contribution (lse = -inf);
+        # match_vma gives all switch branches one output type
+        return (
+            match_vma(jnp.zeros_like(q3), ring_vma),
+            match_vma(jnp.full((B * H, S), -jnp.inf, jnp.float32), ring_vma),
+        )
+
+    def fold(carry, _):
+        kv, blk, m, l, acc = carry
+        kb, vb = kv
+        kb3 = kb.reshape(B * H, S, D)
+        vb3 = vb.reshape(B * H, S, D)
+        if causal:
+            branch = jnp.where(
+                blk > my_blk, 0, jnp.where(blk == my_blk, 2, 1)
+            )
+        else:
+            branch = jnp.int32(1)
+        out_i, lse_i = jax.lax.switch(
+            branch, [_masked, _full, _diag], kb3, vb3
+        )
+        m_new = jnp.maximum(m, lse_i)
+        # -inf - -inf is NaN: m is -inf before the first contributing
+        # round (and m_new stays -inf if that round is masked too, which
+        # a start-offset refactor could produce), so guard the operands,
+        # not the result — a masked/virgin term must contribute exactly 0
+        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - jnp.where(jnp.isneginf(m_new), 0.0, m_new)))
+        w = jnp.where(jnp.isneginf(lse_i), 0.0, jnp.exp(lse_i - jnp.where(jnp.isneginf(m_new), 0.0, m_new)))
+        l = l * corr + w
+        acc = acc * corr[..., None] + out_i.astype(jnp.float32) * w[..., None]
+        perm = [((j + 1) % n_sp, j) for j in range(n_sp)]
+        kv = jax.tree_util.tree_map(
+            lambda t: jax.lax.ppermute(t, axis, perm), (kb, vb)
+        )
+        return (kv, (blk + 1) % n_sp, m_new, l, acc), None
+
+    m0 = match_vma(jnp.full((B * H, S), -jnp.inf, jnp.float32), ring_vma)
+    l0 = match_vma(jnp.zeros((B * H, S), jnp.float32), ring_vma)
+    acc0 = match_vma(jnp.zeros((B * H, S, D), jnp.float32), ring_vma)
+    (_, _, _, l, acc), _ = jax.lax.scan(
+        fold, ((k, v), my_blk, m0, l0, acc0), None, length=n_sp
+    )
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype).reshape(B, H, S, D)
+
+
+def _ring_einsum(q, k, v, causal: bool, axis: str):
+    """jnp online-softmax ring fold (the original implementation)."""
     n_sp = jax.lax.axis_size(axis)
     my_blk = jax.lax.axis_index(axis)
     B, H, S, D = q.shape
@@ -81,11 +171,13 @@ def ring_attention(q, k, v, causal: bool = True, axis: str = "sp"):
     return out.astype(q.dtype)
 
 
-def make_ring_attn(axis: str = "sp"):
+def make_ring_attn(axis: str = "sp", block_impl: str = "auto"):
     """Adapter matching the ``attn_fn(q, k, v, causal)`` slot of
     :meth:`kungfu_tpu.models.transformer.Transformer.apply`."""
 
     def attn(q, k, v, causal):
-        return ring_attention(q, k, v, causal=causal, axis=axis)
+        return ring_attention(
+            q, k, v, causal=causal, axis=axis, block_impl=block_impl
+        )
 
     return attn
