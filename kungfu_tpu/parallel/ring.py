@@ -4,9 +4,12 @@ Long-context support the TPU way (the reference has no attention at all,
 SURVEY §5.7; this is the framework's sequence/context-parallel subsystem):
 Q stays local, K/V blocks rotate around the ``sp`` ring via
 ``lax.ppermute`` while a streaming (online-softmax) accumulator folds each
-block in — memory per device is O(S/sp), traffic rides the ICI ring, and
-compute/communication overlap is XLA's job (each round's matmul hides the
-next block's permute).
+block in — traffic rides the ICI ring, and compute/communication overlap
+is XLA's job (each round's matmul hides the next block's permute).  On
+TPU each round's block runs through the Pallas flash kernel and rounds
+merge by lse (``block_impl`` below), taking per-device attention memory
+from O((S/sp)²) scores to O(kernel block); off-TPU a jnp online-softmax
+fold computes the same thing.
 
 Differentiable: the backward pass is autodiff through the scan — ppermute
 transposes to the inverse rotation, so cotangents counter-rotate around the
