@@ -295,13 +295,23 @@ def payload_kernels(args) -> dict:
     t_pallas = measure_chained(
         lambda q_: flash_attention(q_, k, v, causal=True), q
     )
-    t_xla = measure_chained(lambda q_: xla_attn(q_, k, v), q)
+    # causal fwd FLOPs: QK^T + PV over the lower triangle
+    attn_flops = 2 * 2 * B * H * S * S * D / 2
     results["flash_attention"] = {
         "pallas_ms": round(t_pallas * 1e3, 3),
-        "xla_naive_ms": round(t_xla * 1e3, 3),
-        "speedup": round(t_xla / t_pallas, 3),
+        "pallas_achieved_tflops": round(attn_flops / t_pallas / 1e12, 1),
         "shape": [B, H, S, D],
     }
+    # the un-fused baseline materializes [B,H,S,S] f32 scores — past
+    # S~4k that alone is O(10 GB) and the comparison stops being a
+    # measurement of anything but HBM exhaustion
+    long_context = S >= 4096
+    if not long_context:
+        t_xla = measure_chained(lambda q_: xla_attn(q_, k, v), q)
+        results["flash_attention"].update(
+            xla_naive_ms=round(t_xla * 1e3, 3),
+            speedup=round(t_xla / t_pallas, 3),
+        )
 
     # grad path (round 3: the Pallas dQ + dK/dV backward kernels): chain
     # q -> q - eps * dq, which forces a full fwd+bwd per iteration
@@ -314,13 +324,17 @@ def payload_kernels(args) -> dict:
     t_pallas_g = measure_chained(
         grad_step(lambda qq: flash_attention(qq, k, v, causal=True)), q
     )
-    t_xla_g = measure_chained(grad_step(lambda qq: xla_attn(qq, k, v)), q)
     results["flash_attention_fwd_bwd"] = {
         "pallas_ms": round(t_pallas_g * 1e3, 3),
-        "xla_naive_ms": round(t_xla_g * 1e3, 3),
-        "speedup": round(t_xla_g / t_pallas_g, 3),
+        "pallas_achieved_tflops": round(3.5 * attn_flops / t_pallas_g / 1e12, 1),
         "shape": [B, H, S, D],
     }
+    if not long_context:
+        t_xla_g = measure_chained(grad_step(lambda qq: xla_attn(qq, k, v)), q)
+        results["flash_attention_fwd_bwd"].update(
+            xla_naive_ms=round(t_xla_g * 1e3, 3),
+            speedup=round(t_xla_g / t_pallas_g, 3),
+        )
 
     # fused softmax-xent: pallas kernel vs XLA logsumexp path
     from kungfu_tpu.ops.pallas.xent import softmax_cross_entropy
@@ -373,11 +387,19 @@ def payload_kernels(args) -> dict:
         "shape": [N, V],
     }
 
+    # flash_attention carries no speedup in long-context runs (no XLA
+    # baseline); fused_xent always does, so the min is never empty —
+    # speedup_covers says which kernels the headline value spans
+    covered = [
+        name
+        for name in ("flash_attention", "fused_xent")
+        if "speedup" in results[name]
+    ]
     return {
         "metric": "pallas_kernel_speedup_vs_xla",
-        "value": round(
-            min(results["flash_attention"]["speedup"], results["fused_xent"]["speedup"]), 3
-        ),
+        "value": round(min(results[n]["speedup"] for n in covered), 3),
+        "speedup_covers": covered,
+        "long_context_pallas_only": long_context,
         "unit": "x",
         "vs_baseline": 1.0,
         "platform": dev.platform,
