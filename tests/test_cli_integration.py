@@ -16,14 +16,15 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def run_cli(args, timeout=240):
+    return run_cli_prog([sys.executable, "-m", "kungfu_tpu.runner.cli"] + args,
+                        timeout=timeout)
+
+
+def run_cli_prog(cmd, timeout=240):
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
     return subprocess.run(
-        [sys.executable, "-m", "kungfu_tpu.runner.cli"] + args,
-        cwd=REPO,
-        capture_output=True,
-        text=True,
-        timeout=timeout,
+        cmd, cwd=REPO, capture_output=True, text=True, timeout=timeout,
         env=env,
     )
 
@@ -88,6 +89,19 @@ class TestCLI:
         consumed = {int(c) for _, _, _, c in done}
         assert len(consumed) == 1  # the stream stayed aligned across the resize
         assert any(int(rs) == 1 for _, _, rs, _ in done)  # survivor resized once
+
+
+class TestLongContextExample:
+    def test_ring_sp4_trains(self):
+        """SP demo: exactness check vs dense + loss decreases, flash
+        blocks forced so the Pallas path runs (interpret mode here)."""
+        r = run_cli_prog(
+            [sys.executable, "examples/long_context.py", "--sp", "4",
+             "--seq-len", "128", "--cpu-devices", "4", "--steps", "3",
+             "--d-model", "64", "--block-impl", "flash"],
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "OK" in r.stdout
 
 
 class TestCLIParsing:
