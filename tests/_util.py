@@ -1,0 +1,41 @@
+"""Shared test helpers."""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Sequence
+
+
+def run_all(fns: Sequence[Callable], timeout: float = 120) -> List:
+    """Run callables concurrently (one thread each), return their results
+    in order.  Raises the first exception any of them raised, and raises
+    ``TimeoutError`` if any is still running after ``timeout`` — a hung
+    collective must fail the test loudly, not surface later as a
+    mysterious ``None`` result.  Threads are daemons so a hang can't also
+    wedge interpreter exit."""
+    outs = [None] * len(fns)
+    errs = []
+
+    def wrap(i, f):
+        try:
+            outs[i] = f()
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [
+        threading.Thread(target=wrap, args=(i, f), daemon=True)
+        for i, f in enumerate(fns)
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout)
+    if errs:
+        raise errs[0]
+    hung = [i for i, t in enumerate(ts) if t.is_alive()]
+    if hung:
+        raise TimeoutError(
+            f"worker threads {hung} still running after {timeout}s "
+            "(deadlocked collective?)"
+        )
+    return outs

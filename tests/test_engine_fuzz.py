@@ -1,0 +1,97 @@
+"""Seeded randomized sweep of the collective engine.
+
+The strategy/op matrix tests pin fixed shapes; this sweeps random
+(size, dtype, op, strategy) tuples — chunk-boundary sizes, narrow int
+dtypes, f16 — over a live 3-peer cluster, cross-checked against numpy.
+Mirrors the reference's integration sweep
+(``scripts/tests/run-integration-tests.sh`` runs np∈1..4 × all 8
+strategies over fake buffers); the random sizing is the part fixed
+shapes can't cover (a chunk-count bug shows up only at sizes straddling
+the chunk size).
+"""
+
+import numpy as np
+import pytest
+
+from kungfu_tpu.plan import Cluster, PeerList, Strategy
+
+from tests._util import run_all as _run_all
+
+DTYPES = [np.float32, np.float64, np.float16, np.int32, np.int64, np.uint8]
+OPS = ["sum", "min", "max", "prod"]
+STRATS = [
+    Strategy.STAR, Strategy.RING, Strategy.TREE, Strategy.BINARY_TREE,
+    Strategy.BINARY_TREE_STAR, Strategy.CLIQUE, Strategy.MULTI_STAR,
+    Strategy.MULTI_BINARY_TREE_STAR,
+]
+
+
+@pytest.fixture(params=["native", "python"])
+def peers(request, monkeypatch):
+    monkeypatch.setenv(
+        "KF_NATIVE_ENGINE", "1" if request.param == "native" else "0"
+    )
+    from kungfu_tpu.peer import Peer
+    from kungfu_tpu.utils.envs import Config
+
+    base = 28431 if request.param == "native" else 28441
+    workers = PeerList.parse(
+        ",".join(f"127.0.0.1:{base + i}" for i in range(3))
+    )
+    cluster = Cluster(PeerList.parse("127.0.0.1:38098"), workers)
+    ps = [Peer(Config(self_id=w, cluster=cluster)) for w in workers]
+    for p in ps:
+        p.start()
+    yield ps
+    for p in ps:
+        p.close()
+
+
+def _reference(data, op, dt):
+    acc = data[0].astype(np.float64)
+    for d in data[1:]:
+        if op == "sum":
+            acc = acc + d
+        elif op == "min":
+            acc = np.minimum(acc, d)
+        elif op == "max":
+            acc = np.maximum(acc, d)
+        else:
+            acc = acc * d
+    return acc.astype(dt)
+
+
+def test_randomized_allreduce_sweep(peers):
+    rng = np.random.default_rng(20260730)
+    for trial in range(12):
+        n = int(rng.integers(1, 200_000))
+        dt = DTYPES[int(rng.integers(len(DTYPES)))]
+        op = OPS[int(rng.integers(len(OPS)))]
+        strat = STRATS[int(rng.integers(len(STRATS)))]
+        if np.issubdtype(dt, np.floating):
+            data = [rng.standard_normal(n).astype(dt) for _ in range(3)]
+            if op == "prod":
+                data = [np.abs(d) + 0.5 for d in data]
+        else:
+            data = [rng.integers(1, 3, n).astype(dt) for _ in range(3)]
+        for p in peers:
+            p.engine().set_strategy(strat)
+        outs = _run_all(
+            [
+                lambda p=p, d=d: p.engine().all_reduce(
+                    d, op=op, name=f"fz{trial}"
+                )
+                for p, d in zip(peers, data)
+            ]
+        )
+        ref = _reference(data, op, dt)
+        for o in outs:
+            if dt is np.float16:
+                np.testing.assert_allclose(
+                    o.astype(np.float64), ref.astype(np.float64),
+                    rtol=2e-2, atol=1e-2,
+                )
+            elif np.issubdtype(dt, np.floating):
+                np.testing.assert_allclose(o, ref, rtol=1e-5, atol=1e-5)
+            else:
+                np.testing.assert_array_equal(o, ref)
