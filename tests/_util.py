@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, List, Sequence
 
 
@@ -28,8 +29,11 @@ def run_all(fns: Sequence[Callable], timeout: float = 120) -> List:
     ]
     for t in ts:
         t.start()
+    # one shared deadline, not timeout-per-join: a fully hung N-thread
+    # cluster must fail after ~timeout, not N*timeout
+    deadline = time.monotonic() + timeout
     for t in ts:
-        t.join(timeout)
+        t.join(max(0.0, deadline - time.monotonic()))
     if errs:
         raise errs[0]
     hung = [i for i, t in enumerate(ts) if t.is_alive()]

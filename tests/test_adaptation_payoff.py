@@ -15,7 +15,6 @@ delay can be injected at the channel boundary; the adaptation logic
 above the channel is identical for both backends.
 """
 
-import threading
 import time
 
 import numpy as np
@@ -23,6 +22,8 @@ import pytest
 
 from kungfu_tpu.monitor.adaptive import AdaptiveStrategyDriver
 from kungfu_tpu.plan import Cluster, PeerList, Strategy
+
+from tests._util import run_all as _shared_run_all
 
 DELAY_S = 0.03  # per-send injected latency; must dominate 1-core scheduling noise
 PORTS = "127.0.0.1:27401,127.0.0.1:27402,127.0.0.1:27403"
@@ -47,23 +48,7 @@ class TestAdaptationPayoff:
             p.close()
 
     def run_all(self, fns, timeout=120):
-        outs = [None] * len(fns)
-        errs = []
-
-        def wrap(i, fn):
-            try:
-                outs[i] = fn()
-            except BaseException as e:  # noqa: BLE001
-                errs.append(e)
-
-        ts = [threading.Thread(target=wrap, args=(i, f)) for i, f in enumerate(fns)]
-        for t in ts:
-            t.start()
-        for t in ts:
-            t.join(timeout)
-        if errs:
-            raise errs[0]
-        return outs
+        return _shared_run_all(fns, timeout=timeout)
 
     @staticmethod
     def _throttle_link(peer, other_spec: str):

@@ -11,6 +11,8 @@ from kungfu_tpu.comm.engine import CollectiveEngine, build_strategy_graphs
 from kungfu_tpu.comm.host import HostChannel
 from kungfu_tpu.plan import PeerID, PeerList, Strategy
 
+from tests._util import run_all as _shared_run_all
+
 BASE_PORT = 25000
 _port_gen = [BASE_PORT]
 
@@ -27,22 +29,7 @@ def make_cluster(n, hosts=1):
 
 
 def run_all(fns, timeout=60):
-    errors, results = [], [None] * len(fns)
-
-    def wrap(i, f):
-        try:
-            results[i] = f()
-        except Exception as e:  # noqa: BLE001
-            errors.append(e)
-
-    ts = [threading.Thread(target=wrap, args=(i, f)) for i, f in enumerate(fns)]
-    for t in ts:
-        t.start()
-    for t in ts:
-        t.join(timeout=timeout)
-    if errors:
-        raise errors[0]
-    return results
+    return _shared_run_all(fns, timeout=timeout)
 
 
 ALL_STRATEGIES = [s for s in Strategy if s != Strategy.AUTO]

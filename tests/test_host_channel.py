@@ -20,6 +20,8 @@ from kungfu_tpu.native import transport as native_transport
 from kungfu_tpu.plan import PeerID, PeerList
 from kungfu_tpu.store.store import Store, VersionedStore
 
+from tests._util import run_all as _shared_run_all
+
 
 BASE_PORT = 21000
 
@@ -54,23 +56,7 @@ def channels(request):
 
 def run_all(fns):
     """Run one closure per simulated peer concurrently; re-raise errors."""
-    errors = []
-    results = [None] * len(fns)
-
-    def wrap(i, f):
-        try:
-            results[i] = f()
-        except Exception as e:  # noqa: BLE001
-            errors.append(e)
-
-    threads = [threading.Thread(target=wrap, args=(i, f)) for i, f in enumerate(fns)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join(timeout=30)
-    if errors:
-        raise errors[0]
-    return results
+    return _shared_run_all(fns, timeout=30)
 
 
 class TestHostChannel:

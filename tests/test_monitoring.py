@@ -2,7 +2,6 @@
 set_tree, interference (reference test_tensorflow_throughput_monitoring.py
 / test_set_tree.py analogs)."""
 
-import threading
 import time
 import urllib.request
 
@@ -11,6 +10,8 @@ import pytest
 
 from kungfu_tpu.monitor.metrics import MetricsServer, NetMonitor
 from kungfu_tpu.plan.mst import minimum_spanning_tree
+
+from tests._util import run_all as _shared_run_all
 
 
 class TestNetMonitor:
@@ -82,22 +83,7 @@ class TestAdaptIntegration:
             p.close()
 
     def run_all(self, fns, timeout=60):
-        errs, results = [], [None] * len(fns)
-
-        def wrap(i, f):
-            try:
-                results[i] = f()
-            except Exception as e:  # noqa: BLE001
-                errs.append(e)
-
-        ts = [threading.Thread(target=wrap, args=(i, f)) for i, f in enumerate(fns)]
-        for t in ts:
-            t.start()
-        for t in ts:
-            t.join(timeout=timeout)
-        if errs:
-            raise errs[0]
-        return results
+        return _shared_run_all(fns, timeout=timeout)
 
     def test_latencies(self, peers):
         lats = peers[0].get_peer_latencies()

@@ -2,7 +2,6 @@
 (.github/workflows/pytorch.yaml: torch_simple_example.py + test_torch_ops.py
 under np 1..4), here driven in-process over multi-engine thread clusters."""
 
-import threading
 
 import numpy as np
 import pytest
@@ -14,6 +13,8 @@ from kungfu_tpu.comm.host import HostChannel
 from kungfu_tpu.plan import PeerID, PeerList, Strategy
 from kungfu_tpu.torch.ops import clib, collective
 from kungfu_tpu.torch.optimizers.sync_sgd import SynchronousSGDOptimizer
+
+from tests._util import run_all as _shared_run_all
 
 _port = [27000]
 
@@ -28,22 +29,7 @@ def make_engines(n):
 
 
 def run_all(fns, timeout=60):
-    errors, results = [], [None] * len(fns)
-
-    def wrap(i, f):
-        try:
-            results[i] = f()
-        except Exception as e:  # noqa: BLE001
-            errors.append(e)
-
-    ts = [threading.Thread(target=wrap, args=(i, f)) for i, f in enumerate(fns)]
-    for t in ts:
-        t.start()
-    for t in ts:
-        t.join(timeout=timeout)
-    if errors:
-        raise errors[0]
-    return results
+    return _shared_run_all(fns, timeout=timeout)
 
 
 def close_all(engines, chans):
