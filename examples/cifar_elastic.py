@@ -24,8 +24,13 @@ Run (2 provisioned slots, grow 1→2 mid-job)::
 
 from __future__ import annotations
 
-import argparse
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+import argparse
 
 import jax
 import numpy as np

@@ -22,9 +22,13 @@ spans exactly the active set and that worker 0's PID never changes.
 
 from __future__ import annotations
 
-import argparse
 import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+import argparse
 import time
 
 import numpy as np

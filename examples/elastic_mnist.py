@@ -14,8 +14,13 @@ Run (watch mode + builtin config server)::
 
 from __future__ import annotations
 
-import argparse
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+import argparse
 
 import jax
 import jax.numpy as jnp

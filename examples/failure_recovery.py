@@ -15,9 +15,13 @@ Run::
 
 from __future__ import annotations
 
-import argparse
 import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+import argparse
 
 import jax
 import jax.numpy as jnp

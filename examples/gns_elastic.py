@@ -26,6 +26,12 @@ Run (grow 1→2 when the noise scale rises)::
 
 from __future__ import annotations
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
 import argparse
 
 import jax

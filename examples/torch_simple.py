@@ -5,6 +5,11 @@ launcher with np 1..4).
     python -m kungfu_tpu.runner.cli -np 2 python3 examples/torch_simple.py
 """
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import argparse
 
 import torch

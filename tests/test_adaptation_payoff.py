@@ -23,7 +23,7 @@ import pytest
 from kungfu_tpu.monitor.adaptive import AdaptiveStrategyDriver
 from kungfu_tpu.plan import Cluster, PeerList, Strategy
 
-from tests._util import run_all as _shared_run_all
+from tests._util import run_all
 
 DELAY_S = 0.03  # per-send injected latency; must dominate 1-core scheduling noise
 PORTS = "127.0.0.1:27401,127.0.0.1:27402,127.0.0.1:27403"
@@ -47,8 +47,6 @@ class TestAdaptationPayoff:
         for p in ps:
             p.close()
 
-    def run_all(self, fns, timeout=120):
-        return _shared_run_all(fns, timeout=timeout)
 
     @staticmethod
     def _throttle_link(peer, other_spec: str):
@@ -90,7 +88,7 @@ class TestAdaptationPayoff:
         def run_steps(n):
             times, swaps = [], []
             for _ in range(n):
-                outs = self.run_all(
+                outs = run_all(
                     [lambda p=p, d=d: step(p, d) for p, d in zip(peers, drivers)]
                 )
                 for o, _, _ in outs:

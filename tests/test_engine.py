@@ -11,7 +11,7 @@ from kungfu_tpu.comm.engine import CollectiveEngine, build_strategy_graphs
 from kungfu_tpu.comm.host import HostChannel
 from kungfu_tpu.plan import PeerID, PeerList, Strategy
 
-from tests._util import run_all as _shared_run_all
+from tests._util import run_all
 
 BASE_PORT = 25000
 _port_gen = [BASE_PORT]
@@ -28,8 +28,6 @@ def make_cluster(n, hosts=1):
     return peers, chans
 
 
-def run_all(fns, timeout=60):
-    return _shared_run_all(fns, timeout=timeout)
 
 
 ALL_STRATEGIES = [s for s in Strategy if s != Strategy.AUTO]

@@ -20,7 +20,7 @@ from kungfu_tpu.native import transport as native_transport
 from kungfu_tpu.plan import PeerID, PeerList
 from kungfu_tpu.store.store import Store, VersionedStore
 
-from tests._util import run_all as _shared_run_all
+from tests._util import run_all
 
 
 BASE_PORT = 21000
@@ -54,9 +54,6 @@ def channels(request):
         c.close()
 
 
-def run_all(fns):
-    """Run one closure per simulated peer concurrently; re-raise errors."""
-    return _shared_run_all(fns, timeout=30)
 
 
 class TestHostChannel:

@@ -11,7 +11,7 @@ import pytest
 from kungfu_tpu.monitor.metrics import MetricsServer, NetMonitor
 from kungfu_tpu.plan.mst import minimum_spanning_tree
 
-from tests._util import run_all as _shared_run_all
+from tests._util import run_all
 
 
 class TestNetMonitor:
@@ -82,8 +82,6 @@ class TestAdaptIntegration:
         for p in ps:
             p.close()
 
-    def run_all(self, fns, timeout=60):
-        return _shared_run_all(fns, timeout=timeout)
 
     def test_latencies(self, peers):
         lats = peers[0].get_peer_latencies()
@@ -94,7 +92,7 @@ class TestAdaptIntegration:
     def test_latency_matrix_and_mst(self, peers):
         from kungfu_tpu.monitor.adapt import latency_matrix
 
-        mats = self.run_all([lambda p=p: latency_matrix(p) for p in peers])
+        mats = run_all([lambda p=p: latency_matrix(p) for p in peers])
         for m in mats:
             assert m.shape == (3, 3)
         f = minimum_spanning_tree(mats[0])
@@ -108,13 +106,13 @@ class TestAdaptIntegration:
             out = p.engine().all_reduce(np.full(4, val, np.float32))
             return out
 
-        outs = self.run_all([lambda p=p, v=v: one(p, float(v)) for v, p in enumerate(peers)])
+        outs = run_all([lambda p=p, v=v: one(p, float(v)) for v, p in enumerate(peers)])
         for o in outs:
             np.testing.assert_allclose(o, np.full(4, 3.0))  # 0+1+2
 
     def test_interference_vote(self, peers):
         # no throughput data -> no interference
-        outs = self.run_all([lambda p=p: p.check_interference() for p in peers])
+        outs = run_all([lambda p=p: p.check_interference() for p in peers])
         assert outs == [False, False, False]
 
     def test_adaptive_driver_swaps_on_interference(self, peers):
@@ -142,7 +140,7 @@ class TestAdaptIntegration:
 
         # healthy step: establishes the reference window; the first check
         # can never flag (window == freshly-recorded best)
-        outs = self.run_all([lambda p=p, d=d: train_step(p, d) for p, d in zip(peers, drivers)])
+        outs = run_all([lambda p=p, d=d: train_step(p, d) for p, d in zip(peers, drivers)])
         assert not any(s for _, s in outs)
 
         # pin the recorded best far above anything this machine can do —
@@ -166,7 +164,7 @@ class TestAdaptIntegration:
         try:
             swapped_anywhere = False
             for _ in range(3):
-                outs = self.run_all(
+                outs = run_all(
                     [lambda p=p, d=d: train_step(p, d) for p, d in zip(peers, drivers)],
                     timeout=120,
                 )
@@ -185,7 +183,7 @@ class TestAdaptIntegration:
             for ch, orig in originals:
                 ch.send = orig
         # post-swap collectives remain correct at full speed
-        outs = self.run_all(
+        outs = run_all(
             [lambda p=p: p.engine().all_reduce(np.full(5, 2.0, np.float32)) for p in peers]
         )
         for o in outs:
@@ -208,7 +206,7 @@ class TestAdaptIntegration:
             try:
                 engines = [p.engine() for p in ps]
                 data = np.ones(1000, np.float32)
-                self.run_all([lambda e=e: e.all_reduce(data) for e in engines])
+                run_all([lambda e=e: e.all_reduce(data) for e in engines])
                 # native-backend egress arrives via the counter poll thread
                 deadline = time.time() + 5
                 while time.time() < deadline:

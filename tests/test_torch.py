@@ -14,7 +14,7 @@ from kungfu_tpu.plan import PeerID, PeerList, Strategy
 from kungfu_tpu.torch.ops import clib, collective
 from kungfu_tpu.torch.optimizers.sync_sgd import SynchronousSGDOptimizer
 
-from tests._util import run_all as _shared_run_all
+from tests._util import run_all
 
 _port = [27000]
 
@@ -28,8 +28,6 @@ def make_engines(n):
     return engines, chans
 
 
-def run_all(fns, timeout=60):
-    return _shared_run_all(fns, timeout=timeout)
 
 
 def close_all(engines, chans):
