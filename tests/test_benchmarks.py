@@ -53,6 +53,12 @@ class TestHarnesses:
         assert out["metric"] == "resize_transition_latency"
         assert len(out["transitions"]) >= 2
 
+    def test_system_vgg(self):
+        out = run_bench("system.py", "--model", "vgg16",
+                        "--optimizer", "sync-sgd", "--cpu-mesh", "2")
+        assert out["metric"] == "vgg16_sync-sgd_throughput"
+        assert out["value"] > 0 and out["unit"] == "images/sec"
+
     def test_system_bert_sma(self):
         """BASELINE config 3: BERT-base-shaped + SynchronousAveraging."""
         out = run_bench("system.py", "--model", "bert", "--optimizer", "sma",
