@@ -91,10 +91,14 @@ def batchnorm_apply(p, stats, x, train: bool, momentum=0.9, eps=1e-5, axis_name=
     if train:
         axes = tuple(range(x.ndim - 1))
         mean = jnp.mean(xf, axes)
-        var = jnp.mean(jnp.square(xf), axes) - jnp.square(mean)
+        m2 = jnp.mean(jnp.square(xf), axes)
         if axis_name is not None:
+            # sync-BN: average the raw moments, THEN form the variance —
+            # pmean of per-shard variances drops the cross-shard mean
+            # spread (E[var_s] != E[x^2] - E[x]^2 when shard means differ)
             mean = jax.lax.pmean(mean, axis_name)
-            var = jax.lax.pmean(var, axis_name)
+            m2 = jax.lax.pmean(m2, axis_name)
+        var = m2 - jnp.square(mean)
         new_stats = {
             "mean": momentum * stats["mean"] + (1 - momentum) * mean,
             "var": momentum * stats["var"] + (1 - momentum) * var,

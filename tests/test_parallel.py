@@ -305,6 +305,35 @@ def test_graft_entry_dryrun():
     ge.dryrun_multichip(8)
 
 
+def test_sync_batchnorm_matches_big_batch():
+    """BN with axis_name over a dp mesh must equal single-device BN on
+    the concatenated batch — both the normalized output and the running
+    stats (the whole point of sync-BN; a per-shard-stats bug converges
+    differently at scale and is invisible to loss-goes-down tests)."""
+    from kungfu_tpu.models import nn as knn
+
+    n_dp = 4
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.standard_normal((8, 6, 6, 3)), jnp.float32)
+    p = knn.batchnorm_init(3)
+    st = knn.batchnorm_state_init(3)
+
+    ref_y, ref_stats = knn.batchnorm_apply(p, st, x, train=True)
+
+    mesh = Mesh(np.array(jax.devices()[:n_dp]), ("dp",))
+    f = shard_map(
+        lambda xs: knn.batchnorm_apply(p, st, xs, train=True, axis_name="dp"),
+        mesh=mesh, in_specs=P("dp"), out_specs=(P("dp"), P()),
+    )
+    y, stats = jax.jit(f)(x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref_y), rtol=2e-5, atol=2e-5)
+    for k in ("mean", "var"):
+        np.testing.assert_allclose(
+            np.asarray(stats[k]), np.asarray(ref_stats[k]), rtol=2e-5, atol=2e-5,
+            err_msg=f"running {k} diverged from big-batch BN",
+        )
+
+
 class TestDPTrainStep:
     """dp_train_step: the DP-only helper over a Communicator mesh."""
 
