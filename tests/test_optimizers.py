@@ -191,6 +191,51 @@ class TestMonitors:
         _, var_diff = per_peer(comm, step)(params0, diff)
         assert float(np.asarray(var_diff)[0]) > 1e-3
 
+    def test_variance_matches_numpy(self, comm):
+        """Exactness vs the definition E_i |g_i - g_avg|^2 computed in
+        numpy — transcription errors in cross-replica statistics are
+        invisible to zero/nonzero smoke checks (the sync-BN variance bug
+        shipped through exactly that gap)."""
+        from kungfu_tpu.ops.monitor import group_all_reduce_with_variance
+
+        grads = stacked((7,), seed=3)
+
+        def f(g):
+            avg, var = group_all_reduce_with_variance(g, comm.axis)
+            return avg, var[None]
+
+        avg, var = per_peer(comm, f)(grads)
+        want_avg = grads.mean(axis=0)
+        want_var = np.mean([np.sum((g - want_avg) ** 2) for g in grads])
+        np.testing.assert_allclose(np.asarray(avg)[0], want_avg, rtol=1e-5)
+        np.testing.assert_allclose(float(np.asarray(var)[0]), want_var, rtol=1e-4)
+
+    def test_gns_matches_formula(self, comm):
+        """Exactness vs the two-batch estimator (OpenAI GNS appendix):
+        |G|^2 = (B|g_B|^2 - b|g_b|^2)/(B - b), S = (|g_b|^2 - |g_B|^2) /
+        (1/b - 1/B), GNS = S/|G|^2, with |g_b|^2 peer-averaged."""
+        from kungfu_tpu.ops.monitor import global_noise_scale
+
+        b_small = 16
+        grads = stacked((9,), seed=4)
+
+        def gns_fn(g):
+            import kungfu_tpu.ops.collective as kc
+            avg = kc.all_reduce(g, comm.axis, op="mean")
+            return global_noise_scale(g, avg, b_small, comm.axis)[None]
+
+        got = float(np.asarray(per_peer(comm, gns_fn)(grads))[0])
+
+        n = grads.shape[0]
+        b_big = b_small * n
+        avg = grads.mean(axis=0)
+        g_small_sq = np.mean([np.sum(g * g) for g in grads])
+        g_big_sq = np.sum(avg * avg)
+        g2 = (b_big * g_big_sq - b_small * g_small_sq) / (b_big - b_small)
+        s = (g_small_sq - g_big_sq) / (1.0 / b_small - 1.0 / b_big)
+        want = s / abs(g2)
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
 
 class TestPairAveraging:
     def test_single_process_gossip_loop(self):
