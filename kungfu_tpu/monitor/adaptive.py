@@ -65,6 +65,7 @@ class AdaptiveStrategyDriver:
         threshold: float = INTERFERENCE_THRESHOLD,
         use_mst: bool = False,
         min_steps_between_swaps: int = 2,
+        consecutive_drops: int = 2,
     ):
         self.peer = peer
         self.check_every = max(1, check_every)
@@ -72,6 +73,11 @@ class AdaptiveStrategyDriver:
         self.threshold = threshold
         self.use_mst = use_mst
         self.min_checks_between_swaps = max(1, min_steps_between_swaps)
+        #: windows below threshold required back-to-back before this rank
+        #: votes "interference" — one noisy window (GC pause, CI box
+        #: contention) must not trigger a cluster-wide topology swap
+        self.consecutive_drops = max(1, consecutive_drops)
+        self._drops = 0
         self._step = 0
         self._checks_since_swap = self.min_checks_between_swaps
         self._alt_idx = 0  # rotation cursor over `alternatives`
@@ -87,9 +93,11 @@ class AdaptiveStrategyDriver:
         engine = self.peer.engine()
         if engine is None:
             return False
-        suspected = bool(
+        dropped = bool(
             check_interference(engine, threshold=self.threshold)
         )
+        self._drops = self._drops + 1 if dropped else 0
+        suspected = self._drops >= self.consecutive_drops
         # the vote is an allreduce: every rank computes the same verdict
         agreed = majority_vote_interference(self.peer, suspected)
         self._checks_since_swap += 1
@@ -99,8 +107,13 @@ class AdaptiveStrategyDriver:
             # hysteresis: a fresh strategy needs a window to establish its
             # own best before it can be judged (prevents swap thrash)
             return False
-        self._swap(engine)
+        if not self._swap(engine):
+            # agreed interference but nothing to swap to (e.g. the only
+            # alternative is already installed): report no swap, keep the
+            # suspicion state — callers must not see phantom swaps
+            return False
         self._checks_since_swap = 0
+        self._drops = 0
         self.swaps += 1
         return True
 
@@ -118,7 +131,8 @@ class AdaptiveStrategyDriver:
                 return s
         return None
 
-    def _swap(self, engine) -> None:
+    def _swap(self, engine) -> bool:
+        """Returns whether a topology/strategy change was installed."""
         if self.use_mst:
             # min-of-3 pings per edge: one sample is corruptible by a
             # scheduler spike on a loaded box (observed: a 30 ms-throttled
@@ -129,11 +143,11 @@ class AdaptiveStrategyDriver:
             # identical MST; peer.set_tree does consensus + barrier fencing
             self.peer.set_tree(forest)
             _log.info("interference: installed latency-MST tree %s", forest)
-            return
+            return True
         target = self._next_strategy(engine)
         if target is None:
             _log.warning("interference agreed but no alternative strategy")
-            return
+            return False
         # fencing (reference adaptation.go:8-28): consensus on the proposed
         # strategy, barrier, swap
         digest = f"strategy:{target.name}".encode()
@@ -144,6 +158,7 @@ class AdaptiveStrategyDriver:
         self.peer.barrier()
         engine.set_strategy(target)
         _log.info("interference: swapped strategy to %s", target.name)
+        return True
 
 
 def monitored_all_reduce(engine, x: np.ndarray, driver: AdaptiveStrategyDriver,

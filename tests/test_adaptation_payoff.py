@@ -99,9 +99,20 @@ class TestAdaptationPayoff:
                 swaps.append(flags.pop())
             return times, swaps
 
-        # healthy phase: establish each peer's best-throughput window
-        healthy, swaps = run_steps(3)
-        assert not any(swaps)
+        # healthy phase: establish each peer's best-throughput window.
+        # A spurious swap needs 2 consecutive degraded windows + majority
+        # on an unthrottled cluster: retry once — a load spike on the CI
+        # box passes the second attempt, while a driver regression that
+        # always votes interference fails BOTH attempts (and the test)
+        for attempt in range(2):
+            healthy, swaps = run_steps(3)
+            if not any(swaps):
+                break
+        else:
+            pytest.fail(
+                "interference voted on a healthy cluster in two separate "
+                "3-step phases — trigger-happy driver, not CI-box noise"
+            )
 
         # degrade the 0<->1 link on both endpoints
         restores = [
