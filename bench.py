@@ -47,6 +47,33 @@ RETRY_BACKOFF_S = 20.0
 # guarded runner: payload in a subprocess, retried, JSON-or-error contract
 # --------------------------------------------------------------------------
 
+def backend_preflight(timeout=150.0, attempts=2, cpu=False):
+    """Cheap probe: can a fresh process enumerate devices at all?  A
+    wedged TPU tunnel hangs backend init indefinitely — without this,
+    every payload attempt burns its full 900 s timeout and the driver
+    waits ~45 min to learn the chip was never reachable."""
+    if cpu:
+        return None  # CPU backend can't wedge
+    code = "import jax; jax.devices(); print('ok')"
+    last = ""
+    for attempt in range(attempts):
+        if attempt:
+            time.sleep(RETRY_BACKOFF_S)
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code], capture_output=True,
+                text=True, timeout=timeout, cwd=REPO,
+            )
+            if r.returncode == 0 and "ok" in r.stdout:
+                return None
+            last = (r.stderr or r.stdout).strip().splitlines()[-1:] or ["?"]
+            last = last[0][-300:]
+        except subprocess.TimeoutExpired:
+            last = f"device enumeration hung >{timeout:.0f}s (tunnel wedged?)"
+        print(f"bench: preflight attempt {attempt} failed: {last}", file=sys.stderr)
+    return last
+
+
 def run_guarded(payload_args, attempts=PAYLOAD_ATTEMPTS, timeout=PAYLOAD_TIMEOUT_S):
     """Run ``bench.py <payload_args>`` in a subprocess; return the parsed
     JSON object from its last stdout line, or an error dict after all
@@ -522,7 +549,20 @@ def main() -> None:
     if args.cpu:
         fwd.append("--cpu")
 
-    out = run_guarded(fwd, timeout=args.timeout)
+    # CPU paths can't wedge; only probe when the payload would touch the
+    # TPU backend.  A slow-but-alive tunnel (probe timeout but the user
+    # raised --timeout expecting slowness) still gets ONE payload attempt
+    # — the preflight exists to avoid 3 x 900 s on a dead tunnel, not to
+    # veto measurements.
+    pre_err = backend_preflight(cpu=args.cpu or bool(args.cpu_mesh))
+    if pre_err is None:
+        out = run_guarded(fwd, timeout=args.timeout)
+    elif "hung" in pre_err and args.timeout > PAYLOAD_TIMEOUT_S:
+        out = run_guarded(fwd, attempts=1, timeout=args.timeout)
+        if "error" in out and "metric" not in out:
+            out["error"] = f"preflight: {pre_err}; payload: " + out["error"]
+    else:
+        out = {"error": f"backend preflight failed: {pre_err}"}
     if "error" in out and "metric" not in out:
         # keep the one-JSON-line contract even in total failure
         out = {
