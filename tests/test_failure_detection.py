@@ -174,10 +174,14 @@ class TestAutoRecoveryCLI:
         detector must flag it via the heartbeat timeout (not process exit)
         and the restart round must restore + finish."""
         r = subprocess.run(
-            [sys.executable, "-m", "kungfu_tpu.runner.cli", "-auto-recover", "3s",
+            [sys.executable, "-m", "kungfu_tpu.runner.cli", "-auto-recover", "5s",
              "-np", "2", sys.executable, "examples/failure_recovery.py",
              "--n-epochs", "3", "--hang-at-epoch", "1",
              "--ckpt-dir", str(tmp_path)],
+            # 5s period, not 3: a CPU-starved batch on a loaded 1-core box
+            # can legitimately exceed 3s, and a begin-without-end past the
+            # period reads as a hang — the detector then restarts BEFORE
+            # the simulated stall, failing the 'simulating stall' assert
             cwd=REPO, capture_output=True, text=True, timeout=350, env=self._env(),
         )
         assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
