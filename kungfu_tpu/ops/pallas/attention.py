@@ -48,8 +48,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+#: caps for the adaptive default block shape (see :func:`_default_blocks`).
+#: A round-3 interleaved min-of-8 sweep on v5e (benchmarks/flash_sweep.py,
+#: B4 H8 S2048 D128 causal) is monotonic in block_k: (128,128) 2.60 ms →
+#: (256,1024) 0.34 ms fwd (7.7x, 101 TFLOP/s).  Large K/V tiles amortize
+#: the per-grid-step overhead and keep the MXU fed; 16 MB VMEM fits
+#: (256,1024) at D=128 with ~2.7 MB to spare.
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 1024
 _NEG_INF = -1e30
 #: per-row scalars (lse, delta) cross the pallas_call boundary replicated
 #: across one full lane width — Mosaic's tiling only accepts (8k, 128)
@@ -512,13 +518,39 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _default_blocks(s: int, block_q, block_k):
+    """Resolve ``None`` block sizes: the largest power-of-two tile up to
+    the capped default whose sequence padding stays proportionate — a big
+    tile only pays off when it isn't mostly padding (S=1152 with a 1024
+    block would pad to 2048 and nearly double the tile traffic; it gets
+    256 → pad 1280).  Power-of-two choices keep block_q | block_k (or
+    vice versa), so the pad length is just max(block_q, block_k)-aligned.
+    """
+    n = ((max(s, 1) + 127) // 128) * 128
+    # tolerate up to ~25% padded rows (and never a whole extra 128-tile
+    # on short sequences — the 127 keeps n=128 at a 128 block)
+    allowance = max(n // 4, 127)
+
+    def pick(cap):
+        for opt in (1024, 512, 256, 128):
+            if opt <= cap and ((n + opt - 1) // opt) * opt - n <= allowance:
+                return opt
+        return 128
+
+    if block_q is None:
+        block_q = pick(DEFAULT_BLOCK_Q)
+    if block_k is None:
+        block_k = pick(DEFAULT_BLOCK_K)
+    return block_q, block_k
+
+
 def flash_attention(
     q,
     k,
     v,
     causal: bool = True,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ):
     """Fused attention for [B, H, S, D] (or [BH, S, D]) operands.
@@ -526,10 +558,12 @@ def flash_attention(
     Differentiable; numerically matches
     :func:`kungfu_tpu.models.transformer.default_attention` (softmax in
     f32).  ``interpret=None`` auto-selects interpreter mode off-TPU so
-    the same call works on the CPU test cluster.
+    the same call works on the CPU test cluster.  ``block_q``/``block_k``
+    default to the swept TPU tiles (:func:`_default_blocks`).
     """
     if interpret is None:
         interpret = _use_interpret()
+    block_q, block_k = _default_blocks(q.shape[-2], block_q, block_k)
     if q.ndim == 3:
         return _flash(q, k, v, causal, block_q, block_k, interpret)
     if q.ndim != 4:
@@ -549,8 +583,8 @@ def flash_attention_with_lse(
     k,
     v,
     causal: bool = True,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ):
     """Fused attention returning ``(out, lse)`` for [BH, S, D] operands.
@@ -564,10 +598,11 @@ def flash_attention_with_lse(
         interpret = _use_interpret()
     if q.ndim != 3:
         raise ValueError(f"expected [BH, S, D], got {q.shape}")
+    block_q, block_k = _default_blocks(q.shape[-2], block_q, block_k)
     return _flash_pair(q, k, v, causal, block_q, block_k, interpret)
 
 
-def make_flash_attn(block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K):
+def make_flash_attn(block_q: Optional[int] = None, block_k: Optional[int] = None):
     """Adapter for the ``attn_fn(q, k, v, causal)`` slot of
     :meth:`kungfu_tpu.models.transformer.Transformer.apply`."""
 
