@@ -11,11 +11,11 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_bench(script, *args, timeout=300):
+def run_bench(script, *args, timeout=300, subdir="benchmarks"):
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
     r = subprocess.run(
-        [sys.executable, os.path.join(REPO, "benchmarks", script), "--quick", *args],
+        [sys.executable, os.path.join(REPO, subdir, script), "--quick", *args],
         capture_output=True, text=True, timeout=timeout, cwd=REPO, env=env,
     )
     assert r.returncode == 0, r.stdout + r.stderr
@@ -73,3 +73,63 @@ class TestHarnesses:
                         "--base-port", "28700")
         assert out["metric"] == "pair_averaging_gossip_steps_per_sec"
         assert out["value"] > 0 and out["np"] == 2
+
+
+class TestMeasureGroup:
+    """bench.py's interleaved chained-K timing harness (the relay-burst
+    defense every recorded TPU ratio rides on)."""
+
+    @staticmethod
+    def _measure_group():
+        sys.path.insert(0, REPO)
+        from bench import measure_group
+
+        return measure_group
+
+    def test_times_every_contestant(self):
+        measure_group = self._measure_group()
+        import jax.numpy as jnp
+
+        t = measure_group(
+            {"a": lambda c: c * 1.0001, "b": lambda c: c * 1.0002},
+            jnp.ones((8,)), k_lo=1, k_hi=3, rounds=1,
+        )
+        assert set(t) == {"a", "b"}
+        assert all(v > 0 for v in t.values())
+
+    def test_on_error_skip_maps_to_none(self):
+        measure_group = self._measure_group()
+        import jax.numpy as jnp
+
+        def boom(c):
+            raise RuntimeError("does not lower")
+
+        t = measure_group(
+            {"ok": lambda c: c * 1.0001, "bad": boom},
+            jnp.ones((8,)), k_lo=1, k_hi=2, rounds=1, on_error="skip",
+        )
+        assert t["bad"] is None and t["ok"] > 0
+
+    def test_on_error_raise_propagates(self):
+        measure_group = self._measure_group()
+        import jax.numpy as jnp
+
+        def boom(c):
+            raise RuntimeError("does not lower")
+
+        with pytest.raises(RuntimeError):
+            measure_group({"bad": boom}, jnp.ones((8,)), k_lo=1, k_hi=2)
+
+
+@pytest.mark.slow
+class TestBenchPayloads:
+    def test_lm_quick(self):
+        """bench.py --lm: the kernels-in-anger payload, CPU/interpret."""
+        out = run_bench("bench.py", "--payload", "lm", "--cpu",
+                        "--steps", "2", timeout=420, subdir="")
+        assert out["metric"] == "gpt_small_sync_sgd_tokens_per_sec_per_chip"
+        assert out["value"] > 0 and out["unit"] == "tokens/sec"
+        # vs_baseline is t_xla / t_pallas (the kernel path's speedup; <1
+        # expected in CPU interpret mode), not a reference baseline
+        assert out["vs_baseline"] > 0
+        assert out["final_loss"] is not None

@@ -241,3 +241,36 @@ class TestFusedCrossEntropy:
         monkeypatch.setenv("KF_TPU_XENT", "fused")
         got = model.loss(params, batch)
         np.testing.assert_allclose(float(ref), float(got), atol=1e-5)
+
+
+class TestDefaultBlocks:
+    """Adaptive flash block resolution (round-3 v5e sweep: big K/V tiles,
+    but never mostly-padding ones)."""
+
+    def test_sweep_winners_at_long_seq(self):
+        from kungfu_tpu.ops.pallas.attention import _default_blocks
+
+        assert _default_blocks(2048, None, None) == (256, 1024)
+        assert _default_blocks(8192, None, None) == (256, 1024)
+
+    def test_short_seq_never_pads_a_whole_tile(self):
+        from kungfu_tpu.ops.pallas.attention import _default_blocks
+
+        assert _default_blocks(128, None, None) == (128, 128)
+        assert _default_blocks(100, None, None) == (128, 128)
+        assert _default_blocks(300, None, None) == (128, 128)
+
+    def test_padding_allowance_caps_waste(self):
+        from kungfu_tpu.ops.pallas.attention import _default_blocks
+
+        # S=1152 with a 1024 block would pad to 2048 (~78% waste)
+        bq, bk = _default_blocks(1152, None, None)
+        assert bk <= 256
+        # allowance scales with S: 1536 tolerates a 512 tile, not 1024
+        assert _default_blocks(1536, None, None)[1] == 512
+
+    def test_explicit_blocks_pass_through(self):
+        from kungfu_tpu.ops.pallas.attention import _default_blocks
+
+        assert _default_blocks(2048, 32, 64) == (32, 64)
+        assert _default_blocks(2048, None, 64) == (256, 64)
