@@ -1,0 +1,86 @@
+#!/usr/bin/env python3
+"""Block-size sweep for the fused-xent Pallas kernels on the local chip.
+
+Same methodology as flash_sweep.py: all candidates compiled once, timed
+via bench.py's measure_group (interleaved rounds, per-program running
+min) so relay congestion bursts can't land on one candidate.
+
+    python benchmarks/xent_sweep.py [--bwd] [--rounds 8] [--n 8192] [--v 32768]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import measure_group  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=8192)
+    p.add_argument("--v", type=int, default=32768)
+    p.add_argument("--bwd", action="store_true")
+    p.add_argument("--rounds", type=int, default=8)
+    p.add_argument("--blocks", type=str, default="",
+                   help="comma list of bn:bv pairs")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kungfu_tpu.ops.pallas.xent import softmax_cross_entropy
+
+    N, V = args.n, args.v
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.standard_normal((N, V)), jnp.bfloat16)
+    targets = jnp.asarray(rng.integers(0, V, N), jnp.int32)
+    # bytes one iteration must move: fwd reads the logits once; the bwd
+    # chain re-reads them, writes dlogits, and the epilogue reads+writes
+    # the logits again (all bf16)
+    gb = N * V * 2 * (5 if args.bwd else 1) / 1e9
+
+    if args.blocks:
+        pairs = [tuple(int(x) for x in pair.split(":"))
+                 for pair in args.blocks.split(",")]
+    else:
+        pairs = [(bn, bv)
+                 for bn in (128, 256, 512, 1024)
+                 for bv in (1024, 2048, 4096, 8192)]
+
+    def make_step(bn, bv):
+        if args.bwd:
+            def step(lg):
+                dl = jax.grad(
+                    lambda x: softmax_cross_entropy(x, targets,
+                                                    block_n=bn, block_v=bv).mean()
+                )(lg)
+                return (lg - 0.1 * dl).astype(lg.dtype)
+        else:
+            def step(lg):
+                return lg + softmax_cross_entropy(
+                    lg, targets, block_n=bn, block_v=bv
+                ).mean().astype(lg.dtype)
+        return step
+
+    times = measure_group(
+        {f"{bn}:{bv}": make_step(bn, bv) for bn, bv in pairs},
+        logits, rounds=args.rounds, on_error="skip",
+    )
+    for name, t in times.items():
+        bn, bv = (int(x) for x in name.split(":"))
+        row = {"block_n": bn, "block_v": bv, "n": N, "v": V, "bwd": args.bwd}
+        if t is None:
+            row["error"] = "did not compile (see stderr)"
+        else:
+            row.update(ms=round(t * 1e3, 3), gb_s=round(gb / t, 1))
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -43,10 +43,17 @@ _LANES = 128
 
 
 def _fwd_kernel(logits_ref, targets_ref, loss_ref, lse_ref, m_ref, l_ref,
-                t_ref, *, vocab, block_v):
+                t_ref, *, vocab, block_v, masked):
     """Grid = (row blocks, vocab blocks), vocab innermost.  One [block_n,
     block_v] logits tile lives in VMEM at a time; the online max/sumexp/
-    target accumulators persist in scratch across the vocab sweep."""
+    target accumulators persist in scratch across the vocab sweep.
+
+    ``masked`` is a compile-time flag, False whenever block_v divides the
+    vocab — the tail-mask compare/selects then vanish from the hot loop.
+    The target logit is accumulated IN the sweep: a round-3 experiment
+    moved it to an XLA gather outside the kernel and lost 2x — a
+    take_along_axis over [8k, 32k] costs 1.7-4.3 ms on v5e (TPU gathers
+    serialize), dwarfing the per-element compare it saved."""
     j = pl.program_id(1)
     n_v = pl.num_programs(1)
     blk = logits_ref[...].astype(jnp.float32)  # [block_n, block_v]
@@ -60,13 +67,15 @@ def _fwd_kernel(logits_ref, targets_ref, loss_ref, lse_ref, m_ref, l_ref,
         t_ref[...] = jnp.zeros_like(t_ref)
 
     k_pos = j * block_v + jax.lax.broadcasted_iota(jnp.int32, (n, block_v), 1)
-    valid = k_pos < vocab
-    blk = jnp.where(valid, blk, _NEG_INF)
+    if masked:
+        # one select suffices: exp(_NEG_INF - m_new) underflows to exactly
+        # 0, so the sum needs no second mask
+        blk = jnp.where(k_pos < vocab, blk, _NEG_INF)
     m = m_ref[...]
     m_new = jnp.maximum(m, jnp.max(blk, axis=-1, keepdims=True))
     corr = jnp.exp(m - m_new)
     l_new = l_ref[...] * corr + jnp.sum(
-        jnp.where(valid, jnp.exp(blk - m_new), 0.0), axis=-1, keepdims=True
+        jnp.exp(blk - m_new), axis=-1, keepdims=True
     )
     # the target logit lives in exactly one vocab block
     is_tgt = k_pos == tgt
@@ -91,7 +100,9 @@ def _fwd_call(logits, targets, block_n, block_v, interpret):
     if n_pad != n or v_pad != v:
         logits = jnp.pad(logits, [(0, n_pad - n), (0, v_pad - v)])
         targets = jnp.pad(targets, [(0, n_pad - n)])
-    kernel = functools.partial(_fwd_kernel, vocab=v, block_v=block_v)
+    kernel = functools.partial(
+        _fwd_kernel, vocab=v, block_v=block_v, masked=v_pad != v
+    )
     row = pl.BlockSpec((block_n, _LANES), lambda i, j: (i, 0))
     loss, lse = pl.pallas_call(
         kernel,
@@ -146,15 +157,20 @@ def _bwd_blocked(logits, targets, lse, g, block_v):
 
 
 def _bwd_kernel(logits_ref, targets_ref, lse_ref, g_ref, dl_ref, *,
-                vocab, block_v):
-    """dlogits tile = (softmax - onehot) * g; stateless per grid step."""
+                vocab, block_v, masked):
+    """dlogits tile = (softmax - onehot) * g; stateless per grid step.
+    ``masked`` as in :func:`_fwd_kernel` (the onehot iota is needed
+    either way, but the tail-mask select is skipped when block_v divides
+    the vocab)."""
     j = pl.program_id(1)
     blk = logits_ref[...].astype(jnp.float32)  # [block_n, block_v]
     n = blk.shape[0]
     k_pos = j * block_v + jax.lax.broadcasted_iota(jnp.int32, (n, block_v), 1)
     lse = lse_ref[...][:, :1]  # [block_n, 1] (lane 0)
     g = g_ref[...][:, :1]
-    p = jnp.where(k_pos < vocab, jnp.exp(blk - lse), 0.0)
+    p = jnp.exp(blk - lse)
+    if masked:
+        p = jnp.where(k_pos < vocab, p, 0.0)
     onehot = (k_pos == targets_ref[...][:, :1]).astype(jnp.float32)
     dl_ref[...] = ((p - onehot) * g).astype(dl_ref.dtype)
 
@@ -172,7 +188,8 @@ def _bwd_pallas(logits, targets, lse, g, block_n, block_v, interpret):
     row = pl.BlockSpec((block_n, _LANES), lambda i, j: (i, 0))
     lanes = lambda t: jnp.broadcast_to(t[:, None], (n_pad, _LANES))  # noqa: E731
     dlogits = pl.pallas_call(
-        functools.partial(_bwd_kernel, vocab=v, block_v=block_v),
+        functools.partial(_bwd_kernel, vocab=v, block_v=block_v,
+                          masked=v_pad != v),
         grid=(n_pad // block_n, v_pad // block_v),
         in_specs=[
             pl.BlockSpec((block_n, block_v), lambda i, j: (i, j)),

@@ -195,6 +195,27 @@ class TestFusedCrossEntropy:
         gr = jax.grad(lambda x: jnp.mean(self._ref(x, targets)))(logits)
         np.testing.assert_allclose(np.asarray(gk), np.asarray(gr), atol=1e-6)
 
+    def test_unmasked_fast_path(self, monkeypatch):
+        """block_v dividing the vocab takes the masked=False branch — the
+        production LM-head shape (V=32768, block_v=2048) and the path the
+        other tests' odd vocabs never reach.  KF_PALLAS_BWD=pallas forces
+        the backward KERNEL (not the blocked-jnp fallback) so its
+        masked=False branch is covered too."""
+        from kungfu_tpu.ops.pallas import softmax_cross_entropy
+
+        monkeypatch.setenv("KF_PALLAS_BWD", "pallas")
+        logits, targets = self._data(b=1, s=64, v=512, seed=2)
+        got = softmax_cross_entropy(
+            logits, targets, block_n=32, block_v=256, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(self._ref(logits, targets)), np.asarray(got), atol=1e-4
+        )
+        gk = jax.grad(lambda x: jnp.mean(softmax_cross_entropy(
+            x, targets, block_n=32, block_v=256, interpret=True)))(logits)
+        gr = jax.grad(lambda x: jnp.mean(self._ref(x, targets)))(logits)
+        np.testing.assert_allclose(np.asarray(gk), np.asarray(gr), atol=1e-6)
+
     def test_bf16_logits(self):
         from kungfu_tpu.ops.pallas import softmax_cross_entropy
 
