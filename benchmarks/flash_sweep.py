@@ -28,6 +28,8 @@ from bench import measure_group  # noqa: E402
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--seq-len", type=int, default=2048)
+    p.add_argument("--head-dim", type=int, default=128,
+                   help="64 = the GPT-small shape; defaults were tuned at 128")
     p.add_argument("--bwd", action="store_true", help="sweep fwd+bwd instead of fwd")
     p.add_argument("--rounds", type=int, default=8)
     p.add_argument("--blocks", type=str, default="",
@@ -40,7 +42,7 @@ def main():
 
     from kungfu_tpu.ops.pallas.attention import flash_attention
 
-    B, H, S, D = 4, 8, args.seq_len, 128
+    B, H, S, D = 4, 8, args.seq_len, args.head_dim
     rng = np.random.default_rng(0)
     q = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.bfloat16)
     k = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.bfloat16)
