@@ -657,9 +657,14 @@ def payload_allreduce(args) -> dict:
         # single chip: no collective possible; measure an on-chip
         # read+write of the buffer as a floor.  NOT (y+y)*0.5 — the
         # algebraic simplifier folds that to the identity and the loop
-        # would time nothing; a decay factor != 1 survives optimization
+        # would time nothing; a decay factor != 1 survives optimization.
+        # At the default 64 MiB this runs ~100 us/iter — differencing
+        # noise on the relay then dominates (a recorded 64 MiB run
+        # exceeded HBM spec) — so the K window stretches to put ~3 ms of
+        # real work in the differenced span
         decay = jnp.float32(1.0 - 2.0 ** -12)
         step = lambda y: y * decay
+        k_window = {"k_lo": 8, "k_hi": 40}
     else:
         from jax.sharding import Mesh, PartitionSpec as P
         from jax.experimental.shard_map import shard_map
@@ -670,7 +675,8 @@ def payload_allreduce(args) -> dict:
             lambda y: jax.lax.psum(y, "d") * inv_n,
             mesh=mesh, in_specs=P("d"), out_specs=P("d"),
         )
-    dt = measure_chained(step, x)
+        k_window = {}
+    dt = measure_chained(step, x, **k_window)
     # standard allreduce bus-bandwidth formula over the per-rank size
     bus = (
         2 * (n - 1) / n * per_rank_bytes / dt / (1 << 30)
