@@ -133,3 +133,23 @@ class TestBenchPayloads:
         # expected in CPU interpret mode), not a reference baseline
         assert out["vs_baseline"] > 0
         assert out["final_loss"] is not None
+
+    def test_resnet_quick(self):
+        """The driver's headline payload: framework-path ResNet training.
+        (--quick pins batch/img/steps itself, so no --steps here — the
+        payload would ignore it.)"""
+        out = run_bench("bench.py", "--payload", "resnet", "--cpu",
+                        timeout=420, subdir="")
+        assert out["metric"] == "resnet50_sync_sgd_images_per_sec_per_chip"
+        assert out["value"] > 0 and out["unit"] == "images/sec"
+        assert out["final_loss"] is not None
+        assert "dp_train_step" in out["framework_path"]
+
+    def test_allreduce(self):
+        """Under pytest the conftest's XLA_FLAGS leak an 8-device virtual
+        CPU platform into the subprocess (psum path); standalone it sees
+        one device (read+write floor).  Both are valid payload branches."""
+        out = run_bench("bench.py", "--payload", "allreduce", "--cpu",
+                        subdir="")
+        assert out["metric"] == "allreduce_bus_bandwidth"
+        assert out["value"] > 0 and out["n_devices"] in (1, 8)
