@@ -259,17 +259,23 @@ def token_nll(logits, targets):
 def softmax_cross_entropy(
     logits,
     targets,
-    block_n: int = DEFAULT_BLOCK_N,
-    block_v: int = DEFAULT_BLOCK_V,
+    block_n: Optional[int] = None,
+    block_v: Optional[int] = None,
     interpret: Optional[bool] = None,
 ):
     """Per-token NLL for ``logits`` [..., V] and int targets [...].
 
     Matches ``-log_softmax(logits)[target]`` numerically; differentiable
-    w.r.t. logits."""
+    w.r.t. logits.  ``block_v=None`` shrinks the default tile to the
+    128-rounded vocab so small vocabs (tests, toy models) don't pad up
+    to a whole 2048-wide block."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     v = logits.shape[-1]
+    if block_v is None:
+        block_v = min(DEFAULT_BLOCK_V, ((max(v, 1) + 127) // 128) * 128)
+    if block_n is None:
+        block_n = DEFAULT_BLOCK_N
     lead = logits.shape[:-1]
     out = _xent(
         logits.reshape(-1, v),
