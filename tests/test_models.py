@@ -73,6 +73,19 @@ class TestResNet:
         n = nn.num_params(params)
         assert 25.4e6 < n < 25.8e6, n  # ~25.56M
 
+    def test_deep_variants(self):
+        """101/152 stage tables build and run (tiny width)."""
+        for depth, blocks in ((101, 33), (152, 50)):
+            m = ResNet(depth, num_classes=10, width=8)
+            params, state = m.init(jax.random.PRNGKey(0))
+            n_blocks = sum(
+                1 for k in params if k[0] == "s" and k[1].isdigit()
+            )
+            assert n_blocks == blocks
+            x = np.random.RandomState(0).rand(1, 32, 32, 3).astype(np.float32)
+            logits, _ = m.apply(params, state, x, train=False, dtype=jnp.float32)
+            assert logits.shape == (1, 10)
+
 
 class TestVGG:
     def test_tiny_forward_backward(self):
