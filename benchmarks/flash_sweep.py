@@ -75,15 +75,18 @@ def main():
                                        block_q=bq, block_k=bk)
         return step
 
+    # target_sep=0.3: ~10% worst-case jitter error is plenty for RANKING
+    # tile shapes (the spread between candidates is 7x); the full 1.0 s
+    # default would multiply a many-pair sweep's runtime for nothing
     times = measure_group(
         {f"{bq}:{bk}": make_step(bq, bk) for bq, bk in pairs},
-        q, rounds=args.rounds, on_error="skip",
+        q, rounds=args.rounds, on_error="skip", target_sep=0.3,
     )
     for name, t in times.items():
         bq, bk = (int(x) for x in name.split(":"))
         row = {"block_q": bq, "block_k": bk, "seq": S, "bwd": args.bwd}
         if t is None:
-            row["error"] = "did not compile (see stderr)"
+            row["error"] = "unmeasured: compile failure or relay noise (see stderr)"
         else:
             row.update(ms=round(t * 1e3, 3),
                        tflops=round(flop_mult * attn_flops / t / 1e12, 1))

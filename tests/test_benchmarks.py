@@ -120,6 +120,33 @@ class TestMeasureGroup:
         with pytest.raises(RuntimeError):
             measure_group({"bad": boom}, jnp.ones((8,)), k_lo=1, k_hi=2)
 
+    def test_respan_grows_fast_contestants(self, capsys):
+        """A contestant whose K-separation is below target_sep gets its
+        hi program rebuilt with a bigger span (the jitter defense every
+        recorded TPU number now rides on)."""
+        measure_group = self._measure_group()
+        import jax.numpy as jnp
+
+        t = measure_group(
+            {"fast": lambda c: c * 1.0001},
+            jnp.ones((8,)), k_lo=1, k_hi=3, rounds=2,
+            target_sep=0.005, max_rounds=4,
+        )
+        assert t["fast"] > 0
+        assert "re-span" in capsys.readouterr().err
+
+    def test_rounds_1_skips_respan_and_settle(self, capsys):
+        measure_group = self._measure_group()
+        import jax.numpy as jnp
+
+        t = measure_group(
+            {"fast": lambda c: c * 1.0001},
+            jnp.ones((8,)), k_lo=1, k_hi=3, rounds=1, target_sep=10.0,
+        )
+        assert t["fast"] > 0
+        err = capsys.readouterr().err
+        assert "re-span" not in err and "settled" not in err
+
 
 @pytest.mark.slow
 class TestBenchPayloads:
