@@ -73,6 +73,45 @@ def conv_apply(p, x, stride: int = 1, padding="SAME", dtype=None):
     return y
 
 
+def conv_stem_s2d_apply(p, x, dtype=None):
+    """The classic TPU stem trick: a 7x7/stride-2 conv on [N,H,W,3] runs
+    at 3/128 MXU lane efficiency; computing the SAME linear map as a
+    4x4/stride-1 conv on 2x2 space-to-depth input ([N,H/2,W/2,12]) packs
+    4x more channels per lane.  The trainable parameter stays the
+    original [7,7,C,F] kernel (checkpoint-compatible; gradients flow
+    through the rearrangement, which is pure indexing/zero-padding).
+
+    Exactness: SAME padding for k=7,s=2 is (2,3), so output o reads
+    input p = 2o+a-2, a in [0,7).  With p = 2i+di the taps become
+    i = o+u-1, a = 2u+di for u in [0,4), di in {0,1} — a 4x4 kernel
+    W'[u,v,(di,dj,c),f] = W[2u+di, 2v+dj, c, f] (the a=7 taps are
+    zero-padded) over pad ((1,2),(1,2)) stride 1.  Matches the direct
+    conv up to float reassociation.
+
+    Falls back to :func:`conv_apply` when the shape doesn't fit the
+    pattern (odd H/W, non-7x7 kernel).
+    """
+    kh, kw, c, f = p["w"].shape
+    n, h, w_, xc = x.shape
+    if (kh, kw) != (7, 7) or h % 2 or w_ % 2 or xc != c:
+        return conv_apply(p, x, stride=2, dtype=dtype)
+    wgt = p["w"].astype(dtype) if dtype else p["w"]
+    x = x.reshape(n, h // 2, 2, w_ // 2, 2, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, w_ // 2, 4 * c)
+    w8 = jnp.pad(wgt, ((0, 1), (0, 1), (0, 0), (0, 0)))
+    w4 = w8.reshape(4, 2, 4, 2, c, f)
+    w4 = w4.transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, 4 * c, f)
+    y = jax.lax.conv_general_dilated(
+        x, w4,
+        window_strides=(1, 1),
+        padding=((1, 2), (1, 2)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    if "b" in p:
+        y = y + (p["b"].astype(dtype) if dtype else p["b"])
+    return y
+
+
 # -- norms ---------------------------------------------------------------
 def batchnorm_init(ch: int):
     """Trainable affine params; running stats live in a separate state tree

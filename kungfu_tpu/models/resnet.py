@@ -77,7 +77,9 @@ class ResNet:
         new_state = {}
         x = x.astype(dtype)
 
-        h = nn.conv_apply(params["stem"], x, stride=2, dtype=dtype)
+        # space-to-depth stem: same linear map as conv_apply(stride=2),
+        # MXU-lane-efficient on TPU (see nn.conv_stem_s2d_apply)
+        h = nn.conv_stem_s2d_apply(params["stem"], x, dtype=dtype)
         h, ns = nn.batchnorm_apply(params["stem_bn"], state["stem_bn"], h, train, axis_name=axis_name)
         new_state["stem_bn"] = ns
         h = jax.nn.relu(h)

@@ -67,6 +67,34 @@ class TestResNet:
         logits, _ = m.apply(params, state, x, train=False, dtype=jnp.float32)
         assert logits.shape == (2, 10)
 
+    def test_s2d_stem_matches_direct_conv(self):
+        """The space-to-depth stem is the SAME linear map as the 7x7/s2
+        conv (MXU lane packing, not an architecture change): outputs and
+        the gradient w.r.t. the original 7x7 parameter must match the
+        direct conv to float tolerance, and odd sizes fall back."""
+        key = jax.random.PRNGKey(0)
+        p = nn.conv_init(key, 3, 16, (7, 7))
+        x = jnp.asarray(
+            np.random.RandomState(1).randn(2, 64, 64, 3), jnp.float32
+        )
+        a = nn.conv_apply(p, x, stride=2)
+        b = nn.conv_stem_s2d_apply(p, x)
+        assert a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+        ga = jax.grad(lambda w: jnp.sum(
+            nn.conv_apply({"w": w}, x, stride=2) ** 2))(p["w"])
+        gb = jax.grad(lambda w: jnp.sum(
+            nn.conv_stem_s2d_apply({"w": w}, x) ** 2))(p["w"])
+        np.testing.assert_allclose(np.asarray(ga), np.asarray(gb),
+                                   rtol=1e-4, atol=1e-4)
+        # odd spatial size: falls back to the direct conv path
+        x_odd = x[:, :63, :63, :]
+        np.testing.assert_allclose(
+            np.asarray(nn.conv_stem_s2d_apply(p, x_odd)),
+            np.asarray(nn.conv_apply(p, x_odd, stride=2)),
+            rtol=1e-5, atol=1e-5)
+
     def test_real_resnet50_param_count(self):
         m = ResNet(50, num_classes=1000)
         params, _ = m.init(jax.random.PRNGKey(0))
