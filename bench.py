@@ -838,21 +838,42 @@ def payload_allreduce(args) -> dict:
         from jax.sharding import Mesh, PartitionSpec as P
         from jax.experimental.shard_map import shard_map
 
+        from kungfu_tpu.ops.schedules import all_reduce_scheduled
+
         mesh = Mesh(np.array(devs), ("d",))
         inv_n = 1.0 / n
-        step = shard_map(
-            lambda y: jax.lax.psum(y, "d") * inv_n,
-            mesh=mesh, in_specs=P("d"), out_specs=P("d"),
-        )
+
+        def make_step(schedule):
+            return shard_map(
+                lambda y: all_reduce_scheduled(
+                    y, "d", schedule=schedule) * inv_n,
+                mesh=mesh, in_specs=P("d"), out_specs=P("d"),
+            )
+
+        step = make_step("psum")
         k_window = {}
     dt = measure_chained(step, x, **k_window)
-    # standard allreduce bus-bandwidth formula over the per-rank size
-    bus = (
-        2 * (n - 1) / n * per_rank_bytes / dt / (1 << 30)
-        if n > 1
-        else per_rank_bytes / dt / (1 << 30)
-    )
-    return {
+
+    def busbw(t):
+        # standard allreduce bus-bandwidth convention over per-rank size
+        return (2 * (n - 1) / n if n > 1 else 1.0) * per_rank_bytes / t / (1 << 30)
+
+    schedules = None
+    if n > 1:
+        # the selectable decompositions (kungfu_tpu.ops.schedules) timed
+        # against the same psum in one interleaved group — the
+        # device-plane analog of the reference's per-strategy throughput
+        # table (session/strategy.go:17-56)
+        t = measure_group(
+            {s: make_step(s) for s in ("psum", "two_stage", "ring")}, x,
+            rounds=3, target_sep=0.3,
+        )
+        schedules = {
+            s: (None if ts is None else round(busbw(ts), 3))
+            for s, ts in t.items()
+        }
+    bus = busbw(dt)
+    out = {
         "metric": "allreduce_bus_bandwidth",
         "value": round(bus, 3),
         "unit": "GiB/s",
@@ -861,6 +882,9 @@ def payload_allreduce(args) -> dict:
         "n_devices": n,
         "mbytes": args.mbytes,
     }
+    if schedules is not None:
+        out["schedule_bus_gib_s"] = schedules
+    return out
 
 
 PAYLOADS = {

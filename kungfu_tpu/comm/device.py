@@ -78,9 +78,12 @@ class Communicator:
         version: int = 0,
         devices: Optional[Sequence] = None,
         local_size: Optional[int] = None,
+        strategy: str = "psum",
     ):
         self.cluster = cluster
         self.version = version
+        self._strategy = "psum"
+        self.set_strategy(strategy)
         devs = list(devices) if devices is not None else list(jax.devices())
         n = len(devs)
         if local_size is None:
@@ -129,6 +132,30 @@ class Communicator:
                 parts, n, n,
             )
         return n
+
+    # -- strategy --------------------------------------------------------
+    @property
+    def strategy(self) -> str:
+        """Active allreduce schedule (``kungfu_tpu.ops.schedules``)."""
+        return self._strategy
+
+    def set_strategy(self, name: str) -> None:
+        """Select the compiled allreduce schedule — the device-plane
+        analog of the reference's ``SetGlobalStrategy``
+        (``session/adaptation.go:8-28``).  Swapping re-jits on next use
+        (compiled programs are cached per (op, shape, strategy) key).
+        Like every collective here, all controller processes must make
+        the same call at the same point; consensus/fencing for adaptive
+        swaps rides the same driver machinery as the host plane
+        (:mod:`kungfu_tpu.monitor.adaptive`).
+        """
+        from kungfu_tpu.ops.schedules import ALLREDUCE_SCHEDULES
+
+        if name not in ALLREDUCE_SCHEDULES:
+            raise ValueError(
+                f"unknown strategy {name!r}; one of {ALLREDUCE_SCHEDULES}"
+            )
+        self._strategy = name
 
     # -- metadata --------------------------------------------------------
     @property
@@ -205,10 +232,13 @@ class Communicator:
 
     def _all_reduce_leaf(self, a, op, axes):
         a = jnp.asarray(a)
-        key = ("ar", op, axes, a.shape, a.dtype.name)
+        sched = self._strategy if op != "prod" else "psum"
+        key = ("ar", op, axes, a.shape, a.dtype.name, sched)
 
         def build():
             def body(s):
+                if sched != "psum":
+                    return self._scheduled_body(s, op, axes)
                 if op == "sum":
                     return jax.lax.psum(s, axes)
                 if op == "mean":
@@ -225,6 +255,40 @@ class Communicator:
             return self._shard_jit(body)
 
         return self._cached(key, build)(a)
+
+    def _scheduled_body(self, s, op, axes):
+        """Non-default schedule over the REQUESTED axes (global or one of
+        the local/cross sub-axes).  Single-axis reductions run wholly
+        through the scheduled decomposition; a global reduction on a
+        hierarchical mesh reduces intra-host over ICI (psum — one hop on
+        the torus) and applies the schedule to the cross-host stage, the
+        reference's local/cross split (``session/strategy.go:176-210``)."""
+        from kungfu_tpu.ops.schedules import all_reduce_scheduled
+
+        base = "sum" if op == "mean" else op
+        fold = {"sum": jax.lax.psum, "min": jax.lax.pmin, "max": jax.lax.pmax}
+        sizes = {LOCAL_AXIS: self._local, HOST_AXIS: self._hosts}
+        if isinstance(axes, str):
+            denom = sizes[axes]
+            s = all_reduce_scheduled(s, axes, op=base,
+                                     schedule=self._strategy)
+        else:
+            denom = 1
+            for ax in axes:
+                denom *= sizes[ax]
+            # apply the schedule to the last (cross-host) axis; earlier
+            # axes ride one-hop psum.  Trivial axes (size 1) are skipped
+            # so a flat mesh still schedules its real axis.
+            real = [ax for ax in axes if sizes[ax] > 1]
+            if not real:
+                real = [axes[-1]]
+            for ax in real[:-1]:
+                s = fold[base](s, ax)
+            s = all_reduce_scheduled(s, real[-1], op=base,
+                                     schedule=self._strategy)
+        if op == "mean":
+            s = s / denom
+        return s
 
     def reduce(self, x, root: int = 0, op: str = "sum"):
         """Root-valid reduce (reference ``session.go:157-165``): peer

@@ -23,6 +23,7 @@ from kungfu_tpu.ops.collective import (
     peer_size,
 )
 from kungfu_tpu.ops.fuse import fuse, defuse
+from kungfu_tpu.ops.schedules import ALLREDUCE_SCHEDULES, all_reduce_scheduled
 from kungfu_tpu.ops.monitor import global_noise_scale, group_all_reduce_with_variance
 from kungfu_tpu.ops.state import counter, exponential_moving_average
 
@@ -34,6 +35,8 @@ __all__ = [
     "barrier_value",
     "peer_rank",
     "peer_size",
+    "ALLREDUCE_SCHEDULES",
+    "all_reduce_scheduled",
     "fuse",
     "defuse",
     "global_noise_scale",

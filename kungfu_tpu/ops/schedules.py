@@ -1,0 +1,171 @@
+"""Device-plane allreduce *schedules* — strategy choice, TPU-style.
+
+The reference adapts its allreduce by swapping per-message routing graphs
+(8 named topologies, ``base/strategy.go:10-22``, swapped at runtime with
+barrier+consensus, ``session/adaptation.go:8-28``).  On TPU the compiler
+owns message routing, so "strategy" becomes **which collective
+decomposition gets compiled** (SURVEY §7 step 9): the same allreduce can
+lower as
+
+* ``psum`` — one HLO all-reduce; XLA picks the algorithm (default).
+* ``two_stage`` — explicit reduce-scatter + all-gather
+  (``lax.psum_scatter`` + tiled ``all_gather``): the bandwidth-optimal
+  decomposition materialized in the program, which lets XLA schedule the
+  two phases independently around neighboring compute.
+* ``ring`` — a manual ``ppermute`` ring (n-1 reduce-scatter steps +
+  n-1 all-gather steps): every hop is an explicit program point, the
+  shape that overlap experiments and the scaling-book recipes reason
+  about.
+
+All three produce the same values (sum/mean/min/max; see per-schedule
+notes), verified against ``lax.psum`` in ``tests/test_schedules.py``.
+Swapping = re-jitting with a different ``schedule=`` — the moral
+equivalent of the reference's ``SetGlobalStrategy``, with consensus
+handled by the same driver machinery as the host plane
+(:mod:`kungfu_tpu.monitor.adaptive`).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Axis = Union[str, Tuple[str, ...]]
+
+#: selectable device-plane allreduce schedules
+ALLREDUCE_SCHEDULES = ("psum", "two_stage", "ring")
+
+_OPS = {
+    "sum": jnp.add,
+    "mean": jnp.add,  # sum then divide at the end
+    "min": jnp.minimum,
+    "max": jnp.maximum,
+}
+def _pad_identity(op: str, dtype):
+    """Identity element for the fold — op- and dtype-aware (an inf pad
+    in an int buffer would overflow; a zero pad would corrupt min/max;
+    bool has neither iinfo nor inf)."""
+    if op in ("sum", "mean"):
+        return 0
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.inf if op == "min" else -jnp.inf
+    if dtype == jnp.bool_:
+        return op == "min"  # True is min's identity, False is max's
+    info = jnp.iinfo(dtype)
+    return info.max if op == "min" else info.min
+
+
+def _single_axis(axis: Axis) -> str:
+    if isinstance(axis, str):
+        return axis
+    if len(axis) == 1:
+        return axis[0]
+    raise ValueError(
+        f"ring/two_stage schedules need a single mesh axis, got {axis!r}; "
+        "collapse the mesh axes or use schedule='psum'"
+    )
+
+
+def _flatten_pad(a, n: int, op: str):
+    """Flatten to [n, chunk] with an op-identity pad (zeros would corrupt
+    min/max tails)."""
+    flat = a.reshape(-1)
+    chunk = max(1, math.ceil(flat.size / n))
+    pad = n * chunk - flat.size
+    if pad:
+        flat = jnp.concatenate(
+            [flat, jnp.full((pad,), _pad_identity(op, flat.dtype), flat.dtype)]
+        )
+    return flat.reshape(n, chunk), flat.size - pad
+
+
+def _ring_all_reduce_leaf(a, axis_name: str, op: str):
+    """ppermute ring: n-1 reduce-scatter hops, n-1 all-gather hops.
+
+    Step s of reduce-scatter: rank r sends chunk (r-s) mod n, receives
+    chunk (r-s-1) mod n from rank r-1 and folds it in; after n-1 steps
+    rank r owns the fully reduced chunk (r+1) mod n, which then travels
+    the ring unreduced for n-1 more steps.
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return a
+    idx = lax.axis_index(axis_name)
+    fold = _OPS[op]
+    parts, size = _flatten_pad(a, n, op)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def rs_step(s, parts):
+        send_i = (idx - s) % n
+        recv_i = (idx - s - 1) % n
+        buf = lax.dynamic_index_in_dim(parts, send_i, axis=0, keepdims=False)
+        got = lax.ppermute(buf, axis_name, perm)
+        cur = lax.dynamic_index_in_dim(parts, recv_i, axis=0, keepdims=False)
+        return lax.dynamic_update_index_in_dim(
+            parts, fold(cur, got), recv_i, axis=0
+        )
+
+    parts = lax.fori_loop(0, n - 1, rs_step, parts)
+
+    def ag_step(s, parts):
+        send_i = (idx + 1 - s) % n
+        recv_i = (idx - s) % n
+        buf = lax.dynamic_index_in_dim(parts, send_i, axis=0, keepdims=False)
+        got = lax.ppermute(buf, axis_name, perm)
+        return lax.dynamic_update_index_in_dim(parts, got, recv_i, axis=0)
+
+    parts = lax.fori_loop(0, n - 1, ag_step, parts)
+    out = parts.reshape(-1)[:size].reshape(a.shape)
+    if op == "mean":
+        out = out / n
+    return out
+
+
+def _two_stage_all_reduce_leaf(a, axis_name: str, op: str):
+    """Explicit reduce-scatter + all-gather.  ``psum_scatter`` is
+    sum-only; min/max fall back to the ring schedule (same explicit
+    two-phase shape, correct op)."""
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return a
+    if op in ("min", "max"):
+        return _ring_all_reduce_leaf(a, axis_name, op)
+    parts, size = _flatten_pad(a, n, op)
+    flat = parts.reshape(-1)
+    mine = lax.psum_scatter(flat, axis_name, scatter_dimension=0, tiled=True)
+    out = lax.all_gather(mine, axis_name, axis=0, tiled=True)
+    out = out[:size].reshape(a.shape)
+    if op == "mean":
+        out = out / n
+    return out
+
+
+def all_reduce_scheduled(x, axis: Axis, op: str = "sum",
+                         schedule: str = "psum"):
+    """Allreduce a tensor/pytree across ``axis`` with an explicit
+    schedule.  ``schedule='psum'`` is :func:`kungfu_tpu.ops.all_reduce`;
+    the others decompose the collective in-program (docstring above).
+    Jit/shard_map-composable; every schedule returns the same values.
+    """
+    if op not in _OPS:
+        raise ValueError(f"unsupported op {op!r}")
+    if schedule not in ALLREDUCE_SCHEDULES:
+        raise ValueError(
+            f"unknown schedule {schedule!r}; one of {ALLREDUCE_SCHEDULES}"
+        )
+    if schedule == "psum":
+        from kungfu_tpu.ops.collective import all_reduce
+
+        return all_reduce(x, axis, op=op)
+    axis_name = _single_axis(axis)
+    leaf = partial(
+        _ring_all_reduce_leaf if schedule == "ring"
+        else _two_stage_all_reduce_leaf,
+        axis_name=axis_name, op=op,
+    )
+    return jax.tree_util.tree_map(lambda a: leaf(a), x)

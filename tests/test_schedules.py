@@ -1,0 +1,193 @@
+"""Device-plane allreduce schedules (SURVEY §7 step 9: strategy choice =
+choice among compiled collective decompositions).
+
+Every schedule must produce the SAME values as ``lax.psum``-family
+reference collectives — on the 8-device virtual CPU mesh (conftest), for
+ragged sizes that exercise the padding path, and for the int dtypes whose
+pad identity differs from float.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kungfu_tpu.ops.schedules import ALLREDUCE_SCHEDULES, all_reduce_scheduled
+
+N_DEV = 8
+
+
+def _mesh():
+    return Mesh(np.asarray(jax.devices()[:N_DEV]), ("x",))
+
+
+def _run(schedule, op, x):
+    """x: [N_DEV, ...] stacked input; returns the allreduced stack."""
+    mesh = _mesh()
+
+    def body(s):
+        return all_reduce_scheduled(s, "x", op=op, schedule=schedule)
+
+    f = shard_map(body, mesh=mesh, in_specs=(P("x"),), out_specs=P("x"))
+    return jax.jit(f)(x)
+
+
+def _reference(op, x):
+    red = {
+        "sum": np.sum, "mean": np.mean, "min": np.min, "max": np.max,
+    }[op](np.asarray(x, np.float64 if x.dtype != np.int32 else np.int64),
+          axis=0)
+    return np.broadcast_to(red, x.shape)
+
+
+class TestSchedules:
+    @pytest.mark.parametrize("schedule", ["two_stage", "ring"])
+    @pytest.mark.parametrize("op", ["sum", "mean", "min", "max"])
+    @pytest.mark.parametrize("length", [1, 7, 64, 1000])
+    def test_matches_reference(self, schedule, op, length):
+        rng = np.random.RandomState(hash((schedule, op, length)) % 2**31)
+        x = jnp.asarray(rng.randn(N_DEV, length), jnp.float32)
+        out = _run(schedule, op, x)
+        ref = _reference(op, np.asarray(x))
+        np.testing.assert_allclose(np.asarray(out), ref,
+                                   rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("schedule", ["two_stage", "ring"])
+    @pytest.mark.parametrize("op", ["min", "max"])
+    def test_int_dtypes_pad_identity(self, schedule, op):
+        """A 0/inf pad would corrupt int min/max on the ragged tail."""
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randint(-1000, 1000, (N_DEV, 13)), jnp.int32)
+        out = _run(schedule, op, x)
+        ref = _reference(op, np.asarray(x))
+        np.testing.assert_array_equal(np.asarray(out), ref)
+
+    def test_psum_schedule_is_default_path(self):
+        x = jnp.asarray(np.arange(N_DEV * 4, dtype=np.float32).reshape(N_DEV, 4))
+        out = _run("psum", "sum", x)
+        np.testing.assert_allclose(np.asarray(out), _reference("sum", np.asarray(x)))
+
+    def test_pytree_input(self):
+        rng = np.random.RandomState(0)
+        tree = {
+            "w": jnp.asarray(rng.randn(N_DEV, 5), jnp.float32),
+            "b": jnp.asarray(rng.randn(N_DEV, 3), jnp.float32),
+        }
+        mesh = _mesh()
+
+        def body(s):
+            return all_reduce_scheduled(s, "x", op="sum", schedule="ring")
+
+        f = shard_map(body, mesh=mesh, in_specs=(P("x"),), out_specs=P("x"))
+        out = jax.jit(f)(tree)
+        for k in tree:
+            np.testing.assert_allclose(
+                np.asarray(out[k]), _reference("sum", np.asarray(tree[k])),
+                rtol=1e-5, atol=1e-5)
+
+    def test_errors(self):
+        with pytest.raises(ValueError, match="unknown schedule"):
+            all_reduce_scheduled(jnp.ones(4), "x", schedule="tree")
+        with pytest.raises(ValueError, match="unsupported op"):
+            all_reduce_scheduled(jnp.ones(4), "x", op="prod", schedule="ring")
+        with pytest.raises(ValueError, match="single mesh axis"):
+            all_reduce_scheduled(jnp.ones(4), ("a", "b"), schedule="ring")
+
+
+class TestCommunicatorStrategy:
+    """Strategy selection on the eager Communicator (the reference's
+    ``SetGlobalStrategy`` analog, ``session/adaptation.go:8-28``)."""
+
+    def _comm(self, local_size):
+        from kungfu_tpu.comm.device import Communicator
+
+        return Communicator(devices=jax.devices()[:N_DEV],
+                            local_size=local_size)
+
+    @pytest.mark.parametrize("local_size", [1, 4, 8])
+    @pytest.mark.parametrize("strategy", ALLREDUCE_SCHEDULES)
+    def test_all_strategies_match_psum(self, local_size, strategy):
+        """Flat (1xN, Nx1) and hierarchical (2x4) meshes; the
+        hierarchical case applies the schedule to the cross-host stage."""
+        comm = self._comm(local_size)
+        comm.set_strategy(strategy)
+        rng = np.random.RandomState(7)
+        x = jnp.asarray(rng.randn(N_DEV, 33), jnp.float32)
+        for op in ("sum", "mean", "max"):
+            out = comm.all_reduce(x, op=op)
+            ref = _reference(op, np.asarray(x))
+            np.testing.assert_allclose(np.asarray(out), ref,
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_swap_recompiles_and_caches(self):
+        comm = self._comm(8)
+        x = jnp.ones((N_DEV, 4), jnp.float32)
+        comm.all_reduce(x)
+        n0 = len(comm._fns)
+        comm.set_strategy("ring")
+        comm.all_reduce(x)  # new cache entry under the ring key
+        assert len(comm._fns) == n0 + 1
+        comm.set_strategy("psum")
+        comm.all_reduce(x)  # back to the original compiled program
+        assert len(comm._fns) == n0 + 1
+
+    @pytest.mark.parametrize("strategy", ["two_stage", "ring"])
+    def test_sub_axis_collectives_honor_axes(self, strategy):
+        """local_/cross_all_reduce under a non-psum strategy must reduce
+        over their OWN axis, not the whole mesh (regression: the
+        scheduled body once ignored the requested axes and silently
+        computed a global sum)."""
+        comm = self._comm(4)  # 2 hosts x 4 local
+        comm.set_strategy(strategy)
+        x = jnp.asarray(np.arange(N_DEV * 2, dtype=np.float32).reshape(N_DEV, 2))
+        xa = np.asarray(x)
+        local = np.asarray(comm.local_all_reduce(x, op="mean"))
+        # per-host means, replicated within each host's block of 4
+        for h in range(2):
+            blk = xa[4 * h:4 * h + 4]
+            np.testing.assert_allclose(local[4 * h:4 * h + 4],
+                                       np.broadcast_to(blk.mean(0), blk.shape),
+                                       rtol=1e-6)
+        cross = np.asarray(comm.cross_all_reduce(x, op="sum"))
+        # peers with the same local rank sum across the 2 hosts
+        for l in range(4):
+            pair = xa[[l, 4 + l]]
+            np.testing.assert_allclose(cross[[l, 4 + l]],
+                                       np.broadcast_to(pair.sum(0), pair.shape),
+                                       rtol=1e-6)
+        # flat mesh: cross is a no-op under every strategy
+        flat = self._comm(8)
+        flat.set_strategy(strategy)
+        np.testing.assert_allclose(np.asarray(flat.cross_all_reduce(x)), xa)
+
+    @pytest.mark.parametrize("strategy", ["two_stage", "ring"])
+    def test_bool_min_max(self, strategy):
+        """bool consensus-style reduces must not be strategy-dependent
+        (regression: _pad_identity crashed on bool via jnp.iinfo)."""
+        comm = self._comm(8)
+        comm.set_strategy(strategy)
+        x = jnp.asarray(np.random.RandomState(0).rand(N_DEV, 5) > 0.5)
+        got_max = np.asarray(comm.all_reduce(x, op="max"))
+        got_min = np.asarray(comm.all_reduce(x, op="min"))
+        xa = np.asarray(x)
+        np.testing.assert_array_equal(
+            got_max, np.broadcast_to(xa.max(0), xa.shape))
+        np.testing.assert_array_equal(
+            got_min, np.broadcast_to(xa.min(0), xa.shape))
+
+    def test_unknown_strategy_rejected(self):
+        comm = self._comm(8)
+        with pytest.raises(ValueError, match="unknown strategy"):
+            comm.set_strategy("BINARY_TREE_STAR")
+
+    def test_ctor_strategy(self):
+        from kungfu_tpu.comm.device import Communicator
+
+        comm = Communicator(devices=jax.devices()[:N_DEV], local_size=8,
+                            strategy="two_stage")
+        assert comm.strategy == "two_stage"
+        x = jnp.ones((N_DEV, 4), jnp.float32)
+        np.testing.assert_allclose(np.asarray(comm.all_reduce(x)),
+                                   np.full((N_DEV, 4), 8.0))
