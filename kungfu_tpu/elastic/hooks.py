@@ -22,6 +22,7 @@ import numpy as np
 
 from kungfu_tpu.elastic.schedule import step_based_schedule
 from kungfu_tpu.initializer import broadcast_parameters
+from kungfu_tpu.monitor.signals import monitor_compile_grace
 from kungfu_tpu.utils.log import get_logger, log_event
 
 _log = get_logger("elastic")
@@ -77,6 +78,11 @@ def elastic_step(
             log_event("detached-stopping")
             return replace(state, detached=True), params, True
         log_event(f"resynced-after-resize-v{peer.cluster_version}")
+        # the new cluster shape re-jits the training step (new mesh ⇒
+        # fresh XLA compile, multi-ten-second on TPU); tell the failure
+        # detector so the next batch's stall allowance is compile-sized,
+        # not heartbeat-sized (no-op when monitoring is off)
+        monitor_compile_grace(peer.rank())
         # re-broadcast runs on the host channel (safe while the new engine
         # is cold).  Do NOT run an engine collective here: a joiner's first
         # engine op is its step's gradient allreduce, so the survivors'

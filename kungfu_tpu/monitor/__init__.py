@@ -13,6 +13,7 @@ from kungfu_tpu.monitor.adaptive import AdaptiveStrategyDriver, monitored_all_re
 from kungfu_tpu.monitor.signals import (
     monitor_batch_begin,
     monitor_batch_end,
+    monitor_compile_grace,
     monitor_epoch_end,
     monitor_train_end,
 )
@@ -24,6 +25,7 @@ __all__ = [
     "AdaptiveStrategyDriver",
     "monitored_all_reduce",
     "monitor_batch_begin",
+    "monitor_compile_grace",
     "monitor_batch_end",
     "monitor_epoch_end",
     "monitor_train_end",

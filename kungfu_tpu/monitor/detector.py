@@ -34,6 +34,13 @@ _log = get_logger("detector")
 
 DEFAULT_DETECTOR_PORT = 7756  # reference monitor.go
 DEFAULT_STALL_TIMEOUT_S = 10.0
+#: allowance while a rank is known to be compiling (first-ever batch, or
+#: an explicit ``grace`` signal after a resize re-jit).  SURVEY §7 hard
+#: part: a 10 s batch-stall timeout cannot tell a 20-40 s first XLA
+#: compile from a dead host — the reference never had to (CUDA kernels
+#: launch immediately); on TPU the first step and every post-resize step
+#: ARE multi-ten-second stalls on a healthy rank.
+DEFAULT_COMPILE_GRACE_S = 120.0
 CHECK_PERIOD_S = 1.0
 
 
@@ -52,6 +59,9 @@ class _RankState:
     epochs_done: int = 0
     finished: bool = False
     seen: bool = False
+    batches_done: int = 0  # completed begin/end pairs
+    grace_pending: bool = False  # a grace signal awaits its batch
+    in_grace_batch: bool = False  # the current open batch is compile-covered
 
 
 class DetectorServer:
@@ -64,6 +74,7 @@ class DetectorServer:
         port: int = DEFAULT_DETECTOR_PORT,
         peer_hosts: Optional[List[str]] = None,
         stall_timeout: float = DEFAULT_STALL_TIMEOUT_S,
+        compile_grace: float = DEFAULT_COMPILE_GRACE_S,
         host: str = "0.0.0.0",
         require_all_seen: bool = True,
     ):
@@ -71,6 +82,7 @@ class DetectorServer:
         self.port = port
         self.peer_hosts = peer_hosts or []
         self.stall_timeout = stall_timeout
+        self.compile_grace = max(compile_grace, stall_timeout)
         self.require_all_seen = require_all_seen
         self.results = DetectorResults()
         self._ranks: Dict[int, _RankState] = {}
@@ -145,11 +157,29 @@ class DetectorServer:
                 self.results.finish_flag = True
                 return None
             st = self._rank(int(sig["rank"]))
+            if st.finished and kind in ("begin", "grace"):
+                # a fresh incarnation reusing a finished rank id (restart
+                # or rejoin): stale state would either skip monitoring
+                # forever or judge its cold compile by the batch timeout
+                st = self._ranks[int(sig["rank"])] = _RankState(
+                    epochs_done=st.epochs_done
+                )
             st.seen = True
             if kind == "begin":
                 st.last_begin, st.open_begin = now, True
+                # anchor the grace window at the batch it covers — a
+                # pending grace consumed here allows compile_grace FROM
+                # THIS BEGIN, however long the announcement preceded it
+                st.in_grace_batch = st.grace_pending
+                st.grace_pending = False
             elif kind == "end":
                 st.last_end, st.open_begin = now, False
+                st.batches_done += 1
+                st.in_grace_batch = False  # grace dies with its batch
+            elif kind == "grace":
+                # the worker announces an upcoming known-long stall (a
+                # resize re-jit, or a fresh process about to cold-compile)
+                st.grace_pending = True
             elif kind == "epoch":
                 st.epochs_done = max(st.epochs_done, int(sig["epoch"]) + 1)
             elif kind == "trainend":
@@ -173,7 +203,18 @@ class DetectorServer:
             for r, st in self._ranks.items():
                 if st.finished:
                     continue
-                stalled_in_batch = st.open_begin and now - st.last_begin > self.stall_timeout
+                # compile-aware allowance: the first-ever batch (cold
+                # XLA compile, 20-40s on TPU) and any batch announced by
+                # a grace signal (resize re-jit) get compile_grace
+                # instead of the batch-stall timeout — a healthy TPU
+                # rank's first step IS a multi-ten-second stall (SURVEY
+                # §7 hard part: slow-compile vs dead-host).  The grace is
+                # per-batch: it expires at that batch's `end`, so a rank
+                # that compiles fast and then dies is caught on the
+                # normal clock.
+                compiling = st.batches_done == 0 or st.in_grace_batch
+                allow = self.compile_grace if compiling else self.stall_timeout
+                stalled_in_batch = st.open_begin and now - st.last_begin > allow
                 # a rank that goes silent *between* batches (hung data
                 # loader, dead host) has open_begin False — give it a
                 # longer grace (3x) on total heartbeat silence
@@ -181,7 +222,7 @@ class DetectorServer:
                 silent = (
                     not st.open_begin
                     and last_seen > 0
-                    and now - last_seen > 3 * self.stall_timeout
+                    and now - last_seen > max(3 * self.stall_timeout, allow)
                 )
                 if stalled_in_batch or silent:
                     min_epoch = min(

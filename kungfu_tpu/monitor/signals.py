@@ -72,5 +72,13 @@ def monitor_epoch_end(rank: int, epoch: int) -> None:
     _send({"kind": "epoch", "rank": rank, "epoch": epoch}, attempts=3)
 
 
+def monitor_compile_grace(rank: int) -> None:
+    """Announce an upcoming known-long stall (resize re-jit): the
+    detector extends this rank's allowance to its compile-grace window
+    instead of the batch-stall timeout.  Retried — a dropped grace signal
+    turns a healthy recompile into a spurious cluster restart."""
+    _send({"kind": "grace", "rank": rank}, attempts=3)
+
+
 def monitor_train_end(rank: int) -> None:
     _send({"kind": "trainend", "rank": rank}, attempts=3)

@@ -116,6 +116,13 @@ class Peer:
                 )
             else:
                 bind_local_rank(self.local_rank(), self.local_size())
+            # every fresh process is about to cold-compile its step: tell
+            # the failure detector (no-op without KF_MONITOR_ADDR).  This
+            # also covers a joiner that reuses a rank id whose previous
+            # incarnation left non-fresh detector state.
+            from kungfu_tpu.monitor.signals import monitor_compile_grace
+
+            monitor_compile_grace(self.rank())
             log_event("peer-started")
 
     def _init_jax_distributed(self) -> None:

@@ -24,6 +24,7 @@ import sys
 import time
 from typing import List, Optional
 
+from kungfu_tpu.monitor.detector import DEFAULT_COMPILE_GRACE_S
 from kungfu_tpu.plan import Cluster, HostList, parse_strategy
 from kungfu_tpu.plan.hostfile import parse_hostfile
 from kungfu_tpu.plan.hostspec import DEFAULT_RUNNER_PORT
@@ -56,6 +57,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="start a built-in config server on this port")
     p.add_argument("-auto-recover", dest="auto_recover", default="",
                    help="failure-detection period (e.g. 10s); enables MonitoredRun")
+    p.add_argument("-compile-grace", dest="compile_grace",
+                   default=f"{int(DEFAULT_COMPILE_GRACE_S)}s",
+                   help="stall allowance while a rank is known to be "
+                        "compiling (first batch / post-resize re-jit)")
     p.add_argument("-port-range", dest="port_range", default="10000-11000")
     p.add_argument("-logdir", default="")
     p.add_argument("-q", dest="quiet", action="store_true", help="suppress worker output")

@@ -111,6 +111,7 @@ def monitored_run(ns, cluster: Cluster, job: Job) -> int:
         expected_ranks=cluster.size(),
         peer_hosts=peer_hosts,
         stall_timeout=period,
+        compile_grace=parse_period(ns.compile_grace),
     ).start()
     job.extra_envs[MONITOR_ADDR_ENV] = f"{main_host}:{DEFAULT_DETECTOR_PORT}"
 

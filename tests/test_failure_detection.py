@@ -59,7 +59,11 @@ class TestArgPatching:
 class TestDetector:
     @pytest.fixture
     def detector(self):
-        d = DetectorServer(expected_ranks=2, port=27756, stall_timeout=1.0).start()
+        # compile_grace pinned equal to stall_timeout: these tests
+        # simulate steady-state stalls; the compile-aware allowance has
+        # its own tests below
+        d = DetectorServer(expected_ranks=2, port=27756, stall_timeout=1.0,
+                           compile_grace=1.0).start()
         yield d
         d.stop()
 
@@ -119,6 +123,96 @@ class TestDetector:
         detector.reset()
         assert not detector.results.down_flag
         assert detector.min_epoch() == 0
+
+
+class TestCompileGrace:
+    """Slow-compile vs dead-host (SURVEY §7 hard part): the first batch
+    and explicitly announced re-jits get the compile allowance, not the
+    heartbeat allowance."""
+
+    @pytest.fixture
+    def detector(self):
+        d = DetectorServer(expected_ranks=1, port=27757, stall_timeout=0.5,
+                           compile_grace=2.5).start()
+        yield d
+        d.stop()
+
+    def test_first_batch_outlasts_stall_timeout(self, detector):
+        """begin with no end for > stall_timeout but < compile_grace: a
+        cold XLA compile, not a dead rank."""
+        post_signal("127.0.0.1", 27757, {"kind": "begin", "rank": 0})
+        time.sleep(1.2)  # 2.4x the stall timeout
+        assert not detector.results.down_flag
+        post_signal("127.0.0.1", 27757, {"kind": "end", "rank": 0})
+        assert not detector.results.down_flag
+
+    def test_first_batch_grace_is_bounded(self, detector):
+        """A rank that never finishes its first batch still goes down —
+        after compile_grace instead of stall_timeout."""
+        post_signal("127.0.0.1", 27757, {"kind": "begin", "rank": 0})
+        deadline = time.time() + 10
+        while not detector.results.down_flag and time.time() < deadline:
+            time.sleep(0.2)
+        assert detector.results.down_flag
+
+    def test_steady_state_uses_stall_timeout(self, detector):
+        """After one completed batch the allowance drops back."""
+        post_signal("127.0.0.1", 27757, {"kind": "begin", "rank": 0})
+        post_signal("127.0.0.1", 27757, {"kind": "end", "rank": 0})
+        post_signal("127.0.0.1", 27757, {"kind": "begin", "rank": 0})
+        time.sleep(1.5)  # > stall_timeout, < compile_grace
+        assert detector.results.down_flag
+
+    def test_grace_signal_extends_mid_training(self, detector):
+        """A resize re-jit announced via the grace signal gets the
+        compile allowance even after completed batches."""
+        post_signal("127.0.0.1", 27757, {"kind": "begin", "rank": 0})
+        post_signal("127.0.0.1", 27757, {"kind": "end", "rank": 0})
+        post_signal("127.0.0.1", 27757, {"kind": "grace", "rank": 0})
+        post_signal("127.0.0.1", 27757, {"kind": "begin", "rank": 0})
+        time.sleep(1.2)  # > stall_timeout, inside the grace window
+        assert not detector.results.down_flag
+        post_signal("127.0.0.1", 27757, {"kind": "end", "rank": 0})
+        assert not detector.results.down_flag
+
+    def test_grace_anchors_at_begin_and_dies_with_its_batch(self, detector):
+        """The window starts at the covered batch's begin (an early
+        announcement is not consumed by pre-begin work), and expires at
+        that batch's end — a rank that compiles fast then dies is caught
+        on the normal clock."""
+        post_signal("127.0.0.1", 27757, {"kind": "begin", "rank": 0})
+        post_signal("127.0.0.1", 27757, {"kind": "end", "rank": 0})
+        post_signal("127.0.0.1", 27757, {"kind": "grace", "rank": 0})
+        time.sleep(1.0)  # announcement ages; must NOT consume the window
+        post_signal("127.0.0.1", 27757, {"kind": "begin", "rank": 0})
+        time.sleep(1.2)
+        assert not detector.results.down_flag  # anchored at begin
+        post_signal("127.0.0.1", 27757, {"kind": "end", "rank": 0})
+        post_signal("127.0.0.1", 27757, {"kind": "begin", "rank": 0})
+        time.sleep(1.5)  # > stall_timeout: grace is spent
+        assert detector.results.down_flag
+
+    def test_finished_rank_reuse_resets_state(self):
+        """A new incarnation reusing a rank id whose previous life sent
+        trainend must be monitored afresh (stale finished=True would
+        skip it forever) with the compile allowance (fresh batches_done)."""
+        d = DetectorServer(expected_ranks=2, port=27758, stall_timeout=0.5,
+                           compile_grace=2.5).start()
+        try:
+            post_signal("127.0.0.1", 27758, {"kind": "begin", "rank": 0})
+            post_signal("127.0.0.1", 27758, {"kind": "end", "rank": 0})
+            post_signal("127.0.0.1", 27758, {"kind": "trainend", "rank": 0})
+            # new incarnation: cold compile outlasts the stall timeout
+            post_signal("127.0.0.1", 27758, {"kind": "begin", "rank": 0})
+            time.sleep(1.2)
+            assert not d.results.down_flag
+            # ...but a rank that never finishes it still goes down
+            deadline = time.time() + 10
+            while not d.results.down_flag and time.time() < deadline:
+                time.sleep(0.2)
+            assert d.results.down_flag
+        finally:
+            d.stop()
 
 
 class TestCheckpoint:
