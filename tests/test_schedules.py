@@ -182,6 +182,19 @@ class TestCommunicatorStrategy:
         with pytest.raises(ValueError, match="unknown strategy"):
             comm.set_strategy("BINARY_TREE_STAR")
 
+    def test_autotune_picks_and_installs(self):
+        """autotune_strategy returns a valid schedule, installs it, and
+        results stay correct under the winner (the measured AUTO analog
+        of reference strategy.go:90-99)."""
+        comm = self._comm(8)
+        winner = comm.autotune_strategy(nbytes=1 << 12, trials=1)
+        assert winner in ALLREDUCE_SCHEDULES
+        assert comm.strategy == winner
+        x = jnp.asarray(np.random.RandomState(2).randn(N_DEV, 9), jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(comm.all_reduce(x, op="mean")),
+            _reference("mean", np.asarray(x)), rtol=1e-5, atol=1e-5)
+
     def test_ctor_strategy(self):
         from kungfu_tpu.comm.device import Communicator
 
