@@ -406,37 +406,13 @@ class Communicator:
 
     def _scheduled_body(self, s, op, axes):
         """Non-default schedule over the REQUESTED axes (global or one of
-        the local/cross sub-axes).  Single-axis reductions run wholly
-        through the scheduled decomposition; a global reduction on a
-        hierarchical mesh reduces intra-host over ICI (psum — one hop on
-        the torus) and applies the schedule to the cross-host stage, the
-        reference's local/cross split (``session/strategy.go:176-210``)."""
+        the local/cross sub-axes).  ``all_reduce_scheduled`` owns the
+        hierarchical decomposition: the schedule applies to the FIRST
+        non-trivial axis (cross-host in ``(host, local)`` order) after
+        the inner axes fold with one-hop psum."""
         from kungfu_tpu.ops.schedules import all_reduce_scheduled
 
-        base = "sum" if op == "mean" else op
-        fold = {"sum": jax.lax.psum, "min": jax.lax.pmin, "max": jax.lax.pmax}
-        sizes = {LOCAL_AXIS: self._local, HOST_AXIS: self._hosts}
-        if isinstance(axes, str):
-            denom = sizes[axes]
-            s = all_reduce_scheduled(s, axes, op=base,
-                                     schedule=self._strategy)
-        else:
-            denom = 1
-            for ax in axes:
-                denom *= sizes[ax]
-            # apply the schedule to the last (cross-host) axis; earlier
-            # axes ride one-hop psum.  Trivial axes (size 1) are skipped
-            # so a flat mesh still schedules its real axis.
-            real = [ax for ax in axes if sizes[ax] > 1]
-            if not real:
-                real = [axes[-1]]
-            for ax in real[:-1]:
-                s = fold[base](s, ax)
-            s = all_reduce_scheduled(s, real[-1], op=base,
-                                     schedule=self._strategy)
-        if op == "mean":
-            s = s / denom
-        return s
+        return all_reduce_scheduled(s, axes, op=op, schedule=self._strategy)
 
     def reduce(self, x, root: int = 0, op: str = "sum"):
         """Root-valid reduce (reference ``session.go:157-165``): peer

@@ -28,7 +28,6 @@ handled by the same driver machinery as the host plane
 from __future__ import annotations
 
 import math
-from functools import partial
 from typing import Tuple, Union
 
 import jax
@@ -60,15 +59,6 @@ def _pad_identity(op: str, dtype):
     return info.max if op == "min" else info.min
 
 
-def _single_axis(axis: Axis) -> str:
-    if isinstance(axis, str):
-        return axis
-    if len(axis) == 1:
-        return axis[0]
-    raise ValueError(
-        f"ring/two_stage schedules need a single mesh axis, got {axis!r}; "
-        "collapse the mesh axes or use schedule='psum'"
-    )
 
 
 def _flatten_pad(a, n: int, op: str):
@@ -120,10 +110,7 @@ def _ring_all_reduce_leaf(a, axis_name: str, op: str):
         return lax.dynamic_update_index_in_dim(parts, got, recv_i, axis=0)
 
     parts = lax.fori_loop(0, n - 1, ag_step, parts)
-    out = parts.reshape(-1)[:size].reshape(a.shape)
-    if op == "mean":
-        out = out / n
-    return out
+    return parts.reshape(-1)[:size].reshape(a.shape)
 
 
 def _two_stage_all_reduce_leaf(a, axis_name: str, op: str):
@@ -139,10 +126,10 @@ def _two_stage_all_reduce_leaf(a, axis_name: str, op: str):
     flat = parts.reshape(-1)
     mine = lax.psum_scatter(flat, axis_name, scatter_dimension=0, tiled=True)
     out = lax.all_gather(mine, axis_name, axis=0, tiled=True)
-    out = out[:size].reshape(a.shape)
-    if op == "mean":
-        out = out / n
-    return out
+    return out[:size].reshape(a.shape)
+
+
+_PSUM_FOLD = {"sum": lax.psum, "min": lax.pmin, "max": lax.pmax}
 
 
 def all_reduce_scheduled(x, axis: Axis, op: str = "sum",
@@ -151,6 +138,12 @@ def all_reduce_scheduled(x, axis: Axis, op: str = "sum",
     schedule.  ``schedule='psum'`` is :func:`kungfu_tpu.ops.all_reduce`;
     the others decompose the collective in-program (docstring above).
     Jit/shard_map-composable; every schedule returns the same values.
+
+    ``axis`` may be a tuple of mesh axis names in outer-to-inner order
+    (e.g. a hierarchical communicator's ``(host, local)``): the schedule
+    applies to the FIRST non-trivial axis — the cross-host stage — after
+    the inner axes reduce with one-hop psum over ICI, the reference's
+    local/cross split (``session/strategy.go:176-210``).
     """
     if op not in _OPS:
         raise ValueError(f"unsupported op {op!r}")
@@ -162,10 +155,19 @@ def all_reduce_scheduled(x, axis: Axis, op: str = "sum",
         from kungfu_tpu.ops.collective import all_reduce
 
         return all_reduce(x, axis, op=op)
-    axis_name = _single_axis(axis)
-    leaf = partial(
-        _ring_all_reduce_leaf if schedule == "ring"
-        else _two_stage_all_reduce_leaf,
-        axis_name=axis_name, op=op,
-    )
-    return jax.tree_util.tree_map(lambda a: leaf(a), x)
+    axes = (axis,) if isinstance(axis, str) else tuple(axis)
+    sched_leaf = (_ring_all_reduce_leaf if schedule == "ring"
+                  else _two_stage_all_reduce_leaf)
+    base = "sum" if op == "mean" else op
+
+    def leaf(a):
+        sizes = [lax.axis_size(ax) for ax in axes]
+        real = [ax for ax, s in zip(axes, sizes) if s > 1] or [axes[0]]
+        for ax in real[1:]:  # inner (intra-host) stages: one-hop psum
+            a = _PSUM_FOLD[base](a, ax)
+        a = sched_leaf(a, axis_name=real[0], op=base)
+        if op == "mean":
+            a = a / math.prod(sizes)
+        return a
+
+    return jax.tree_util.tree_map(leaf, x)

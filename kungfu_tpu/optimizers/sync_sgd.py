@@ -11,17 +11,29 @@ def synchronous_sgd(
     inner: optax.GradientTransformation,
     axis,
     average: bool = True,
+    schedule: str = "psum",
 ) -> optax.GradientTransformation:
     """The S-SGD wrapper (reference ``sync_sgd.py:58-109``: group allreduce
     then grad/np).  ``inner`` is any optax optimizer; ``axis`` the mesh
     axis name(s).  With ``average=False`` gradients are summed (the caller
-    scales the LR instead)."""
+    scales the LR instead).
+
+    ``schedule`` selects the allreduce decomposition that gets COMPILED
+    into the training step (``kungfu_tpu.ops.schedules``; pass
+    ``comm.strategy`` to honor a ``set_strategy``/``autotune_strategy``
+    choice).  A strategy swap therefore means rebuilding the optimizer
+    and re-jitting — on TPU the strategy lives in the program, not in a
+    per-message router."""
 
     def init(params):
         return inner.init(params)
 
     def update(grads, state, params=None):
-        grads = ops.group_all_reduce(grads, axis, op="mean" if average else "sum")
+        # schedule="psum" dispatches to the same all_reduce that
+        # group_all_reduce wraps — one call site for every schedule
+        grads = ops.all_reduce_scheduled(
+            grads, axis, op="mean" if average else "sum", schedule=schedule
+        )
         return inner.update(grads, state, params)
 
     return optax.GradientTransformation(init, update)
