@@ -216,7 +216,10 @@ def payload_resnet(args) -> dict:
 
     k_lo = max(1, steps // 4)
     k_hi = max(steps, k_lo + 1)  # --steps 1 must not difference K with itself
-    dt_step = measure_chained(step_c, carry0, k_lo=k_lo, k_hi=k_hi)
+    # CPU smoke runs (seconds per step on one core) must not pay the
+    # settle/re-span machinery built for relay jitter: rounds=1 skips both
+    dt_step = measure_chained(step_c, carry0, k_lo=k_lo, k_hi=k_hi,
+                              rounds=5 if on_tpu else 1)
 
     # prove real training: advance `steps` more real steps and report the
     # loss (random labels, so it decays toward memorization, not 0)
@@ -552,9 +555,6 @@ def measure_group(named_steps, init_carry, k_lo=4, k_hi=12, rounds=5,
         }
 
     names = list(progs)
-    # pilot: a few unsettled rounds, only to size the re-span — its
-    # estimates are discarded once the final phase runs
-    est = measure(names, "pilot", min(rounds, 3), settle=False)
 
     # adaptive span: rebuild any contestant whose two programs are
     # separated by less real compute than the relay's jitter scale.
@@ -564,7 +564,12 @@ def measure_group(named_steps, init_carry, k_lo=4, k_hi=12, rounds=5,
     # OBSERVED dispatch wall (walls[name]/span is a per-iteration upper
     # bound including the RTT share), so a collapsed estimate can never
     # build a program whose single dispatch runs for minutes.
+    # (rounds=1 smoke runs skip the pilot too — its estimates only feed
+    # this block.)
     if rounds >= 2 and target_sep:
+        # pilot: a few unsettled rounds, only to size the re-span — its
+        # estimates are discarded once the final phase runs
+        est = measure(names, "pilot", min(rounds, 3), settle=False)
         for attempt in (1, 2, 3):
             rekeyed = []
             for name in names:

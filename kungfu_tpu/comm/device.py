@@ -81,10 +81,12 @@ class Communicator:
         devices: Optional[Sequence] = None,
         local_size: Optional[int] = None,
         strategy: str = "psum",
+        on_strategy_change: Optional[Callable[[str], None]] = None,
     ):
         self.cluster = cluster
         self.version = version
         self._strategy = "psum"
+        self._on_strategy_change = on_strategy_change
         self.set_strategy(strategy)
         devs = list(devices) if devices is not None else list(jax.devices())
         n = len(devs)
@@ -158,6 +160,10 @@ class Communicator:
                 f"unknown strategy {name!r}; one of {ALLREDUCE_SCHEDULES}"
             )
         self._strategy = name
+        if self._on_strategy_change is not None:
+            # let an owning Peer record the choice durably, so a resize
+            # racing this call cannot rebuild the next epoch without it
+            self._on_strategy_change(name)
 
     def autotune_strategy(self, nbytes: int = 4 << 20, trials: int = 3) -> str:
         """Measure every allreduce schedule on a representative buffer on

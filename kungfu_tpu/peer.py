@@ -50,6 +50,9 @@ class Peer:
         self._channel: Optional[HostChannel] = None
         self._comm: Optional[Communicator] = None
         self._comm_version = -1
+        #: carried across mesh epochs — the resize paths retire the old
+        #: communicator object, not the user's strategy decision
+        self._comm_strategy = "psum"
         self._engine = None
         self._engine_version = -1
         self._lock = threading.RLock()
@@ -253,7 +256,7 @@ class Peer:
                 self._engine.close()
             self._engine = None
             self._engine_version = -1
-            self._comm = None
+            self._retire_comm()  # keep the strategy across close/start
             self._comm_version = -1
             self._started = False
 
@@ -283,6 +286,20 @@ class Peer:
         return self._channel
 
     # -- communicator (mesh epoch) ---------------------------------------
+    def _retire_comm(self) -> None:
+        """Drop the current communicator ahead of a new mesh epoch,
+        preserving the installed allreduce strategy (set_strategy /
+        autotune) for the next epoch's build.  Callers hold the lock."""
+        if self._comm is not None:
+            self._comm_strategy = self._comm.strategy
+        self._comm = None
+
+    def _record_strategy(self, name: str) -> None:
+        """``on_strategy_change`` hook: a ``set_strategy`` call lands on
+        the Peer durably even if the communicator object it was made on
+        is being retired by a concurrent resize."""
+        self._comm_strategy = name
+
     def communicator(self) -> Communicator:
         """The communicator for the current cluster version; rebuilt lazily
         after membership changes (analog of ``Peer.CurrentSession`` +
@@ -297,11 +314,17 @@ class Peer:
                 devices = local_size = None
                 if self._jax_initialized:
                     devices, local_size = self._carve_active_devices()
+                # an installed schedule (set_strategy / autotune)
+                # survives the mesh epoch swap — the resize rebuilds the
+                # mesh, not the user's strategy decision
+                self._retire_comm()
                 self._comm = Communicator(
                     cluster=self.cluster,
                     version=self.cluster_version,
                     devices=devices,
                     local_size=local_size,
+                    strategy=self._comm_strategy,
+                    on_strategy_change=self._record_strategy,
                 )
                 self._comm_version = self.cluster_version
                 _log.info("new %r", self._comm)
@@ -416,7 +439,7 @@ class Peer:
                 # re-carved into a later mesh epoch without a relaunch
                 self.detached = not active and not in_world
                 self.standby = not active and in_world
-                self._comm = None  # next communicator() call builds the new epoch
+                self._retire_comm()  # next communicator() builds the new epoch
                 if self._jax_initialized and active and world is None:
                     new_procs = len(new_cluster.workers)
                     if new_procs != getattr(self, "_jax_world_procs", new_procs):
@@ -509,7 +532,7 @@ class Peer:
                             self._channel.reset_connections()
                         self.standby = False
                         self.detached = False
-                        self._comm = None
+                        self._retire_comm()
                     log_event(f"rejoined-v{version}-n{cluster.size()}")
                     return True
                 # newer stage still excludes us: track the version so a

@@ -194,6 +194,41 @@ class TestCommunicatorStrategy:
         np.testing.assert_array_equal(
             got_min, np.broadcast_to(xa.min(0), xa.shape))
 
+    def test_strategy_survives_mesh_epoch_rebuild(self):
+        """A resize rebuilds the mesh, not the user's strategy decision:
+        the next mesh epoch's Communicator inherits the installed
+        schedule."""
+        from kungfu_tpu.peer import Peer
+        from kungfu_tpu.utils import envs as E
+
+        peer = Peer(config=E.parse_config_from_env({}))
+        comm0 = peer.communicator()
+        comm0.set_strategy("ring")
+        # what _propose/await_rejoin do on a genuine membership change:
+        # retire the communicator object BEFORE the version moves (the
+        # naive `_comm = None` here is how the strategy once got lost)
+        with peer._lock:
+            peer._retire_comm()
+        peer.cluster_version += 1
+        comm1 = peer.communicator()
+        assert comm1 is not comm0
+        assert comm1.strategy == "ring"
+
+    def test_set_strategy_racing_a_resize_still_lands(self):
+        """set_strategy made on a communicator the resize just retired
+        must still reach the next epoch (the on_strategy_change hook
+        records it on the Peer durably)."""
+        from kungfu_tpu.peer import Peer
+        from kungfu_tpu.utils import envs as E
+
+        peer = Peer(config=E.parse_config_from_env({}))
+        comm0 = peer.communicator()
+        with peer._lock:
+            peer._retire_comm()  # a concurrent resize got there first
+        comm0.set_strategy("two_stage")  # user's call on the old object
+        peer.cluster_version += 1
+        assert peer.communicator().strategy == "two_stage"
+
     def test_unknown_strategy_rejected(self):
         comm = self._comm(8)
         with pytest.raises(ValueError, match="unknown strategy"):
