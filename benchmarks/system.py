@@ -163,17 +163,50 @@ def main(argv=None) -> dict:
     params, opt_state, loss = step(params, opt_state, batch0)  # compile
     jax.block_until_ready(loss)
 
-    times = []
-    for i in range(args.warmup + args.steps):
-        b = make_batch(rng, global_batch)
-        t0 = time.perf_counter()
-        params, opt_state, loss = step(params, opt_state, b)
-        jax.block_until_ready(loss)
-        dt = time.perf_counter() - t0
-        if i >= args.warmup:
-            times.append(dt)
+    if on_tpu:
+        # remote-relay backends ack block_until_ready early and cache
+        # byte-identical dispatches — per-step wall timing measures
+        # nothing there (see bench.measure_group).  Chain the step with
+        # a fixed batch (salted per dispatch) and difference two K's,
+        # the window derived from --steps as bench.py's payloads do.
+        from bench import measure_chained
 
-    sps = global_batch * len(times) / sum(times)
+        def step_c(c):
+            p, o, _ = c
+            return step(p, o, batch0)
+
+        k_lo = max(1, args.steps // 4)
+        k_hi = max(args.steps, k_lo + 1)
+        try:
+            dt = measure_chained(step_c, (params, opt_state, loss),
+                                 k_lo=k_lo, k_hi=k_hi)
+        except RuntimeError as e:
+            # honor the one-JSON-line contract even when relay noise
+            # makes the run unmeasurable (no run_guarded retry layer
+            # wraps this entry point)
+            result = {
+                "metric": f"{args.model}_{args.optimizer}_throughput",
+                "value": 0.0, "unit": "samples/sec", "np": n,
+                "error": str(e),
+            }
+            print(json.dumps(result))
+            return result
+        sps = global_batch / dt
+        # prove real training beyond the timing chain
+        for _ in range(args.steps):
+            params, opt_state, loss = step(params, opt_state,
+                                           make_batch(rng, global_batch))
+    else:
+        times = []
+        for i in range(args.warmup + args.steps):
+            b = make_batch(rng, global_batch)
+            t0 = time.perf_counter()
+            params, opt_state, loss = step(params, opt_state, b)
+            jax.block_until_ready(loss)
+            dt = time.perf_counter() - t0
+            if i >= args.warmup:
+                times.append(dt)
+        sps = global_batch * len(times) / sum(times)
     unit = "sequences/sec" if args.model in ("transformer", "bert") else "images/sec"
     result = {
         "metric": f"{args.model}_{args.optimizer}_throughput",
