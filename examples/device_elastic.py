@@ -45,6 +45,11 @@ def main() -> int:
                          "(S-SGD over the re-carved Communicator), carrying "
                          "the model across resizes")
     ap.add_argument("--steps-per-epoch", type=int, default=2)
+    ap.add_argument("--strategy", default="",
+                    help="install an allreduce schedule (psum/two_stage/"
+                         "ring) on the FIRST mesh epoch; later epochs "
+                         "must inherit it across resizes (each KFEPOCH "
+                         "line prints the active strategy)")
     ns = ap.parse_args()
     if ns.steps_per_epoch < 1:
         ap.error("--steps-per-epoch must be >= 1")
@@ -127,6 +132,10 @@ def main() -> int:
 
             v = peer.cluster_version
             comm = peer.communicator()
+            if ns.strategy and v == 0:
+                # installed once; every later epoch's communicator must
+                # inherit it through the resize (peer._retire_comm)
+                comm.set_strategy(ns.strategy)
             # device-plane allreduce over the ACTIVE sub-mesh: each peer
             # contributes (world_rank + 1), so the result identifies
             # exactly which slots participated
@@ -140,7 +149,7 @@ def main() -> int:
             print(
                 f"KFEPOCH v={v} size={peer.size()} rank={peer.rank()} "
                 f"world_rank={my_world_rank} psum={got} expect={expect} "
-                f"pid={os.getpid()} ok={ok}"
+                f"pid={os.getpid()} ok={ok} strategy={comm.strategy}"
                 # full precision: replica-sync checks compare these exactly
                 + (f" loss={loss:.17g}" if loss is not None else ""),
                 flush=True,

@@ -62,6 +62,10 @@ class Peer:
 
         #: this peer's versioned model store (served to gossip peers)
         self.store = VersionedStore()
+        #: control-plane blobs (reserved ``kf.`` names): own eviction
+        #: window so gossip's per-step model versions cannot push out an
+        #: epoch's strategy record before a joiner pulls it
+        self._ctrl_store = VersionedStore(window=8)
         self.net_monitor = None
         self._metrics_server = None
 
@@ -102,7 +106,8 @@ class Peer:
                 )
                 from kungfu_tpu.store import install_p2p_handler
 
-                install_p2p_handler(self._channel, self.store)
+                install_p2p_handler(self._channel, self.store,
+                                    self._ctrl_store)
             if self.config.coordinator and self.config.num_processes > 1:
                 self._init_jax_distributed()
             from kungfu_tpu.utils.affinity import bind_local_rank
@@ -300,6 +305,52 @@ class Peer:
         is being retired by a concurrent resize."""
         self._comm_strategy = name
 
+    _STRATEGY_BLOB = "kf.device-strategy"
+
+    def _sync_device_strategy(self, version: int) -> None:
+        """Cluster-consistent device schedule for a mesh epoch: rank 0's
+        strategy IS the epoch's strategy — it publishes to its blob store
+        keyed by the cluster version, everyone else adopts via a p2p pull
+        (retried: rank 0 publishes when it builds its own communicator).
+
+        This is mandatory, not cosmetic, on multi-controller meshes: a
+        survivor compiling ring collectives while a joiner compiles psum
+        is two DIFFERENT programs on one mesh — a deadlock, not a wrong
+        value.  (The reference sidesteps this by rebuilding sessions from
+        the static configured strategy on every membership change,
+        i.e. runtime swaps do not survive resizes at all; here they
+        survive whenever rank 0 survives.)  A joiner that becomes rank 0
+        resets the epoch to its own default — consistency wins over
+        persistence."""
+        if self._channel is None or self.size() <= 1:
+            return
+        ver = str(version)
+        if self.rank() == 0:
+            # fixed-width payload: Store.save refuses same-name size
+            # changes, and a close/start cycle may legitimately
+            # re-publish a different (longer) strategy for this version
+            self._ctrl_store.save(
+                self._STRATEGY_BLOB,
+                self._comm_strategy.ljust(32).encode(), version=ver
+            )
+            return
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            try:
+                blob = self.request(0, self._STRATEGY_BLOB, version=ver,
+                                    timeout=5.0)
+            except (OSError, ConnectionError, TimeoutError):
+                blob = None
+            if blob:
+                self._comm_strategy = blob.decode().strip()
+                return
+            time.sleep(0.2)
+        _log.warning(
+            "no device-strategy from rank 0 for v%d after 30s; keeping %r "
+            "(mesh-wide schedule mismatch possible)",
+            version, self._comm_strategy,
+        )
+
     def communicator(self) -> Communicator:
         """The communicator for the current cluster version; rebuilt lazily
         after membership changes (analog of ``Peer.CurrentSession`` +
@@ -316,8 +367,10 @@ class Peer:
                     devices, local_size = self._carve_active_devices()
                 # an installed schedule (set_strategy / autotune)
                 # survives the mesh epoch swap — the resize rebuilds the
-                # mesh, not the user's strategy decision
+                # mesh, not the user's strategy decision — and the epoch
+                # agrees on ONE schedule cluster-wide (rank 0's)
                 self._retire_comm()
+                self._sync_device_strategy(self.cluster_version)
                 self._comm = Communicator(
                     cluster=self.cluster,
                     version=self.cluster_version,
@@ -582,12 +635,18 @@ class Peer:
 
     # -- p2p blob store (gossip) -----------------------------------------
     def save(self, name: str, blob: bytes, version: Optional[str] = None) -> None:
+        """Save into this peer's gossip store.  Names under ``kf.`` are
+        reserved for the control plane (served from a separate store)."""
         self.store.save(name, blob, version)
 
-    def request(self, target_rank: int, name: str, version: Optional[str] = None) -> Optional[bytes]:
+    def request(self, target_rank: int, name: str,
+                version: Optional[str] = None,
+                timeout: float = 60.0) -> Optional[bytes]:
         """Pull a named blob from a peer's versioned store
-        (reference ``p2p.go:15-41``, ``handler/p2p.go:102-120``)."""
+        (reference ``p2p.go:15-41``, ``handler/p2p.go:102-120``).
+        ``kf.``-prefixed names are answered from the target's
+        control-plane store."""
         from kungfu_tpu.store import remote_request
 
         target = self.cluster.workers[target_rank]
-        return remote_request(self, target, name, version)
+        return remote_request(self, target, name, version, timeout=timeout)

@@ -25,16 +25,24 @@ _OK = b"\x01"
 _FAIL = b"\x00"
 
 
-def install_p2p_handler(channel: HostChannel, store=None) -> None:
+def install_p2p_handler(channel: HostChannel, store=None,
+                        control_store=None) -> None:
     """Make this endpoint answer blob requests from ``store`` (default: the
-    process-global store)."""
+    process-global store).  Names under the reserved ``kf.`` prefix are
+    served from ``control_store`` instead — control-plane blobs (e.g. the
+    device-strategy epoch record) must not share an eviction window with
+    gossip model traffic, whose per-step versions would push them out."""
 
     def handle(name: str, payload: bytes, src: str):
         # name = "req.<id>"; payload = json {"name":..., "version":...}
         req_id = name[len("req."):]
         try:
             req = json.loads(payload.decode())
-            blob = (store or get_local_store()).get(req["name"], req.get("version") or None)
+            blob_name = req["name"]
+            st = (control_store
+                  if control_store is not None and blob_name.startswith("kf.")
+                  else (store or get_local_store()))
+            blob = st.get(blob_name, req.get("version") or None)
         except (ValueError, KeyError) as e:
             _log.warning("bad p2p request from %s: %s", src, e)
             blob = None
@@ -60,6 +68,8 @@ def remote_request(
     """Pull blob ``name`` from ``target``'s store; None when unavailable."""
     channel = peer.channel
     own_store = getattr(peer, "store", None)
+    if name.startswith("kf."):
+        own_store = getattr(peer, "_ctrl_store", None) or own_store
     if channel is None or target == peer.config.self_id:
         # single-process mode / self-request: serve from the own store
         st = own_store if own_store is not None else get_local_store()

@@ -168,6 +168,46 @@ class TestLiveResize:
                 stderr_all += fh.read()
         assert "keep their original device world" not in stderr_all
 
+    def test_strategy_survives_mesh_epochs_e2e(self, tmp_path):
+        """An allreduce schedule installed on epoch 0 must be the active
+        strategy on every later mesh epoch of every worker — including
+        joiners that were standby at install time and the post-shrink
+        epoch (the real _propose/rejoin paths, not the unit-test
+        shortcut)."""
+        logdir = str(tmp_path / "logs")
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        r = subprocess.run(
+            [sys.executable, "-m", "kungfu_tpu.runner.cli",
+             "-np", "2", "-H", "127.0.0.1:4", "-w", "-device-world",
+             "-builtin-config-port", "9313", "-logdir", logdir, "-q",
+             sys.executable, "examples/device_elastic.py",
+             "--", "--schedule", "2,4,2", "--strategy", "ring"],
+            cwd=REPO, capture_output=True, text=True, timeout=420, env=env,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        lines = []
+        for f in glob.glob(os.path.join(logdir, "*.stdout.log")):
+            with open(f) as fh:
+                lines += fh.read().splitlines()
+        seen = {}
+        for ln in lines:
+            m = re.match(
+                r"KFEPOCH v=(\d+) .*world_rank=(\d+) .*ok=(\w+) "
+                r"strategy=(\w+)", ln)
+            if m:
+                seen.setdefault(int(m.group(1)), []).append(
+                    (int(m.group(2)), m.group(3), m.group(4)))
+        assert sorted(seen) == [0, 1, 2], lines
+        for v, rows in seen.items():
+            for world_rank, ok, strategy in rows:
+                assert ok == "True", (v, rows)
+                # EVERY member of every epoch — survivors across the
+                # shrink AND the standby joiners at v=1 — must run rank
+                # 0's installed schedule: a mixed-schedule mesh would be
+                # two different compiled programs on one collective
+                assert strategy == "ring", (v, world_rank, rows)
+
     def test_training_survives_mesh_epochs(self, tmp_path):
         """REAL S-SGD training (dp_train_step over the re-carved
         Communicator) across 2→4→2: every member of an epoch must report
@@ -191,7 +231,7 @@ class TestLiveResize:
                 lines += fh.read().splitlines()
         losses = {}
         for ln in lines:
-            m = re.match(r"KFEPOCH v=(\d+) .*ok=True loss=([\d.eE+-]+)", ln)
+            m = re.match(r"KFEPOCH v=(\d+) .*ok=True.* loss=([\d.eE+-]+)", ln)
             if m:
                 losses.setdefault(int(m.group(1)), []).append(m.group(2))
         assert sorted(losses) == [0, 1, 2], lines

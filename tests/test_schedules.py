@@ -214,6 +214,31 @@ class TestCommunicatorStrategy:
         assert comm1 is not comm0
         assert comm1.strategy == "ring"
 
+    def test_strategy_blob_survives_gossip_churn(self):
+        """The epoch strategy record lives in the control store, not the
+        gossip window: 3+ per-step model saves must not evict it, and a
+        re-publish with a longer strategy name must not raise (fixed
+        width)."""
+        from kungfu_tpu.peer import Peer
+        from kungfu_tpu.store.p2p import remote_request
+        from kungfu_tpu.utils import envs as E
+
+        peer = Peer(config=E.parse_config_from_env({}))
+        peer._ctrl_store.save(Peer._STRATEGY_BLOB, "psum".ljust(32).encode(),
+                              version="0")
+        # gossip churn: per-step versions roll the gossip store's window
+        for step in range(5):
+            peer.save("model", b"x" * 8, version=str(step))
+        got = remote_request(peer, peer.config.self_id, Peer._STRATEGY_BLOB,
+                             version="0")
+        assert got is not None and got.decode().strip() == "psum"
+        # re-publish a longer name for the same version: fixed width
+        peer._ctrl_store.save(Peer._STRATEGY_BLOB,
+                              "two_stage".ljust(32).encode(), version="0")
+        got = remote_request(peer, peer.config.self_id, Peer._STRATEGY_BLOB,
+                             version="0")
+        assert got.decode().strip() == "two_stage"
+
     def test_set_strategy_racing_a_resize_still_lands(self):
         """set_strategy made on a communicator the resize just retired
         must still reach the next epoch (the on_strategy_change hook
