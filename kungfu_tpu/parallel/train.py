@@ -77,6 +77,7 @@ class ShardedTrainer:
         tx: Optional[optax.GradientTransformation] = None,
         devices=None,
         capacity_factor: float = 1.25,
+        schedule: str = "psum",
     ):
         if cfg.pos != "rope":
             raise NotImplementedError("sharded trainer supports rope positions")
@@ -88,12 +89,22 @@ class ShardedTrainer:
             raise ValueError(f"d_ff {cfg.d_ff} % tp {plan.tp} != 0")
         if n_experts and n_experts % plan.ep:
             raise ValueError(f"n_experts {n_experts} % ep {plan.ep} != 0")
+        from kungfu_tpu.ops.schedules import ALLREDUCE_SCHEDULES
+
+        if schedule not in ALLREDUCE_SCHEDULES:
+            raise ValueError(
+                f"unknown schedule {schedule!r}; one of {ALLREDUCE_SCHEDULES}"
+            )
         self.cfg = cfg
         self.plan = plan
         self.n_experts = n_experts
         self.capacity_factor = capacity_factor
         self.n_micro = n_micro or plan.pp
         self.tx = tx or optax.sgd(0.01)
+        #: allreduce decomposition compiled into sync_grads
+        #: (kungfu_tpu.ops.schedules; pass comm.strategy to honor an
+        #: installed/autotuned choice)
+        self.schedule = schedule
         self.mesh = plan.build_mesh(devices)
         self.param_specs, self.param_kinds = self._layout()
         self._step_fn = None
@@ -305,10 +316,13 @@ class ShardedTrainer:
 
     def sync_grads(self, grads):
         plan = self.plan
+        from kungfu_tpu.ops.schedules import all_reduce_scheduled
 
         def f(g, kind):
             axes, denom_axes = _KIND_AXES[kind]
-            return jax.lax.psum(g, axes) / _axis_prod(plan, denom_axes)
+            g = all_reduce_scheduled(g, axes, op="sum",
+                                     schedule=self.schedule)
+            return g / _axis_prod(plan, denom_axes)
 
         return jax.tree_util.tree_map(f, grads, self.param_kinds)
 
