@@ -100,6 +100,12 @@ def build_model(name: str, quick: bool):
     raise ValueError(f"unknown model {name!r}")
 
 
+def inner_optimizer():
+    """The shared inner update rule — every distributed optimizer wraps
+    THIS, so cross-optimizer rows compare the same update math."""
+    return optax.sgd(1e-3, momentum=0.9)
+
+
 def build_optimizer(name: str, axis, batch: int):
     from kungfu_tpu.optimizers import (
         monitor_gradient_noise_scale,
@@ -108,7 +114,7 @@ def build_optimizer(name: str, axis, batch: int):
         synchronous_sgd,
     )
 
-    inner = optax.sgd(1e-3, momentum=0.9)
+    inner = inner_optimizer()
     if name == "sync-sgd":
         return synchronous_sgd(inner, axis), True
     if name == "sma":
@@ -125,7 +131,7 @@ def main(argv=None) -> dict:
     p.add_argument("--model", default="resnet50",
                    choices=["resnet50", "vgg16", "transformer", "bert"])
     p.add_argument("--optimizer", default="sync-sgd",
-                   choices=["sync-sgd", "sma", "gns", "variance"])
+                   choices=["sync-sgd", "sma", "gns", "variance", "zero1"])
     p.add_argument("--batch-size", type=int, default=0, help="per-device")
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--warmup", type=int, default=3)
@@ -150,12 +156,20 @@ def main(argv=None) -> dict:
         args.steps, args.warmup, batch = 5, 1, 2
 
     params, loss_fn, make_batch = build_model(args.model, args.quick or not on_tpu)
-    tx, replicated = build_optimizer(args.optimizer, comm.axis, batch)
-    step = dp_train_step(loss_fn, tx, comm, replicated_params=replicated)
-    opt_state = tx.init(params)
-    if not replicated:
-        params = stack_for_replicas(params, n)
-        opt_state = stack_for_replicas(opt_state, n)
+    if args.optimizer == "zero1":
+        # weight-update sharding: same wire bytes as sync-sgd, optimizer
+        # state sharded 1/n per device (parallel.zero)
+        from kungfu_tpu.parallel import zero1_train_step
+
+        step, init_opt = zero1_train_step(loss_fn, inner_optimizer(), comm)
+        opt_state = init_opt(params)
+    else:
+        tx, replicated = build_optimizer(args.optimizer, comm.axis, batch)
+        step = dp_train_step(loss_fn, tx, comm, replicated_params=replicated)
+        opt_state = tx.init(params)
+        if not replicated:
+            params = stack_for_replicas(params, n)
+            opt_state = stack_for_replicas(opt_state, n)
 
     rng = np.random.default_rng(0)
     global_batch = batch * n

@@ -66,6 +66,13 @@ class TestHarnesses:
         assert out["metric"] == "bert_sma_throughput"
         assert out["value"] > 0 and out["unit"] == "sequences/sec"
 
+    def test_system_zero1(self):
+        """Weight-update sharding through the throughput harness."""
+        out = run_bench("system.py", "--model", "transformer",
+                        "--optimizer", "zero1", "--cpu-mesh", "2")
+        assert out["metric"] == "transformer_zero1_throughput"
+        assert out["value"] > 0 and out["final_loss"] > 0
+
     def test_gossip(self):
         """BASELINE config 4: PairAveraging gossip over the p2p store."""
         out = run_bench("gossip.py", "--np", "2", "--model", "slp-mnist",
