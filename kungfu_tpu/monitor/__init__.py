@@ -9,7 +9,11 @@
 """
 
 from kungfu_tpu.monitor.detector import DetectorServer, DetectorResults, DEFAULT_DETECTOR_PORT
-from kungfu_tpu.monitor.adaptive import AdaptiveStrategyDriver, monitored_all_reduce
+from kungfu_tpu.monitor.adaptive import (
+    AdaptiveStrategyDriver,
+    DeviceStrategyDriver,
+    monitored_all_reduce,
+)
 from kungfu_tpu.monitor.signals import (
     monitor_batch_begin,
     monitor_batch_end,
@@ -23,6 +27,7 @@ __all__ = [
     "DetectorResults",
     "DEFAULT_DETECTOR_PORT",
     "AdaptiveStrategyDriver",
+    "DeviceStrategyDriver",
     "monitored_all_reduce",
     "monitor_batch_begin",
     "monitor_compile_grace",

@@ -168,3 +168,114 @@ def monitored_all_reduce(engine, x: np.ndarray, driver: AdaptiveStrategyDriver,
     out = engine.all_reduce(x, op=op, name=name)
     driver.step()
     return out
+
+
+class DeviceStrategyDriver:
+    """Step-time-driven re-tuning for the DEVICE plane — the adaptation
+    loop for compiled allreduce schedules (:mod:`kungfu_tpu.ops.schedules`).
+
+    The host-plane :class:`AdaptiveStrategyDriver` watches per-strategy
+    engine throughput; on the device plane the collective is fused into
+    one compiled program, so the observable is the STEP TIME.  The caller
+    feeds measured step seconds; when the window MEDIAN (robust to an
+    aligned periodic outlier like a checkpoint save inside every window)
+    regresses past ``regression``× the established EMA baseline for
+    ``consecutive`` checks — as agreed by a cluster-wide MAJORITY VOTE,
+    exactly like the host driver's interference vote: a locally-decided
+    collective autotune would deadlock controllers whose local clocks
+    disagree at the margin — the driver re-runs
+    :meth:`Communicator.autotune_strategy` and reports True so the
+    caller re-jits its step with ``schedule=comm.strategy``.  Hysteresis
+    comes from the post-swap warm-up: the first window after a re-jit
+    holds the compile and is discarded, and the next seeds a fresh
+    baseline, so a new schedule always gets a clean evaluation window
+    before it can be judged.
+
+    Every controller must call :meth:`observe` every step (the vote is a
+    collective); single-controller meshes vote trivially.
+
+    Typical loop::
+
+        driver = DeviceStrategyDriver(comm)
+        step = make_step(comm.strategy)
+        for batch in data:
+            t0 = time.perf_counter(); ...step...; dt = time.perf_counter()-t0
+            if driver.observe(dt):
+                step = make_step(comm.strategy)   # re-jit on swap
+    """
+
+    def __init__(self, comm, check_every: int = 64, regression: float = 1.3,
+                 consecutive: int = 2, ema: float = 0.1,
+                 autotune_nbytes: int = 4 << 20):
+        self.comm = comm
+        self.check_every = max(1, check_every)
+        self.regression = regression
+        self.consecutive = max(1, consecutive)
+        self.ema = ema
+        self.autotune_nbytes = autotune_nbytes
+        self._baseline = None  # EMA of healthy window medians
+        self._warmed = False  # first window holds the compile; discard it
+        self._window = []
+        self._step = 0
+        self._drops = 0
+        self.swaps = 0
+
+    def _vote(self, suspected: bool) -> bool:
+        """Cluster-wide majority on this window's verdict — every
+        controller must reach the same swap decision or their compiled
+        programs diverge (the host driver's
+        ``majority_vote_interference`` analog, on the device plane)."""
+        import jax.numpy as jnp
+
+        votes = jnp.full((self.comm.addressable_n, 1),
+                         1.0 if suspected else 0.0, jnp.float32)
+        total = float(np.asarray(self.comm.all_reduce(votes)).ravel()[0])
+        return total * 2 > self.comm.size
+
+    def observe(self, step_seconds: float) -> bool:
+        """Feed one measured step time; returns True when the schedule
+        was re-tuned (re-jit your step)."""
+        self._window.append(step_seconds)
+        self._step += 1
+        if self._step % self.check_every:
+            return False
+        med = sorted(self._window)[len(self._window) // 2]
+        self._window = []
+        if not self._warmed:
+            # the first window after (re-)jit contains the XLA compile —
+            # seeding the baseline from it would mask every later
+            # regression (a compile-sized baseline dwarfs real slowdowns)
+            self._warmed = True
+            self._vote(False)  # stay collective: every check votes
+            return False
+        if self._baseline is None:
+            self._baseline = med
+            self._vote(False)
+            return False
+        regressed = med > self.regression * self._baseline
+        # the vote runs on EVERY check (it is a collective — skipping it
+        # on healthy controllers would desynchronize the mesh)
+        agreed = self._vote(regressed)
+        if not agreed:
+            if not regressed:
+                # healthy window: fold into the baseline so slow drift
+                # (bigger model via growth, colder machine) is tracked
+                self._baseline = ((1 - self.ema) * self._baseline
+                                  + self.ema * med)
+            self._drops = 0
+            return False
+        self._drops += 1
+        if self._drops < self.consecutive:
+            return False
+        before = self.comm.strategy
+        ratio = med / self._baseline
+        winner = self.comm.autotune_strategy(nbytes=self.autotune_nbytes)
+        self._drops = 0
+        # the new schedule establishes its own baseline, and its first
+        # window is a fresh re-jit (compile) — discard it again
+        self._baseline = None
+        self._warmed = False
+        self.swaps += 1
+        _log.info("device step-time regression %.2fx: autotune %s -> %s",
+                  ratio, before, winner)
+        return True

@@ -305,6 +305,33 @@ class TestCommunicatorStrategy:
         np.testing.assert_allclose(run(schedule), run("psum"),
                                    rtol=1e-5, atol=1e-6)
 
+    def test_device_strategy_driver(self):
+        """Step-time regression → collective re-autotune → caller told to
+        re-jit; healthy windows track the baseline instead."""
+        from kungfu_tpu.monitor import DeviceStrategyDriver
+
+        comm = self._comm(8)
+        drv = DeviceStrategyDriver(comm, check_every=4, regression=1.5,
+                                   consecutive=2, autotune_nbytes=1 << 10)
+        # healthy baseline windows
+        for _ in range(8):
+            assert not drv.observe(0.010)
+        # a single bad window must NOT trigger (consecutive=2)
+        for _ in range(4):
+            assert not drv.observe(0.030)
+        # second consecutive bad window triggers the re-tune
+        fired = [drv.observe(0.030) for _ in range(4)]
+        assert fired[:3] == [False, False, False] and fired[3]
+        assert drv.swaps == 1
+        assert comm.strategy in ALLREDUCE_SCHEDULES
+        # the new schedule re-establishes its own baseline: the next
+        # window only seeds, no instant re-trigger
+        for _ in range(4):
+            assert not drv.observe(0.030)
+        for _ in range(4):
+            assert not drv.observe(0.030)
+        assert drv.swaps == 1
+
     def test_ctor_strategy(self):
         from kungfu_tpu.comm.device import Communicator
 
