@@ -41,4 +41,13 @@ from kungfu_tpu.python import (  # noqa: F401
     current_communicator,
 )
 
+
+def launch_multiprocess(fn, np_, *args, **kwargs):
+    """Single-machine multi-process launch (reference
+    ``kungfu.cmd.launch_multiprocess``); see
+    :func:`kungfu_tpu.runner.mp.launch_multiprocess`."""
+    from kungfu_tpu.runner.mp import launch_multiprocess as _lm
+
+    return _lm(fn, np_, *args, **kwargs)
+
 __version__ = "0.1.0"

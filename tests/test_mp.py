@@ -1,0 +1,74 @@
+"""launch_multiprocess: the programmatic single-machine launcher
+(reference ``kungfu.cmd.launch_multiprocess`` + ``SingleMachineEnv``)."""
+
+import numpy as np
+import pytest
+
+
+def _worker(rank, size):
+    import kungfu_tpu as kf
+
+    peer = kf.init()
+    assert kf.current_rank() == rank
+    assert kf.cluster_size() == size
+    eng = peer.engine()
+    out = eng.all_reduce(np.full(4, float(rank + 1), np.float32))
+    expect = size * (size + 1) / 2
+    assert np.allclose(out, expect), (rank, out)
+    kf.finalize()
+
+
+def _worker_with_args(rank, size, base, scale=1):
+    assert base == 7 and scale == 3, (base, scale)
+
+
+def _crasher(rank, size):
+    if rank == 1:
+        raise SystemExit(3)
+
+
+def _crash_while_peer_collects(rank, size):
+    """Rank 1 dies pre-collective; rank 0 blocks in an allreduce waiting
+    for it — the launcher must fail fast, not ride out the timeout."""
+    import kungfu_tpu as kf
+
+    if rank == 1:
+        raise SystemExit(3)
+    peer = kf.init()
+    peer.engine().all_reduce(np.ones(4, np.float32))
+
+
+class TestLaunchMultiprocess:
+    def test_cluster_forms_and_allreduces(self):
+        from kungfu_tpu import launch_multiprocess
+
+        launch_multiprocess(_worker, 2, timeout=120)
+
+    def test_args_kwargs_forwarded(self):
+        from kungfu_tpu.runner.mp import launch_multiprocess
+
+        launch_multiprocess(_worker_with_args, 2, 7, scale=3, timeout=60)
+
+    def test_worker_failure_raises(self):
+        from kungfu_tpu.runner.mp import launch_multiprocess
+
+        with pytest.raises(RuntimeError, match="exited with code 3"):
+            launch_multiprocess(_crasher, 2, timeout=60)
+
+    def test_fail_fast_terminates_blocked_survivors(self):
+        """A crashed worker must take the launch down promptly even while
+        a survivor is blocked in a collective waiting for it."""
+        import time
+
+        from kungfu_tpu.runner.mp import launch_multiprocess
+
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="exited with code 3"):
+            launch_multiprocess(_crash_while_peer_collects, 2, timeout=120)
+        assert time.monotonic() - t0 < 60, "fail-fast did not engage"
+
+    def test_bad_np_rejected(self):
+        from kungfu_tpu.runner.mp import launch_multiprocess
+
+        with pytest.raises(ValueError):
+            launch_multiprocess(_worker, 0)
