@@ -113,6 +113,47 @@ class TestSchedules:
                                    rtol=1e-5, atol=1e-5)
 
 
+class TestScheduleFuzz:
+    def test_randomized_sweep_matches_psum(self):
+        """Seeded randomized sweep (the engine-fuzz analog for the device
+        plane): random shapes/dtypes/ops/schedules/mesh splits must all
+        agree with the psum reference."""
+        rng = np.random.RandomState(20260731)
+        for trial in range(25):
+            local = int(rng.choice([1, 2, 4, 8]))
+            schedule = str(rng.choice(["two_stage", "ring"]))
+            op = str(rng.choice(["sum", "mean", "min", "max"]))
+            length = int(rng.randint(1, 300))
+            dtype = rng.choice([np.float32, np.int32])
+            if dtype is np.int32:
+                x = rng.randint(-1000, 1000, (N_DEV, length)).astype(np.int32)
+                if op == "mean":
+                    op = "sum"  # int mean: ill-defined either way
+            else:
+                x = rng.randn(N_DEV, length).astype(np.float32)
+            mesh = Mesh(np.asarray(jax.devices()[:N_DEV]).reshape(
+                N_DEV // local, local), ("h", "l"))
+
+            def body(s, op=op, schedule=schedule):
+                return all_reduce_scheduled(s, ("h", "l"), op=op,
+                                            schedule=schedule)
+
+            f = shard_map(body, mesh=mesh, in_specs=(P(("h", "l")),),
+                          out_specs=P(("h", "l")))
+            got = np.asarray(jax.jit(f)(jnp.asarray(x)))
+            ref = _reference(op, x)
+            if x.dtype == np.int32:
+                np.testing.assert_array_equal(
+                    got, ref.astype(got.dtype),
+                    err_msg=f"trial {trial}: {schedule}/{op}/{length}/"
+                            f"{local}")
+            else:
+                np.testing.assert_allclose(
+                    got, ref, rtol=1e-5, atol=1e-5,
+                    err_msg=f"trial {trial}: {schedule}/{op}/{length}/"
+                            f"{local}")
+
+
 class TestCommunicatorStrategy:
     """Strategy selection on the eager Communicator (the reference's
     ``SetGlobalStrategy`` analog, ``session/adaptation.go:8-28``)."""
