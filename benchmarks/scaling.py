@@ -1,0 +1,91 @@
+#!/usr/bin/env python3
+"""Scaling sweep: training throughput vs cluster size in one run.
+
+Parity with the reference's ``benchmarks/scaling/benchmark_kungfu_scaling.py``
+(and the sync-scalability story its README plots, ``README.md:201-213``):
+run the synthetic-throughput harness at a ladder of cluster sizes and
+report per-size throughput plus scaling efficiency (throughput_n /
+(n × throughput_1)).
+
+Each size runs in a fresh subprocess — a JAX backend cannot be re-shaped
+in-process — through ``benchmarks/system.py``, so the measured path is
+identical to the standalone rows.
+
+    python benchmarks/scaling.py --sizes 1,2,4,8 --model transformer --quick
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_size(n: int, model: str, optimizer: str, quick: bool,
+             timeout: float) -> dict:
+    cmd = [sys.executable, os.path.join(REPO, "benchmarks", "system.py"),
+           "--model", model, "--optimizer", optimizer, "--cpu-mesh", str(n)]
+    if quick:
+        cmd.append("--quick")
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        # one hung rung must not discard the sizes already measured
+        return {"error": f"timed out after {timeout:.0f}s"}
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.strip()]
+    if r.returncode != 0 or not lines:
+        tail = (r.stderr or r.stdout).strip().splitlines()[-3:]
+        return {"error": f"rc={r.returncode}: " + " | ".join(tail)[-300:]}
+    return json.loads(lines[-1])
+
+
+def main(argv=None) -> dict:
+    p = argparse.ArgumentParser()
+    p.add_argument("--sizes", default="1,2,4,8",
+                   help="comma list of virtual-mesh sizes")
+    p.add_argument("--model", default="transformer")
+    p.add_argument("--optimizer", default="sync-sgd")
+    p.add_argument("--quick", action="store_true")
+    p.add_argument("--timeout", type=float, default=420.0, help="per size")
+    args = p.parse_args(argv)
+    sizes = [int(s) for s in args.sizes.split(",") if s]
+
+    by_np, unit = {}, None
+    for n in sizes:
+        out = run_size(n, args.model, args.optimizer, args.quick,
+                       args.timeout)
+        by_np[str(n)] = out.get("value") if "error" not in out else None
+        unit = out.get("unit", unit)
+        if "error" in out:
+            print(f"scaling: np={n}: {out['error']}", file=sys.stderr)
+
+    base_np = sizes[0]
+    base = by_np.get(str(base_np))
+    efficiency = {
+        s: (None if v is None or not base
+            else round(v / (int(s) / base_np) / base, 3))
+        for s, v in by_np.items()
+    }
+    result = {
+        "metric": f"{args.model}_{args.optimizer}_scaling",
+        # headline value: throughput at the largest measured size
+        "value": by_np.get(str(sizes[-1])) or 0.0,
+        "unit": unit or "samples/sec",
+        "throughput_by_np": by_np,
+        "baseline_np": base_np,
+        f"scaling_efficiency_vs_np{base_np}": efficiency,
+        "note": ("virtual CPU mesh on one machine: sizes share the same "
+                 "physical cores, so efficiency reflects collective + "
+                 "dispatch overhead shape, not real-chip scaling"),
+    }
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -66,6 +66,19 @@ class TestHarnesses:
         assert out["metric"] == "bert_sma_throughput"
         assert out["value"] > 0 and out["unit"] == "sequences/sec"
 
+    def test_scaling_sweep(self):
+        """The scaling-ladder harness (reference benchmark_kungfu_scaling
+        analog): per-size throughput + efficiency in one JSON."""
+        # outer timeout > sum of per-size inner timeouts, so two rungs
+        # individually within budget cannot kill the test
+        out = run_bench("scaling.py", "--sizes", "1,2", "--quick",
+                        "--timeout", "200", timeout=520)
+        assert out["metric"] == "transformer_sync-sgd_scaling"
+        assert set(out["throughput_by_np"]) == {"1", "2"}
+        assert out["throughput_by_np"]["1"] > 0
+        assert out["baseline_np"] == 1
+        assert out["scaling_efficiency_vs_np1"]["1"] == 1.0
+
     def test_system_zero1(self):
         """Weight-update sharding through the throughput harness."""
         out = run_bench("system.py", "--model", "transformer",
