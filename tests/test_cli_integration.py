@@ -104,6 +104,22 @@ class TestLongContextExample:
         assert "OK" in r.stdout
 
 
+class TestStrategyTourExample:
+    def test_tour_runs_all_stages(self):
+        """autotune → scheduled training → adaptive re-tune → zero1,
+        in one run on the virtual mesh."""
+        r = run_cli_prog(
+            [sys.executable, "examples/strategy_tour.py",
+             "--cpu-devices", "8", "--steps", "18"],
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "[1] autotune" in r.stdout
+        # deterministic: the injected-slowdown windows produce exactly one
+        # re-tune at --steps 18 (check_every=3, consecutive=2)
+        assert "adaptive re-tunes: 1" in r.stdout
+        assert "[4] zero1" in r.stdout and "(1/8)" in r.stdout
+
+
 class TestCLIParsing:
     def test_parser_flags(self):
         from kungfu_tpu.runner.cli import build_cluster, build_parser
