@@ -18,6 +18,12 @@ Two backends behind one API (save/restore/latest_step/prune):
 Select with ``KF_TPU_CKPT_BACKEND`` (``auto`` | ``orbax`` | ``npz``).
 Restore reads whichever format the newest checkpoint has, so a job can
 switch backends mid-history.
+
+``save_checkpoint_async`` overlaps the file IO with training: the host
+snapshot is taken synchronously (copy — safe against donated-buffer
+reuse), the write runs on one ordered background thread; call
+``wait_pending_checkpoints()`` before a shutdown/restart that relies on
+the newest checkpoint being durable.
 """
 
 from __future__ import annotations
@@ -26,6 +32,10 @@ import json
 import os
 import shutil
 import tempfile
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as _FutureTimeout
 from typing import Any, Optional, Tuple
 
 import jax
@@ -200,6 +210,98 @@ def _restore_orbax(path: str, like_tree, step: int):
     tree = jax.tree_util.tree_unflatten(treedef, conformed)
     _log.info("restored checkpoint %s (meta=%s)", path, meta)
     return tree, step, dict(meta)
+
+
+# -- async save -----------------------------------------------------------
+# one background writer: successive checkpoints must land in order, and a
+# second writer would only contend on the same disk
+_writer_lock = threading.Lock()
+_writer: Optional[ThreadPoolExecutor] = None
+_pending: list = []
+
+
+def _get_writer() -> ThreadPoolExecutor:
+    global _writer
+    with _writer_lock:
+        if _writer is None:
+            _writer = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="kf-ckpt"
+            )
+        return _writer
+
+
+def save_checkpoint_async(ckpt_dir: str, step: int, tree,
+                          meta: Optional[dict] = None) -> "Future[str]":
+    """Overlap the checkpoint's file IO with training.
+
+    The device→host materialization happens HERE, synchronously — the
+    snapshot must be taken before the train loop's next donated step
+    invalidates the buffers — then serialization + the atomic write run
+    on a single background writer thread (ordered across calls).
+
+    Returns a ``Future[str]`` resolving to the checkpoint path;
+    ``.result()`` re-raises any write failure.  Call
+    :func:`wait_pending_checkpoints` before relying on the newest
+    checkpoint existing (e.g. at shutdown or before a restart-recovery
+    exit).
+
+    **Durability-before-report**: anything that advertises progress to a
+    recovery mechanism (the ``epoch`` heartbeat signal, a progress file)
+    must wait for THIS save's future first — observed failure mode: an
+    epoch signal sent while its checkpoint was still in flight made the
+    post-crash restart resume from an epoch whose file never landed.
+    Overlap is for saves whose completion nothing reports yet (mid-epoch
+    step checkpoints, periodic safety snapshots).
+    """
+    def snapshot(leaf):
+        # np.array (copy), not np.asarray: a leaf that is ALREADY numpy
+        # would alias the caller's buffer and a later in-place mutation
+        # (donated step reuse) would corrupt the in-flight write
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            raise ValueError(
+                "save_checkpoint_async snapshots to host numpy and "
+                "requires fully-addressable arrays; for multi-host "
+                "sharded state use orbax's async checkpointing directly"
+            )
+        return np.array(leaf)
+
+    host_tree = jax.tree_util.tree_map(snapshot, tree)
+    fut = _get_writer().submit(save_checkpoint, ckpt_dir, step, host_tree, meta)
+    with _writer_lock:
+        # prune only SUCCESSFUL finished writes — a failed one must stay
+        # queued so wait_pending_checkpoints still surfaces its error
+        _pending[:] = [f for f in _pending
+                       if not f.done() or f.exception() is not None]
+        _pending.append(fut)
+    return fut
+
+
+def wait_pending_checkpoints(timeout: Optional[float] = None) -> None:
+    """Block until every async checkpoint issued so far is durable.
+
+    Waits for ALL pending writes before raising the FIRST write failure
+    (an early failure must not leave later in-flight saves untracked);
+    ``timeout`` is one overall deadline, and futures still running when
+    it expires are re-queued before ``TimeoutError`` propagates."""
+    with _writer_lock:
+        pending = list(_pending)
+        _pending.clear()
+    deadline = None if timeout is None else time.monotonic() + timeout
+    first_err: Optional[BaseException] = None
+    for i, f in enumerate(pending):
+        left = (None if deadline is None
+                else max(0.0, deadline - time.monotonic()))
+        try:
+            f.result(left)
+        except _FutureTimeout:
+            with _writer_lock:
+                _pending.extend(pending[i:])  # still in flight: re-track
+            raise
+        except BaseException as e:  # noqa: BLE001 — surfaced after all wait
+            if first_err is None:
+                first_err = e
+    if first_err is not None:
+        raise first_err
 
 
 def prune_checkpoints(ckpt_dir: str, keep: int = 3) -> None:

@@ -103,3 +103,49 @@ class TestStructureDrift:
         grown = dict(_tree(), extra=np.zeros(2, np.float32))
         with pytest.raises(KeyError):
             ckpt.restore_checkpoint(str(tmp_path), grown)
+
+
+class TestAsyncSave:
+    def test_async_roundtrip(self, backend, tmp_path):
+        tree = _tree()
+        fut = ckpt.save_checkpoint_async(str(tmp_path), 3, tree, {"epoch": 3})
+        path = fut.result(60)
+        assert os.path.exists(path)
+        restored, step, meta = ckpt.restore_checkpoint(str(tmp_path), tree)
+        assert step == 3 and meta == {"epoch": 3}
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.asarray(tree["w"]))
+
+    def test_snapshot_is_immune_to_later_mutation(self, backend, tmp_path):
+        """The host snapshot happens at call time: mutating the source
+        arrays afterwards (a donated train step reusing buffers) must not
+        corrupt the write."""
+        src = {"w": np.arange(8, dtype=np.float32)}
+        fut = ckpt.save_checkpoint_async(str(tmp_path), 1, src)
+        src["w"] += 1000.0  # in-place mutation after issue
+        fut.result(60)
+        restored, _, _ = ckpt.restore_checkpoint(
+            str(tmp_path), {"w": np.zeros(8, np.float32)})
+        np.testing.assert_array_equal(restored["w"],
+                                      np.arange(8, dtype=np.float32))
+
+    def test_wait_pending_surfaces_failure(self, backend, tmp_path):
+        bad_dir = os.path.join(str(tmp_path), "file-not-dir")
+        with open(bad_dir, "w") as f:
+            f.write("x")
+        ckpt.save_checkpoint_async(bad_dir, 1, _tree())
+        with pytest.raises((OSError, NotADirectoryError, FileExistsError)):
+            ckpt.wait_pending_checkpoints(60)
+        # queue is drained after the failure is surfaced
+        ckpt.wait_pending_checkpoints(5)
+
+    def test_ordering_newest_wins(self, backend, tmp_path):
+        for step in (1, 2, 3):
+            tree = {"w": jnp.full((4,), float(step), jnp.float32)}
+            ckpt.save_checkpoint_async(str(tmp_path), step, tree)
+        ckpt.wait_pending_checkpoints(120)
+        assert ckpt.latest_step(str(tmp_path)) == 3
+        restored, _, _ = ckpt.restore_checkpoint(
+            str(tmp_path), {"w": jnp.zeros((4,), jnp.float32)})
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.full((4,), 3.0))
