@@ -52,7 +52,7 @@ class Peer:
         self._comm_version = -1
         #: carried across mesh epochs — the resize paths retire the old
         #: communicator object, not the user's strategy decision
-        self._comm_strategy = "psum"
+        self._comm_strategy = self.config.device_strategy or "psum"
         self._engine = None
         self._engine_version = -1
         self._lock = threading.RLock()

@@ -57,6 +57,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="start a built-in config server on this port")
     p.add_argument("-auto-recover", dest="auto_recover", default="",
                    help="failure-detection period (e.g. 10s); enables MonitoredRun")
+    p.add_argument("-device-strategy", dest="device_strategy", default="",
+                   help="initial device allreduce schedule "
+                        "(psum/two_stage/ring; empty = psum)")
     p.add_argument("-compile-grace", dest="compile_grace",
                    default=f"{int(DEFAULT_COMPILE_GRACE_S)}s",
                    help="stall allowance while a rank is known to be "
@@ -187,6 +190,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     if ns.backend is None:
         ns.backend = "cpu"
     strategy = parse_strategy(ns.strategy)
+    if ns.device_strategy:
+        from kungfu_tpu.ops.schedules import ALLREDUCE_SCHEDULES
+
+        if ns.device_strategy not in ALLREDUCE_SCHEDULES:
+            raise SystemExit(
+                f"kfrun: unknown -device-strategy {ns.device_strategy!r}; "
+                f"one of {ALLREDUCE_SCHEDULES}"
+            )
     cluster = build_cluster(ns)
 
     config_server_url = ns.config_server
@@ -208,6 +219,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog=ns.prog,
         args=[a for a in ns.args if a != "--"],
         strategy=strategy,
+        device_strategy=ns.device_strategy,
         config_server=config_server_url,
         log_dir=ns.logdir,
         parent=PeerID(ns.self_host, DEFAULT_RUNNER_PORT),

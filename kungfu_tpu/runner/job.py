@@ -31,6 +31,7 @@ class Job:
     prog: str
     args: List[str]
     strategy: Strategy = Strategy.AUTO
+    device_strategy: str = ""  # initial device allreduce schedule
     config_server: str = ""
     log_dir: str = ""
     parent: Optional[PeerID] = None
@@ -50,6 +51,8 @@ class Job:
             envs.INIT_RUNNERS: str(cluster.runners),
             envs.INIT_CLUSTER_VERSION: str(version),
             envs.ALLREDUCE_STRATEGY: str(self.strategy),
+            **({envs.DEVICE_STRATEGY: self.device_strategy}
+               if self.device_strategy else {}),
             envs.JOB_START_TIMESTAMP: f"{self.job_start:.3f}",
             envs.PROC_START_TIMESTAMP: f"{time.time():.3f}",
         }

@@ -112,6 +112,8 @@ def _runner_command(
         "-strategy", ns.strategy,
         "-port-range", ns.port_range,
     ]
+    if getattr(ns, "device_strategy", ""):
+        cmd += ["-device-strategy", ns.device_strategy]
     if ns.logdir:
         cmd += ["-logdir", ns.logdir]
     if ns.quiet:
@@ -131,6 +133,8 @@ def main_rrun(argv: Optional[List[str]] = None) -> int:
     p.add_argument("-H", dest="hosts", required=True,
                    help="host spec list ip:slots[:public_addr],...")
     p.add_argument("-strategy", default="AUTO")
+    p.add_argument("-device-strategy", dest="device_strategy", default="",
+                   help="initial device allreduce schedule for all hosts")
     p.add_argument("-port-range", dest="port_range", default="10000-11000")
     p.add_argument("-u", dest="user", default="", help="ssh user name")
     p.add_argument("-logdir", default="", help="remote per-worker log dir")

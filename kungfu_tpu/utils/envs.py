@@ -13,7 +13,8 @@ Bootstrap envs (written by the runner, read once at init):
 ``KF_INIT_RUNNERS``         comma-separated runner list
 ``KF_PARENT_ID``            runner that spawned us (``host:port``)
 ``KF_INIT_CLUSTER_VERSION`` integer mesh-epoch at spawn time
-``KF_ALLREDUCE_STRATEGY``   strategy name (see plan.strategy)
+``KF_ALLREDUCE_STRATEGY``   host-engine strategy name (see plan.strategy)
+``KF_DEVICE_STRATEGY``      device allreduce schedule (ops.schedules)
 ``KF_CONFIG_SERVER``        URL of the elastic config server
 ``KF_JOB_START_TIMESTAMP``  unix seconds the job started (event timeline)
 ``KF_PROC_START_TIMESTAMP`` unix seconds this process started
@@ -69,6 +70,7 @@ INIT_RUNNERS = "KF_INIT_RUNNERS"
 PARENT_ID = "KF_PARENT_ID"
 INIT_CLUSTER_VERSION = "KF_INIT_CLUSTER_VERSION"
 ALLREDUCE_STRATEGY = "KF_ALLREDUCE_STRATEGY"
+DEVICE_STRATEGY = "KF_DEVICE_STRATEGY"
 CONFIG_SERVER = "KF_CONFIG_SERVER"
 JOB_START_TIMESTAMP = "KF_JOB_START_TIMESTAMP"
 PROC_START_TIMESTAMP = "KF_PROC_START_TIMESTAMP"
@@ -126,6 +128,8 @@ class Config:
     cluster: Cluster
     parent: Optional[PeerID] = None
     strategy: Strategy = Strategy.AUTO
+    #: initial device-plane allreduce schedule ("" = psum default)
+    device_strategy: str = ""
     init_version: int = 0
     config_server: str = ""
     single_process: bool = False
@@ -164,7 +168,8 @@ def parse_config_from_env(env=None) -> Config:
     self_spec = env.get(SELF_SPEC)
     if not self_spec:
         c = Cluster.single_process()
-        return Config(self_id=c.workers[0], cluster=c, single_process=True)
+        return Config(self_id=c.workers[0], cluster=c, single_process=True,
+                      device_strategy=env.get(DEVICE_STRATEGY, ""))
     self_id = parse_peer_id(self_spec)
     workers = PeerList.parse(env.get(INIT_PEERS, self_spec))
     runners_spec = env.get(INIT_RUNNERS, "")
@@ -191,6 +196,7 @@ def parse_config_from_env(env=None) -> Config:
         cluster=cluster,
         parent=parent,
         strategy=parse_strategy(env.get(ALLREDUCE_STRATEGY, "AUTO")),
+        device_strategy=env.get(DEVICE_STRATEGY, ""),
         init_version=int(env.get(INIT_CLUSTER_VERSION, "0")),
         config_server=env.get(CONFIG_SERVER, ""),
         coordinator=env.get(COORDINATOR, ""),

@@ -235,6 +235,25 @@ class TestCommunicatorStrategy:
         np.testing.assert_array_equal(
             got_min, np.broadcast_to(xa.min(0), xa.shape))
 
+    def test_env_contract_sets_initial_strategy(self):
+        """KF_DEVICE_STRATEGY (the launcher's -device-strategy) seeds the
+        peer's schedule — the reference's KUNGFU_ALLREDUCE_STRATEGY
+        contract, device plane."""
+        from kungfu_tpu.peer import Peer
+        from kungfu_tpu.runner.job import Job
+        from kungfu_tpu.plan import Cluster, HostList
+        from kungfu_tpu.utils import envs as E
+
+        peer = Peer(config=E.parse_config_from_env(
+            {E.DEVICE_STRATEGY: "two_stage"}))
+        assert peer.communicator().strategy == "two_stage"
+        # and the launcher writes it into worker envs
+        hl = HostList.parse("127.0.0.1:2")
+        cluster = Cluster(hl.gen_runner_list(), hl.gen_peer_list(2))
+        job = Job(prog="python3", args=["t.py"], device_strategy="ring")
+        p = job.new_proc(cluster.workers[0], cluster)
+        assert p.envs[E.DEVICE_STRATEGY] == "ring"
+
     def test_strategy_survives_mesh_epoch_rebuild(self):
         """A resize rebuilds the mesh, not the user's strategy decision:
         the next mesh epoch's Communicator inherits the installed
