@@ -962,20 +962,36 @@ def main() -> None:
     else:
         out = {"error": f"backend preflight failed: {pre_err}"}
     if "error" in out and "metric" not in out:
-        # keep the one-JSON-line contract even in total failure
+        # keep the one-JSON-line contract even in total failure.
+        # one table per payload: (metric, unit, BENCH_extra section)
+        payload_info = {
+            "resnet": ("resnet50_sync_sgd_images_per_sec_per_chip",
+                       "images/sec", "tpu_headline"),
+            "kernels": ("pallas_kernel_speedup_vs_xla", "x", "tpu_kernels"),
+            "allreduce": ("allreduce_bus_bandwidth", "GiB/s",
+                          "tpu_allreduce_floor"),
+            "lm": ("gpt_small_sync_sgd_tokens_per_sec_per_chip",
+                   "tokens/sec", "tpu_lm"),
+        }
+        metric, unit, section = payload_info[which]
         out = {
-            "metric": {
-                "resnet": "resnet50_sync_sgd_images_per_sec_per_chip",
-                "kernels": "pallas_kernel_speedup_vs_xla",
-                "allreduce": "allreduce_bus_bandwidth",
-                "lm": "gpt_small_sync_sgd_tokens_per_sec_per_chip",
-            }[which],
+            "metric": metric,
             "value": 0.0,
-            "unit": {"resnet": "images/sec", "kernels": "x",
-                     "allreduce": "GiB/s", "lm": "tokens/sec"}[which],
+            "unit": unit,
             "vs_baseline": 0.0,
             "error": out["error"],
         }
+        # a wedged tunnel says nothing about the framework: point at the
+        # in-tree recorded run of this same payload (BENCH_extra.json)
+        try:
+            with open(os.path.join(REPO, "BENCH_extra.json")) as f:
+                rec = json.load(f).get(section, {})
+            value = rec.get("value") if isinstance(rec, dict) else None
+            if value is not None:
+                out["last_recorded_value"] = value
+                out["last_recorded_source"] = "BENCH_extra.json (in-tree run)"
+        except (OSError, ValueError, TypeError, AttributeError):
+            pass
     print(json.dumps(out))
 
 
