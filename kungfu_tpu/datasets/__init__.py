@@ -2,3 +2,4 @@ from kungfu_tpu.datasets.adaptor import ElasticDataset  # noqa: F401
 from kungfu_tpu.datasets.cifar import load_cifar10  # noqa: F401
 from kungfu_tpu.datasets.imagenet import ImageNetFolder  # noqa: F401
 from kungfu_tpu.datasets.mnist import load_mnist, synthetic_mnist  # noqa: F401
+from kungfu_tpu.datasets.prefetch import prefetch_to_device  # noqa: F401

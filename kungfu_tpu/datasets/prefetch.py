@@ -1,0 +1,88 @@
+"""Device prefetch: overlap host→device transfer with compute.
+
+The training loops in this framework consume numpy batches
+(:class:`~kungfu_tpu.datasets.adaptor.ElasticDataset`, the loader
+helpers); every ``step(params, opt, batch)`` call then pays the
+host→device copy on the critical path.  ``prefetch_to_device`` wraps any
+batch iterator and keeps ``size`` batches already resident on device: a
+background thread stages batch N+k while the step computes on batch N —
+the standard TPU input-pipeline overlap (flax's ``jax_utils.prefetch``
+shape, re-homed here so the elastic loaders get it too).
+
+The transfer thread only calls ``jax.device_put`` (safe off-thread);
+iterator exhaustion and worker exceptions propagate to the consumer.
+On resize, drop the prefetcher with the rest of the mesh epoch and wrap
+the (re-sharded) iterator again — staged batches belong to a device
+layout that no longer exists.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator
+
+import jax
+
+_SENTINEL = object()
+
+
+def prefetch_to_device(it: Iterable, size: int = 2,
+                       device=None) -> Iterator:
+    """Yield items of ``it`` with up to ``size`` of them pre-staged on
+    ``device`` (default: the default device).  Each item is passed
+    through ``jax.device_put`` as a pytree.
+
+    A plain function (not a generator): validation and the transfer
+    thread start EAGERLY at the call, so staging overlaps any setup the
+    caller does before its loop.  Closing/abandoning the returned
+    iterator (including ``break`` and the per-resize re-wrap this module
+    recommends) stops the worker and releases the staged device buffers
+    — a blocked producer must not pin HBM forever.
+    """
+    if size < 1:
+        raise ValueError("size must be >= 1")
+    q: "queue.Queue" = queue.Queue(maxsize=size)
+    stop = threading.Event()
+
+    def offer(item) -> bool:
+        """put() that a consumer shutdown can always unblock."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for item in it:
+                if not offer(jax.device_put(item, device)):
+                    return
+            offer(_SENTINEL)
+        except BaseException as e:  # noqa: BLE001 — re-raised by consumer
+            offer(e)
+
+    t = threading.Thread(target=worker, daemon=True, name="kf-prefetch")
+    t.start()
+
+    def gen():
+        try:
+            while True:
+                item = q.get()
+                if item is _SENTINEL:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            try:  # unblock a producer waiting on a full queue
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(5)
+
+    return gen()

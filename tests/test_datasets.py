@@ -357,3 +357,90 @@ class TestImageNetFolder:
         assert np.isfinite(x).all()
         with pytest.raises(OSError):
             ImageNetFolder(image_size=32, synthetic_fallback=False)
+
+
+class TestPrefetch:
+    def test_yields_device_arrays_in_order(self):
+        import jax
+
+        from kungfu_tpu.datasets import prefetch_to_device
+
+        batches = [(np.full((2,), i, np.float32), np.int32(i))
+                   for i in range(6)]
+        out = list(prefetch_to_device(iter(batches), size=2))
+        assert len(out) == 6
+        for i, (x, y) in enumerate(out):
+            assert isinstance(x, jax.Array)
+            np.testing.assert_array_equal(np.asarray(x), np.full((2,), i))
+            assert int(y) == i
+
+    def test_overlaps_slow_producer(self):
+        """The consumer must see batches staged AHEAD: with a slow
+        consumer, the producer should have queued more than one batch by
+        the time the consumer asks."""
+        import time
+
+        from kungfu_tpu.datasets import prefetch_to_device
+
+        produced = []
+
+        def gen():
+            for i in range(4):
+                produced.append(i)
+                yield np.full((1,), i, np.float32)
+
+        it = prefetch_to_device(gen(), size=3)
+        first = next(it)
+        time.sleep(0.3)  # producer runs ahead while we "compute"
+        assert len(produced) >= 3  # staged beyond the consumed batch
+        rest = list(it)
+        assert len(rest) == 3
+        np.testing.assert_array_equal(np.asarray(first), [0.0])
+
+    def test_worker_exception_propagates(self):
+        from kungfu_tpu.datasets import prefetch_to_device
+
+        def gen():
+            yield np.zeros((1,), np.float32)
+            raise RuntimeError("loader broke")
+
+        it = prefetch_to_device(gen(), size=2)
+        next(it)
+        with pytest.raises(RuntimeError, match="loader broke"):
+            list(it)
+
+    def test_bad_size_rejected(self):
+        from kungfu_tpu.datasets import prefetch_to_device
+
+        with pytest.raises(ValueError):
+            list(prefetch_to_device(iter([]), size=0))
+
+    def test_abandoned_iterator_releases_worker(self):
+        """break-ing out (or re-wrapping on resize) must stop the
+        producer thread instead of leaving it pinned on a full queue."""
+        import threading
+
+        from kungfu_tpu.datasets import prefetch_to_device
+
+        def gen():
+            for i in range(1000):
+                yield np.full((1,), i, np.float32)
+
+        it = prefetch_to_device(gen(), size=2)
+        next(it)
+        it.close()  # what GC/break does
+        import time
+        deadline = time.time() + 5
+        while time.time() < deadline and any(
+            t.name == "kf-prefetch" and t.is_alive()
+            for t in threading.enumerate()
+        ):
+            time.sleep(0.05)
+        assert not any(t.name == "kf-prefetch" and t.is_alive()
+                       for t in threading.enumerate())
+
+    def test_eager_validation(self):
+        from kungfu_tpu.datasets import prefetch_to_device
+
+        with pytest.raises(ValueError):
+            prefetch_to_device(iter([]), size=0)  # at the CALL, not later
