@@ -14,6 +14,14 @@ iterator exhaustion and worker exceptions propagate to the consumer.
 On resize, drop the prefetcher with the rest of the mesh epoch and wrap
 the (re-sharded) iterator again — staged batches belong to a device
 layout that no longer exists.
+
+Consumption accounting caveat: the SOURCE iterator runs up to ``size``
+batches ahead of what the training loop has actually used.  A loader
+that tracks consumed samples (:class:`ElasticDataset`) will therefore
+have over-counted by the staged batches at the moment of a resize;
+either rewind with ``skip(actually_consumed)`` before re-wrapping, or
+prefetch only within resize-free spans (e.g. re-wrap per epoch, resize
+at epoch boundaries — the shape every elastic example here uses).
 """
 
 from __future__ import annotations
