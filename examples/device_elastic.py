@@ -50,6 +50,11 @@ def main() -> int:
                          "ring) on the FIRST mesh epoch; later epochs "
                          "must inherit it across resizes (each KFEPOCH "
                          "line prints the active strategy)")
+    ap.add_argument("--autotune", action="store_true",
+                    help="run autotune_strategy on the first mesh epoch "
+                         "(the multi-controller settled-path proof: every "
+                         "process must land the same measured winner, "
+                         "printed in the KFEPOCH strategy= field)")
     ns = ap.parse_args()
     if ns.steps_per_epoch < 1:
         ap.error("--steps-per-epoch must be >= 1")
@@ -136,6 +141,11 @@ def main() -> int:
                 # installed once; every later epoch's communicator must
                 # inherit it through the resize (peer._retire_comm)
                 comm.set_strategy(ns.strategy)
+            if ns.autotune and v == 0:
+                # every controller times the same chained-K compiled
+                # programs and the winner is a device-plane argmin — all
+                # processes must install the SAME schedule
+                comm.autotune_strategy(nbytes=1 << 12, trials=1)
             # device-plane allreduce over the ACTIVE sub-mesh: each peer
             # contributes (world_rank + 1), so the result identifies
             # exactly which slots participated
