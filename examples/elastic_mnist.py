@@ -75,6 +75,7 @@ def main():
         state, params, stop = elastic_step(peer, state, args.schedule, params)
         if stop:
             print(f"worker {rank}: detached at step {state.step}", flush=True)
+            kf.finalize()
             return 0
         if rank == 0 and state.step % 3 == 0:
             print(f"step {state.step}: size {kf.cluster_size()} loss {float(loss):.4f}", flush=True)
@@ -84,6 +85,9 @@ def main():
         f"sizes seen {sorted(set(sizes_seen))}, resizes survived {state.resized}",
         flush=True,
     )
+    # rank 0's close broadcasts "done" to every runner — hosts the
+    # schedule shrank to zero workers idle for a re-grow until they get it
+    kf.finalize()
     return 0
 
 

@@ -101,6 +101,16 @@ class NativeTransport:
         if lib is None:
             raise RuntimeError("native transport unavailable")
         self._libref = lib  # keep alive through interpreter teardown
+        if bind_host:
+            # the C++ bind path takes a dotted-quad only (inet_pton);
+            # resolve hostnames here, and fall back to the wildcard rather
+            # than failing channel creation on an unresolvable name
+            import socket as _socket
+
+            try:
+                bind_host = _socket.gethostbyname(bind_host)
+            except OSError:
+                bind_host = ""
         self._h = lib.kf_host_create(
             self_spec.encode(), (bind_host or "").encode(), port, token,
             1 if use_unix else 0,

@@ -101,8 +101,13 @@ class Peer:
                 except OSError as e:
                     _log.warning("metrics server not started: %s", e)
             if not self.config.single_process:
+                # bind our own address, not the wildcard: compose-style
+                # local clusters give every loopback-alias "host" the same
+                # worker ports (gen_peer_list), so two workers of one port
+                # coexist on one machine distinguished by alias IP
                 self._channel = HostChannel(
-                    self.config.self_id, token=self.cluster_version, monitor=monitor
+                    self.config.self_id, token=self.cluster_version,
+                    bind_host=self.config.self_id.host, monitor=monitor
                 )
                 from kungfu_tpu.store import install_p2p_handler
 
@@ -249,6 +254,7 @@ class Peer:
     def close(self) -> None:
         with self._lock:
             if self._channel is not None:
+                self._notify_done()
                 self._channel.close()
                 self._channel = None
             if self._metrics_server is not None:
@@ -512,6 +518,25 @@ class Peer:
                         )
             log_event(f"cluster-resized-v{version}-n{new_cluster.size()}")
             return True
+
+    def _notify_done(self) -> None:
+        """Tell every runner the job completed cleanly (rank 0, on close).
+        Hosts the schedule shrank to zero workers have a runner idling for
+        a possible re-grow — without this signal they could never exit
+        (``watch_run``'s job_done condition)."""
+        if self.config.parent is None or self.detached or self.standby:
+            return
+        if self.cluster.workers.rank(self.config.self_id) != 0:
+            return  # rank() is None for non-members — also not rank 0
+        for runner in self.cluster.runners:
+            try:
+                # best-effort: a runner whose host finished earlier is
+                # already gone — don't ride the 500-retry connect loop
+                self._channel.send(
+                    runner, "done", b"", ConnType.CONTROL, retries=2
+                )
+            except (TimeoutError, ConnectionError, OSError) as e:
+                _log.debug("cannot send done to runner %s: %s", runner, e)
 
     def _notify_runners(self, new_cluster: Cluster, version: int) -> None:
         """Send the new Stage to every runner so they can spawn/kill local

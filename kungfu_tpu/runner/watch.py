@@ -12,9 +12,10 @@ from __future__ import annotations
 
 import json
 import queue
+import time
 from typing import Dict, Set
 
-from kungfu_tpu.comm.host import HostChannel
+from kungfu_tpu.comm.host import ConnType, HostChannel
 from kungfu_tpu.plan.cluster import Cluster
 from kungfu_tpu.plan.hostspec import DEFAULT_RUNNER_PORT
 from kungfu_tpu.plan.peer import PeerID, parse_peer_id
@@ -27,7 +28,10 @@ _log = get_logger("watch")
 
 def watch_run(ns, cluster: Cluster, job: Job) -> int:
     self_host = ns.self_host
-    chan = HostChannel(PeerID(self_host, DEFAULT_RUNNER_PORT))
+    # bind THIS runner's address, not the wildcard: compose-style local
+    # clusters run one runner per loopback alias (127.0.0.<i>) on the
+    # same machine, all on the runner port
+    chan = HostChannel(PeerID(self_host, DEFAULT_RUNNER_PORT), bind_host=self_host)
     stages: "queue.Queue[dict]" = queue.Queue()
 
     def on_control(name: str, payload: bytes, src: str):
@@ -38,6 +42,10 @@ def watch_run(ns, cluster: Cluster, job: Job) -> int:
                 _log.warning("bad update from %s: %s", src, e)
         elif name == "exit":
             stages.put({"exit": True})
+        elif name == "done":
+            # rank 0 finished cleanly: the job is over for every host,
+            # including hosts holding no workers right now
+            stages.put({"done": True})
 
     chan.on_control(on_control)
 
@@ -62,8 +70,10 @@ def watch_run(ns, cluster: Cluster, job: Job) -> int:
         spawn(w, cluster, version)
 
     stop = False
+    job_done = False
+    natural_end_at = None
     try:
-        while running or not stages.empty():
+        while True:
             # poll exits
             for w, r in list(running.items()):
                 code = r.popen.poll()
@@ -82,6 +92,25 @@ def watch_run(ns, cluster: Cluster, job: Job) -> int:
                 for w, r in list(running.items()):
                     kill_group(r)
                     killed.add(w)
+            if not running and stages.empty():
+                # exit when: local workers failed (all killed above); the
+                # job signalled completion; or the CURRENT cluster still
+                # assigns this host workers and they all finished (the
+                # pre-elastic natural end).  A host the schedule shrank to
+                # zero must keep serving — a later stage may grow back.
+                if failures or stop or job_done:
+                    break
+                if current.workers.on_host(self_host):
+                    # natural end — but a shrink's detached workers can
+                    # exit BEFORE rank 0's "update" for that stage reaches
+                    # us; give an in-flight stage a grace window before
+                    # concluding the job is over
+                    if natural_end_at is None:
+                        natural_end_at = time.monotonic() + 3.0
+                    elif time.monotonic() >= natural_end_at:
+                        break
+            else:
+                natural_end_at = None
             # poll membership updates
             try:
                 stage = stages.get(timeout=0.2)
@@ -92,6 +121,9 @@ def watch_run(ns, cluster: Cluster, job: Job) -> int:
                 for w, r in list(running.items()):
                     kill_group(r)
                     killed.add(w)
+                continue
+            if stage.get("done"):
+                job_done = True
                 continue
             new_version = int(stage["version"])
             new_cluster = Cluster.from_json(json.dumps(stage["cluster"]))
@@ -137,6 +169,18 @@ def watch_run(ns, cluster: Cluster, job: Job) -> int:
     finally:
         for w, r in list(running.items()):
             kill_group(r)
+        if failures:
+            # a runner idling with zero workers (shrunk-away host) has no
+            # other way to learn the job died — rank 0 will never send
+            # "done"; best-effort fan-out so peers don't hang
+            me = PeerID(self_host, DEFAULT_RUNNER_PORT)
+            for runner in current.runners:
+                if runner == me:
+                    continue
+                try:
+                    chan.send(runner, "exit", b"", ConnType.CONTROL, retries=1)
+                except (ConnectionError, OSError):
+                    pass
         chan.close()
     if failures:
         _log.error("%d worker(s) failed", failures)

@@ -1,0 +1,162 @@
+#!/usr/bin/env python3
+"""Compose-style multi-runner elastic cluster harness.
+
+Local analog of the reference's docker-compose cluster test
+(``benchmarks/adaptation/gen-compose.py`` generates one ``kungfu-run``
+container per host, all watching an external config server;
+``.github/workflows/cluster.yaml`` drives it in CI).  Here each simulated
+host is a loopback alias (``127.0.0.<i>``) running its own watch-mode
+runner process, the config server is an EXTERNAL process (not the
+builtin), and the workers train MNIST under an elastic schedule that
+grows/shrinks the cluster across hosts through the REST contract.
+
+    python scripts/cluster.py                        # 2 hosts x 2 slots, 2:3,4:3,2:3
+    python scripts/cluster.py --hosts 3 --schedule 2:2,6:2,3:2
+
+Exit 0 = every runner exited clean and every scheduled size was observed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hosts", type=int, default=2,
+                    help="simulated hosts (loopback aliases 127.0.0.<i>)")
+    ap.add_argument("--slots-per-host", type=int, default=2)
+    ap.add_argument("--np", type=int, default=2, help="initial worker count")
+    ap.add_argument("--schedule", default="2:3,4:3,2:3",
+                    help="size:steps stages (examples/elastic_mnist.py)")
+    ap.add_argument("--config-port", type=int, default=9190)
+    ap.add_argument("--logdir", default="")
+    ap.add_argument("--timeout", type=float, default=420.0)
+    ns = ap.parse_args()
+
+    host_spec = ",".join(
+        f"127.0.0.{i + 1}:{ns.slots_per_host}" for i in range(ns.hosts)
+    )
+    logdir = ns.logdir or tempfile.mkdtemp(prefix="kf-cluster-")
+    os.makedirs(logdir, exist_ok=True)
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # workers pick the cpu backend via kfrun
+
+    procs = []
+
+    def cleanup():
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + 10
+        for p in procs:
+            try:
+                p.wait(max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    # 1. the EXTERNAL config server (its own process, like the compose
+    #    file's config-server service)
+    srv_log = open(os.path.join(logdir, "config-server.log"), "w")
+    srv = subprocess.Popen(
+        [sys.executable, "-m", "kungfu_tpu.elastic.configserver",
+         "-port", str(ns.config_port)],
+        cwd=REPO, stdout=srv_log, stderr=subprocess.STDOUT, env=env,
+    )
+    procs.append(srv)
+    url = f"http://127.0.0.1:{ns.config_port}"
+    for _ in range(50):  # wait for it to come up
+        try:
+            urllib.request.urlopen(url + "/get", timeout=1)
+            break
+        except urllib.error.HTTPError:
+            break  # 404 "no cluster" still means the server is up
+        except OSError:
+            time.sleep(0.2)
+    else:
+        print("config server did not come up", file=sys.stderr)
+        cleanup()
+        return 2
+
+    # 2. seed the initial cluster (compose does this with a reset job)
+    from kungfu_tpu.plan import Cluster, HostList
+
+    hl = HostList.parse(host_spec)
+    init = Cluster(hl.gen_runner_list(), hl.gen_peer_list(ns.np))
+    req = urllib.request.Request(
+        url + "/reset", data=init.to_json().encode(), method="POST")
+    urllib.request.urlopen(req, timeout=5)
+
+    # 3. one watch-mode runner per host, all pointed at the external server
+    runners = []
+    for i in range(ns.hosts):
+        self_host = f"127.0.0.{i + 1}"
+        log = open(os.path.join(logdir, f"runner-{self_host}.log"), "w")
+        p = subprocess.Popen(
+            [sys.executable, "-m", "kungfu_tpu.runner.cli", "-w",
+             "-np", str(ns.np), "-H", host_spec, "-self", self_host,
+             "-config-server", url + "/get",
+             "-logdir", os.path.join(logdir, f"workers-{self_host}"),
+             sys.executable, "examples/elastic_mnist.py",
+             "--schedule", ns.schedule],
+            cwd=REPO, stdout=log, stderr=subprocess.STDOUT, env=env,
+        )
+        runners.append((self_host, p))
+        procs.append(p)
+
+    # 4. wait for the runners; the elastic schedule drives itself (rank 0
+    #    proposes each stage through the config server)
+    deadline = time.time() + ns.timeout
+    rc = 0
+    for self_host, p in runners:
+        try:
+            code = p.wait(max(1.0, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            print(f"runner {self_host} timed out", file=sys.stderr)
+            rc = 3
+            break
+        if code != 0:
+            print(f"runner {self_host} exited {code}", file=sys.stderr)
+            rc = 1
+    try:
+        urllib.request.urlopen(url + "/stop", timeout=5)
+    except OSError:
+        pass
+    cleanup()
+
+    # 5. assert every scheduled size was actually reached (worker logs)
+    sizes_wanted = sorted({int(s.split(":")[0]) for s in ns.schedule.split(",")})
+    seen = set()
+    for root, _, files in os.walk(logdir):
+        for f in files:
+            if f.endswith(".log"):
+                with open(os.path.join(root, f), errors="replace") as fh:
+                    txt = fh.read()
+                for m in __import__("re").findall(r"sizes seen \[([\d, ]+)\]", txt):
+                    seen.update(int(x) for x in m.split(","))
+    if rc == 0 and not set(sizes_wanted) <= seen:
+        print(f"scheduled sizes {sizes_wanted} not all observed: {sorted(seen)}",
+              file=sys.stderr)
+        rc = 4
+    print(json.dumps({
+        "ok": rc == 0, "hosts": ns.hosts, "schedule": ns.schedule,
+        "sizes_observed": sorted(seen), "logdir": logdir,
+    }))
+    if rc == 0 and not ns.logdir:
+        shutil.rmtree(logdir, ignore_errors=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

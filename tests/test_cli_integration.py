@@ -91,6 +91,7 @@ class TestCLI:
         assert any(int(rs) == 1 for _, _, rs, _ in done)  # survivor resized once
 
 
+@pytest.mark.slow
 class TestLongContextExample:
     def test_ring_sp4_trains(self):
         """SP demo: exactness check vs dense + loss decreases, flash
@@ -104,6 +105,7 @@ class TestLongContextExample:
         assert "OK" in r.stdout
 
 
+@pytest.mark.slow
 class TestStrategyTourExample:
     def test_tour_runs_all_stages(self):
         """autotune → scheduled training → adaptive re-tune → zero1,

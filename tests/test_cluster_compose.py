@@ -1,0 +1,38 @@
+"""Compose-style cluster harness (scripts/cluster.py).
+
+The local analog of the reference's docker-compose elastic cluster CI
+(``.github/workflows/cluster.yaml`` + ``benchmarks/adaptation/
+gen-compose.py``): an EXTERNAL config server, one watch-mode runner per
+loopback-alias host, and an elastic schedule that must grow the job onto
+a host that started with zero workers and shrink away from it again.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+class TestComposeCluster:
+    def test_two_host_grow_shrink(self, tmp_path):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        r = subprocess.run(
+            [sys.executable, "scripts/cluster.py",
+             "--schedule", "2:3,4:3,2:3",
+             "--config-port", "9391",
+             "--logdir", str(tmp_path / "logs")],
+            # strictly above cluster.py's internal --timeout (420) so its
+            # own rc=3 path + cleanup runs before pytest kills it
+            cwd=REPO, capture_output=True, text=True, timeout=480, env=env,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+        assert out["ok"] is True
+        # the grow crossed onto the empty host and every size was reached
+        assert out["sizes_observed"] == [2, 4]

@@ -50,6 +50,7 @@ class TestMLP:
 
 
 class TestResNet:
+    @pytest.mark.slow  # compile-heavy e2e; full tier + CI slow job
     def test_tiny_forward_backward(self):
         m = ResNet(50, num_classes=10, width=8)
         params, state = m.init(jax.random.PRNGKey(0))
@@ -95,6 +96,7 @@ class TestResNet:
             np.asarray(nn.conv_apply(p, x_odd, stride=2)),
             rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.slow  # compile-heavy e2e; full tier + CI slow job
     def test_real_resnet50_param_count(self):
         m = ResNet(50, num_classes=1000)
         params, _ = m.init(jax.random.PRNGKey(0))
@@ -116,6 +118,7 @@ class TestResNet:
 
 
 class TestVGG:
+    @pytest.mark.slow  # compile-heavy e2e; full tier + CI slow job
     def test_tiny_forward_backward(self):
         m = VGG(11, num_classes=10, hidden=64)
         params, state = m.init(jax.random.PRNGKey(0))

@@ -4,6 +4,9 @@
 import numpy as np
 import pytest
 
+# every test here spawns real worker processes
+pytestmark = pytest.mark.slow
+
 
 def _worker(rank, size):
     import kungfu_tpu as kf

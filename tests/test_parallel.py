@@ -165,6 +165,7 @@ def test_sharded_loss_matches_reference(plan):
     np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
 
 
+@pytest.mark.slow  # compile-heavy e2e; full tier + CI slow job
 def test_sharded_trainer_schedule_matches_psum():
     """ShardedTrainer(schedule='ring'): the scheduled gradient sync must
     produce the same post-step params as the default psum path on a
@@ -213,6 +214,7 @@ def test_sharded_loss_fused_xent_matches(monkeypatch):
     np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
 
 
+@pytest.mark.slow  # compile-heavy e2e; full tier + CI slow job
 @pytest.mark.parametrize("plan", [MeshPlan(dp=2, pp=1, sp=2, tp=2),
                                   MeshPlan(dp=2, pp=2, sp=1, tp=2)], ids=str)
 def test_sharded_step_matches_reference(plan):
@@ -292,6 +294,7 @@ def test_moe_ep_matches_local():
     assert np.isfinite(float(aux_ep))
 
 
+@pytest.mark.slow  # compile-heavy e2e; full tier + CI slow job
 def test_moe_trainer_trains():
     """Full 4-D trainer with MoE FFNs: loss decreases on a repeated batch."""
     cfg = TransformerConfig(**CFG)
@@ -323,6 +326,7 @@ def test_pipeline_microbatch_counts():
         assert float(trainer.loss(state, batch)) == pytest.approx(ref, rel=1e-5)
 
 
+@pytest.mark.slow  # compile-heavy e2e; full tier + CI slow job
 def test_graft_entry_dryrun():
     import sys
 

@@ -69,6 +69,7 @@ class TestDistribute:
         assert "hello-log" in open(logdir / "127.0.0.1.stdout.log").read()
 
 
+@pytest.mark.slow
 class TestRrun:
     def test_launches_runner_per_host(self, fake_ssh, tmp_path):
         """Full path: rrun → fake ssh → kfrun → worker procs.

@@ -114,6 +114,7 @@ class TestSchedules:
 
 
 class TestScheduleFuzz:
+    @pytest.mark.slow  # compile-heavy e2e; full tier + CI slow job
     def test_randomized_sweep_matches_psum(self):
         """Seeded randomized sweep (the engine-fuzz analog for the device
         plane): random shapes/dtypes/ops/schedules/mesh splits must all
