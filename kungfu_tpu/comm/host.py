@@ -131,20 +131,27 @@ def _read_exact(sock: socket.socket, n: int) -> bytes:
     return buf
 
 
-def _encode(token: int, conn_type: int, src: str, name: str, payload: bytes) -> bytes:
+def _payload_nbytes(payload) -> int:
+    return len(payload) if isinstance(payload, bytes) else memoryview(payload).nbytes
+
+
+def _encode_head(token: int, conn_type: int, src: str, name: str, nbytes: int) -> bytes:
     sb, nb = src.encode(), name.encode()
-    if len(payload) > MAX_FRAME:
+    if nbytes > MAX_FRAME:
         raise ValueError(
-            f"payload of {len(payload)} bytes exceeds the 3 GiB frame limit"
+            f"payload of {nbytes} bytes exceeds the 3 GiB frame limit"
         )
     return (
         struct.pack("<IIBH", MAGIC, token, conn_type, len(sb))
         + sb
         + struct.pack("<H", len(nb))
         + nb
-        + struct.pack("<I", len(payload))
-        + payload
+        + struct.pack("<I", nbytes)
     )
+
+
+def _encode(token: int, conn_type: int, src: str, name: str, payload: bytes) -> bytes:
+    return _encode_head(token, conn_type, src, name, len(payload)) + payload
 
 
 def _decode(sock: socket.socket) -> _Msg:
@@ -414,21 +421,26 @@ class PyHostChannel(_ChannelOps):
         self,
         peer: PeerID,
         name: str,
-        payload: bytes,
+        payload,
         conn_type: ConnType = ConnType.COLLECTIVE,
         retries: int = CONNECT_RETRIES,
     ) -> None:
-        data = _encode(self._token, conn_type, str(self.self_id), name, payload)
+        # header and payload sent separately so a large payload (any
+        # contiguous buffer, not just bytes) is never concat-copied;
+        # sendall accepts buffer-protocol objects directly
+        nbytes = _payload_nbytes(payload)
+        head = _encode_head(self._token, conn_type, str(self.self_id), name, nbytes)
         if self.monitor is not None:
             # payload bytes on both sides (ingress counts the same), so
             # egress/ingress totals of a symmetric exchange match
-            self.monitor.egress(str(peer), len(payload))
+            self.monitor.egress(str(peer), nbytes)
         entry = self._pooled(peer)
         with entry[1]:
             if entry[0] is None:
                 entry[0] = self._connect(peer, retries)
             try:
-                entry[0].sendall(data)
+                entry[0].sendall(head)
+                entry[0].sendall(payload)
             except OSError:
                 # stale pooled socket (peer restarted): reconnect once
                 try:
@@ -437,7 +449,8 @@ class PyHostChannel(_ChannelOps):
                     pass
                 entry[0] = None
                 entry[0] = self._connect(peer, retries)
-                entry[0].sendall(data)
+                entry[0].sendall(head)
+                entry[0].sendall(payload)
 
     def reset_connections(self) -> None:
         """Drop pooled connections (on membership change; reference
@@ -488,6 +501,24 @@ class PyHostChannel(_ChannelOps):
             return False
         mv[:] = payload
         return True
+
+    def post_recv(
+        self, src: PeerID, name: str, buf,
+        conn_type: ConnType = ConnType.COLLECTIVE,
+    ):
+        """API parity with the native backend's pre-registered receive;
+        the pure-Python path has no registration, so this is recv_into
+        deferred to ``wait()``."""
+        chan = self
+
+        class _Posted:
+            def wait(self, timeout: Optional[float] = 60.0) -> bool:
+                return chan.recv_into(src, name, buf, conn_type, timeout)
+
+            def abort(self) -> None:
+                pass
+
+        return _Posted()
 
     def ping(self, peer: PeerID, timeout: float = 10.0) -> bool:
         try:
@@ -621,6 +652,37 @@ class NativeHostChannel(_ChannelOps):
         False = size mismatch, payload left queued — use :meth:`recv`."""
         return self._t.recv_into(str(src), name, int(conn_type), timeout, buf)
 
+    def post_recv(
+        self, src: PeerID, name: str, buf,
+        conn_type: ConnType = ConnType.COLLECTIVE,
+    ):
+        """Pre-register ``buf`` for a zero-copy receive BEFORE the
+        matching request is dispatched — the response then streams
+        socket→buf even when the responder wins the race that makes
+        plain :meth:`recv_into` detour through the queue.  ``wait()``
+        returns True when filled, False on a queued size mismatch (fall
+        back to :meth:`recv`); call ``abort()`` if the request was never
+        sent."""
+        t, s, ct = self._t, str(src), int(conn_type)
+        handle = t.recv_begin(s, name, ct, buf)
+
+        class _Posted:
+            # the native handle is consumed by finish/abort — single shot
+            _h = handle
+
+            def wait(self, timeout: Optional[float] = 60.0) -> bool:
+                if self._h is None:  # mismatching payload already queued
+                    return False
+                h, self._h = self._h, None
+                return t.recv_finish(s, name, ct, timeout, h)
+
+            def abort(self) -> None:
+                if self._h is not None:
+                    h, self._h = self._h, None
+                    t.recv_abort(s, name, ct, h)
+
+        return _Posted()
+
     def ping(self, peer: PeerID, timeout: float = 10.0) -> bool:
         return self._t.ping(str(peer), timeout)
 
@@ -645,6 +707,23 @@ def HostChannel(self_id: PeerID, token: int = 0, bind_host: str = "", monitor=No
         except RuntimeError:  # toolchain raced away; stay functional
             _log.warning("native transport unavailable, using python backend")
     return PyHostChannel(self_id, token=token, bind_host=bind_host, monitor=monitor)
+
+
+def bind_own_host_channel(self_id: PeerID, token: int = 0, monitor=None):
+    """Bind preferring the peer's own advertised address — compose-style
+    local clusters give every loopback-alias "host" the same ports, so
+    two same-port endpoints coexist on one machine distinguished by alias
+    IP — falling back to the wildcard when that address is not locally
+    bindable (a NAT'd or load-balanced advertised address)."""
+    try:
+        return HostChannel(self_id, token=token, bind_host=self_id.host,
+                           monitor=monitor)
+    except OSError as e:
+        _log.warning(
+            "cannot bind %s (%s); binding the wildcard instead",
+            self_id.host, e,
+        )
+        return HostChannel(self_id, token=token, monitor=monitor)
 
 
 def _pack_list(items: List[bytes]) -> bytes:

@@ -929,11 +929,14 @@ class Channel {
     // m.payload for normal dispatch.  Runs on the stream thread.
     bool read_payload(int fd, Msg &m, uint32_t plen, bool &consumed) {
         consumed = false;
-        if (m.conn_type == kConnCollective) {
+        // p2p registrations (the gossip pull path) key on token 0 — p2p
+        // traffic is not epoch-fenced (matches recv/recv_into/QueueKey)
+        if (m.conn_type == kConnCollective || m.conn_type == kConnPeerToPeer) {
             std::unique_lock<std::mutex> lk(q_mu_);
-            if (m.token >= token_.load()) {
-                auto it = regbufs_.find(
-                    QueueKey{m.conn_type, m.src, m.name, m.token});
+            if (m.conn_type != kConnCollective || m.token >= token_.load()) {
+                auto it = regbufs_.find(QueueKey{
+                    m.conn_type, m.src, m.name,
+                    m.conn_type == kConnCollective ? m.token : 0});
                 if (it != regbufs_.end() && it->second->state == 0 &&
                     it->second->cap == plen) {
                     RegBuf *rb = it->second;
@@ -1227,6 +1230,53 @@ int kf_host_recv_into(void *h, const char *src, const char *name,
                       uint32_t cap, uint32_t *got) {
     return static_cast<Channel *>(h)->recv_into(src, name, conn_type,
                                                 timeout_s, buf, cap, got);
+}
+
+// Staged zero-copy receive for request/response pulls: register the
+// destination buffer BEFORE dispatching the request, so the response
+// streams socket->buf even when it races the receiver (recv_into
+// registers after the caller's send — a fast responder then detours
+// through the queue, costing an alloc + two copies on a ~100 MiB blob).
+// Returns an opaque handle for kf_host_recv_finish / kf_host_recv_abort,
+// or null with *rc_out set: 2 closed, -2 queued-size-mismatch (payload
+// left queued; fall back to kf_host_recv), -3 duplicate registration.
+// rc_out 0 with a non-null handle may ALREADY be filled (a queued
+// payload of the right size was consumed at register time) — finish
+// resolves either way.  The buffer MUST stay alive and unwritten until
+// finish/abort returns.
+void *kf_host_recv_begin(void *h, const char *src, const char *name,
+                         int conn_type, uint8_t *buf, uint32_t cap,
+                         int *rc_out) {
+    auto *rb = new RegBuf{buf, cap};
+    int rc = static_cast<Channel *>(h)->recv_register(src, name, conn_type, rb);
+    *rc_out = rc;
+    if (rc != 0) {
+        delete rb;
+        return nullptr;
+    }
+    return rb;
+}
+
+// 0 ok (*got set), 1 timeout, 2 closed, -2 queued-size-mismatch.  The
+// handle is consumed on every return (recv_await guarantees no live
+// pointer remains in the channel).
+int kf_host_recv_finish(void *h, const char *src, const char *name,
+                        int conn_type, double timeout_s, void *rbp,
+                        uint32_t *got) {
+    auto *rb = static_cast<RegBuf *>(rbp);
+    int rc = static_cast<Channel *>(h)->recv_await(src, name, conn_type,
+                                                   timeout_s, rb, got);
+    delete rb;
+    return rc;
+}
+
+// Abandon a registration (e.g. the request send failed); consumes the
+// handle after any in-flight claim on the buffer resolves.
+void kf_host_recv_abort(void *h, const char *src, const char *name,
+                        int conn_type, void *rbp) {
+    auto *rb = static_cast<RegBuf *>(rbp);
+    static_cast<Channel *>(h)->recv_cancel(src, name, conn_type, rb);
+    delete rb;
 }
 
 int kf_host_ping(void *h, const char *peer, double timeout_s) {

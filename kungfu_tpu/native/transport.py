@@ -46,7 +46,7 @@ def _lib():
         lib.kf_host_send.restype = ctypes.c_int
         lib.kf_host_send.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
-            ctypes.c_char_p, ctypes.c_uint32, ctypes.c_int, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int, ctypes.c_int,
         ]
         lib.kf_host_recv.restype = ctypes.c_int
         lib.kf_host_recv.argtypes = [
@@ -61,6 +61,20 @@ def _lib():
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
             ctypes.c_double, ctypes.c_void_p, ctypes.c_uint32,
             ctypes.POINTER(ctypes.c_uint32),
+        ]
+        lib.kf_host_recv_begin.restype = ctypes.c_void_p
+        lib.kf_host_recv_begin.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.kf_host_recv_finish.restype = ctypes.c_int
+        lib.kf_host_recv_finish.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_double, ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint32),
+        ]
+        lib.kf_host_recv_abort.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_void_p,
         ]
         lib.kf_host_ping.restype = ctypes.c_int
         lib.kf_host_ping.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_double]
@@ -132,15 +146,33 @@ class NativeTransport:
     def token(self) -> int:
         return int(self._libref.kf_host_token(self._h))
 
-    def send(self, peer_spec: str, name: str, payload: bytes, conn_type: int,
+    def send(self, peer_spec: str, name: str, payload, conn_type: int,
              retries: int) -> None:
+        """``payload``: any contiguous buffer (bytes, numpy array,
+        memoryview) — passed by POINTER to the C++ writev (which sends
+        from the caller's memory synchronously), so a ~100 MiB gossip
+        blob crosses Python→wire with zero copies."""
+        if isinstance(payload, bytes):
+            # bytes → borrowed char* (no copy); the object outlives the
+            # synchronous call
+            ptr = ctypes.cast(ctypes.c_char_p(payload), ctypes.c_void_p)
+            nbytes = len(payload)
+        else:
+            mv = memoryview(payload)
+            if not mv.contiguous:
+                raise ValueError("send needs a contiguous buffer")
+            import numpy as _np
+
+            arr = _np.frombuffer(mv.cast("B"), _np.uint8)  # view, ro-safe
+            ptr = ctypes.c_void_p(arr.ctypes.data)
+            nbytes = arr.nbytes
         rc = self._libref.kf_host_send(
-            self._h, peer_spec.encode(), name.encode(), payload, len(payload),
+            self._h, peer_spec.encode(), name.encode(), ptr, nbytes,
             conn_type, retries,
         )
         if rc == -3:
             raise ValueError(
-                f"payload of {len(payload)} bytes exceeds the 3 GiB frame "
+                f"payload of {nbytes} bytes exceeds the 3 GiB frame "
                 "limit — split the blob (the engine chunks at 1 MiB; this "
                 "can only come from an oversized p2p/control message)"
             )
@@ -193,6 +225,51 @@ class NativeTransport:
             raise TimeoutError(
                 f"recv_into {name!r} from {src_spec} timed out after {timeout}s")
         raise ConnectionError("channel closed")
+
+    def recv_begin(self, src_spec: str, name: str, conn_type: int, buf):
+        """Register ``buf`` for a zero-copy receive BEFORE the request is
+        dispatched (see kf_host_recv_begin).  Returns an opaque handle to
+        pass to :meth:`recv_finish`/:meth:`recv_abort`, or None when
+        nothing was registered (rc -2: a queued payload of another size —
+        fall back to :meth:`recv`; rc 2: channel closed)."""
+        mv = memoryview(buf)
+        if mv.readonly or not mv.contiguous:
+            raise ValueError("recv_begin needs a writable contiguous buffer")
+        addr = ctypes.addressof(ctypes.c_char.from_buffer(buf))
+        rc = ctypes.c_int()
+        h = self._libref.kf_host_recv_begin(
+            self._h, src_spec.encode(), name.encode(), conn_type,
+            addr, mv.nbytes, ctypes.byref(rc),
+        )
+        if h is None:
+            if rc.value == 2:
+                raise ConnectionError("channel closed")
+            return None  # -2 size mismatch / -3 duplicate: caller recvs
+        return h
+
+    def recv_finish(self, src_spec: str, name: str, conn_type: int,
+                    timeout: Optional[float], handle) -> bool:
+        """Resolve a :meth:`recv_begin` registration; True = buffer
+        filled, False = a queued payload of another size (fall back to
+        :meth:`recv`).  Consumes the handle on every outcome."""
+        got = ctypes.c_uint32()
+        rc = self._libref.kf_host_recv_finish(
+            self._h, src_spec.encode(), name.encode(), conn_type,
+            -1.0 if timeout is None else float(timeout),
+            handle, ctypes.byref(got),
+        )
+        if rc == 0:
+            return True
+        if rc == -2:
+            return False
+        if rc == 1:
+            raise TimeoutError(
+                f"recv_finish {name!r} from {src_spec} timed out after {timeout}s")
+        raise ConnectionError("channel closed")
+
+    def recv_abort(self, src_spec: str, name: str, conn_type: int, handle) -> None:
+        self._libref.kf_host_recv_abort(
+            self._h, src_spec.encode(), name.encode(), conn_type, handle)
 
     def ping(self, peer_spec: str, timeout: float) -> bool:
         return self._libref.kf_host_ping(self._h, peer_spec.encode(), timeout) == 0

@@ -61,29 +61,51 @@ class PairAveragingOptimizer:
         self._rr_next = 0
         self._spec = None
         self._step_count = 0
+        self._recv_buf = None  # reused registered-receive buffer
+        #: cumulative wall seconds / bytes spent inside blob pulls —
+        #: benchmarks/gossip.py derives the measured pull bandwidth
+        self.pull_seconds = 0.0
+        self.pull_bytes = 0
 
-        def _avg(params, other_buf):
-            mine, spec = fuse(params, dtype=self.fuse_dtype)
-            merged = 0.5 * mine + 0.5 * other_buf
-            return defuse(merged, spec)
+        # ONE compiled program per step flavor: average with the pulled
+        # model (when a pull landed), apply local gradients, and return
+        # the updated params together with their fused buffer — so the
+        # publish is a zero-copy view of jit output, not a re-fuse +
+        # tobytes (two full-model copies per step gone)
+        def _step(params, grads, state, other_buf):
+            if other_buf is not None:
+                mine, spec = fuse(params, dtype=self.fuse_dtype)
+                params = defuse(0.5 * mine + 0.5 * other_buf, spec)
+            updates, state = self.inner.update(grads, state, params)
+            params = optax.apply_updates(params, updates)
+            out_buf, _ = fuse(params, dtype=self.fuse_dtype)
+            return params, state, out_buf
 
-        self._avg_jit = jax.jit(_avg)
-        self._update_jit = jax.jit(
-            lambda g, s, p: self.inner.update(g, s, p)
+        self._step_avg_jit = jax.jit(_step)
+        self._step_local_jit = jax.jit(
+            lambda params, grads, state: _step(params, grads, state, None)
         )
 
     # -- store IO --------------------------------------------------------
-    def _serialize(self, params) -> bytes:
+    def _serialize(self, params):
         buf, self._spec = fuse(params, dtype=self.fuse_dtype)
-        return np.asarray(buf).tobytes()
+        # np.asarray of a CPU-resident jax array is a zero-copy readonly
+        # view; the store takes it without snapshotting (copy=False) —
+        # jax arrays are immutable, so the handover is safe
+        return np.asarray(buf)
 
-    def _deserialize_buf(self, blob: bytes):
+    def _deserialize_buf(self, blob):
         return jnp.asarray(
-            np.frombuffer(blob, dtype=np.dtype(self.fuse_dtype)).copy()
+            np.frombuffer(blob, dtype=np.dtype(self.fuse_dtype))
         )
 
     def _publish(self, params) -> None:
-        self.peer.save(self.name, self._serialize(params), version=str(self._step_count))
+        self.peer.save(self.name, self._serialize(params),
+                       version=str(self._step_count), copy=False)
+
+    def _publish_buf(self, fused) -> None:
+        self.peer.save(self.name, np.asarray(fused),
+                       version=str(self._step_count), copy=False)
 
     def _select_peer(self) -> Optional[int]:
         n, me = self.peer.size(), self.peer.rank()
@@ -105,17 +127,40 @@ class PairAveragingOptimizer:
         self.peer.barrier()
         return self.inner.init(params)
 
+    def _pull(self, target):
+        """Pull the target's fused model into the reused receive buffer
+        (socket→buffer on the native backend).  Returns the filled numpy
+        view or None."""
+        import time as _time
+
+        if self._recv_buf is None:
+            n = int(np.sum([int(np.prod(l.shape)) for l in
+                            jax.tree_util.tree_leaves(self._last_params)]))
+            self._recv_buf = np.empty(n, np.dtype(self.fuse_dtype))
+        t0 = _time.perf_counter()
+        got = self.peer.request_into(target, self.name, self._recv_buf)
+        dt = _time.perf_counter() - t0
+        if got is None:
+            return None
+        self.pull_seconds += dt
+        self.pull_bytes += memoryview(got).nbytes
+        return got
+
     def step(self, params, grads, state):
         """One gossip step; returns ``(new_params, new_state)``."""
+        self._last_params = params
         target = self._select_peer()
+        other = None
         if target is not None:
-            blob = self.peer.request(target, self.name)
+            blob = self._pull(target)
             if blob is not None:
-                params = self._avg_jit(params, self._deserialize_buf(blob))
+                other = self._deserialize_buf(blob)
             else:
                 _log.debug("peer %d had no %r yet", target, self.name)
-        updates, state = self._update_jit(grads, state, params)
-        params = optax.apply_updates(params, updates)
+        if other is not None:
+            params, state, fused = self._step_avg_jit(params, grads, state, other)
+        else:
+            params, state, fused = self._step_local_jit(params, grads, state)
         self._step_count += 1
-        self._publish(params)
+        self._publish_buf(fused)
         return params, state

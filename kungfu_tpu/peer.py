@@ -101,13 +101,11 @@ class Peer:
                 except OSError as e:
                     _log.warning("metrics server not started: %s", e)
             if not self.config.single_process:
-                # bind our own address, not the wildcard: compose-style
-                # local clusters give every loopback-alias "host" the same
-                # worker ports (gen_peer_list), so two workers of one port
-                # coexist on one machine distinguished by alias IP
-                self._channel = HostChannel(
+                from kungfu_tpu.comm.host import bind_own_host_channel
+
+                self._channel = bind_own_host_channel(
                     self.config.self_id, token=self.cluster_version,
-                    bind_host=self.config.self_id.host, monitor=monitor
+                    monitor=monitor
                 )
                 from kungfu_tpu.store import install_p2p_handler
 
@@ -542,16 +540,28 @@ class Peer:
         """Send the new Stage to every runner so they can spawn/kill local
         workers (reference ``peer.go:195-209`` → ``runner/handler.go``).
         Skipped when no runner spawned us (mp-spawn / direct-driven test
-        clusters have no runner daemon to notify)."""
+        clusters have no runner daemon to notify).
+
+        Rank 0 fans the stage out to EVERY runner; every OTHER worker
+        also sends it to its own parent.  The parent copy closes a
+        shutdown race: a worker the stage removes exits right after this
+        call, and if rank 0's fan-out were the only copy, the runner
+        could reap that exit first, read it as the job's natural end, and
+        quit — orphaning the host for later re-grows.  The local send
+        happens-before the local exit; duplicate versions are tolerated
+        (``watch_run`` cross-checks and drops them)."""
         if self._channel is None or self.config.parent is None:
             return
         # rank in the OLD membership; standby/detached peers don't notify
-        if self.cluster.workers.rank(self.config.self_id) != 0:
+        if self.cluster.workers.rank(self.config.self_id) is None:
             return
         stage = json.dumps(
             {"version": version, "cluster": json.loads(new_cluster.to_json())}
         ).encode()
-        for runner in new_cluster.runners:
+        targets = (new_cluster.runners
+                   if self.cluster.workers.rank(self.config.self_id) == 0
+                   else [self.config.parent])
+        for runner in targets:
             try:
                 self._channel.wait(runner, timeout=10)
                 self._channel.send(runner, "update", stage, ConnType.CONTROL)
@@ -659,10 +669,13 @@ class Peer:
             set_tree(engine, forest)
 
     # -- p2p blob store (gossip) -----------------------------------------
-    def save(self, name: str, blob: bytes, version: Optional[str] = None) -> None:
+    def save(self, name: str, blob, version: Optional[str] = None,
+             copy: bool = True) -> None:
         """Save into this peer's gossip store.  Names under ``kf.`` are
-        reserved for the control plane (served from a separate store)."""
-        self.store.save(name, blob, version)
+        reserved for the control plane (served from a separate store).
+        ``copy=False`` hands over the caller's buffer (never mutate it
+        after) — the gossip hot path publishes ~100 MiB fused models."""
+        self.store.save(name, blob, version, copy=copy)
 
     def request(self, target_rank: int, name: str,
                 version: Optional[str] = None,
@@ -675,3 +688,14 @@ class Peer:
 
         target = self.cluster.workers[target_rank]
         return remote_request(self, target, name, version, timeout=timeout)
+
+    def request_into(self, target_rank: int, name: str, buf,
+                     version: Optional[str] = None,
+                     timeout: float = 60.0):
+        """Pull a named blob INTO a preallocated buffer — zero-copy on
+        the native backend (see :func:`remote_request_into`)."""
+        from kungfu_tpu.store import remote_request_into
+
+        target = self.cluster.workers[target_rank]
+        return remote_request_into(self, target, name, buf, version,
+                                   timeout=timeout)

@@ -15,7 +15,7 @@ import queue
 import time
 from typing import Dict, Set
 
-from kungfu_tpu.comm.host import ConnType, HostChannel
+from kungfu_tpu.comm.host import ConnType, bind_own_host_channel
 from kungfu_tpu.plan.cluster import Cluster
 from kungfu_tpu.plan.hostspec import DEFAULT_RUNNER_PORT
 from kungfu_tpu.plan.peer import PeerID, parse_peer_id
@@ -28,10 +28,10 @@ _log = get_logger("watch")
 
 def watch_run(ns, cluster: Cluster, job: Job) -> int:
     self_host = ns.self_host
-    # bind THIS runner's address, not the wildcard: compose-style local
+    # bind THIS runner's address (wildcard fallback): compose-style local
     # clusters run one runner per loopback alias (127.0.0.<i>) on the
     # same machine, all on the runner port
-    chan = HostChannel(PeerID(self_host, DEFAULT_RUNNER_PORT), bind_host=self_host)
+    chan = bind_own_host_channel(PeerID(self_host, DEFAULT_RUNNER_PORT))
     stages: "queue.Queue[dict]" = queue.Queue()
 
     def on_control(name: str, payload: bytes, src: str):

@@ -11,7 +11,8 @@ GIL; the Python API stays identical.
 """
 
 from kungfu_tpu.store.store import Store, VersionedStore, get_local_store, reset_local_store
-from kungfu_tpu.store.p2p import install_p2p_handler, remote_request
+from kungfu_tpu.store.p2p import (install_p2p_handler, remote_request,
+                                  remote_request_into)
 
 __all__ = [
     "Store",
@@ -20,4 +21,5 @@ __all__ = [
     "reset_local_store",
     "install_p2p_handler",
     "remote_request",
+    "remote_request_into",
 ]

@@ -34,11 +34,14 @@ def install_p2p_handler(channel: HostChannel, store=None,
     gossip model traffic, whose per-step versions would push them out."""
 
     def handle(name: str, payload: bytes, src: str):
-        # name = "req.<id>"; payload = json {"name":..., "version":...}
+        # name = "req.<id>"; payload = json {"name":..., "version":...,
+        # "raw": 0|1}
         req_id = name[len("req."):]
+        raw = False
         try:
             req = json.loads(payload.decode())
             blob_name = req["name"]
+            raw = bool(req.get("raw"))
             st = (control_store
                   if control_store is not None and blob_name.startswith("kf.")
                   else (store or get_local_store()))
@@ -46,12 +49,21 @@ def install_p2p_handler(channel: HostChannel, store=None,
         except (ValueError, KeyError) as e:
             _log.warning("bad p2p request from %s: %s", src, e)
             blob = None
-        status, body = (_OK, blob) if blob is not None else (_FAIL, b"")
+        if raw:
+            # zero-copy reply: the blob buffer itself is the payload (the
+            # requester recv_intos it straight off the socket); a miss is
+            # the empty payload — gossip blobs are never 0 bytes
+            body = blob if blob is not None else b""
+        else:
+            # legacy framing: 1 status byte + body in one message (pays a
+            # concat copy; fine for the small control-plane blobs).  The
+            # store may hold non-bytes buffers (copy=False saves).
+            body = (_OK + bytes(blob)) if blob is not None else _FAIL
         try:
             channel.send(
                 parse_peer_id(src),
                 f"rsp.{req_id}",
-                status + body,
+                body,
                 ConnType.PEER_TO_PEER,
                 retries=5,
             )
@@ -61,19 +73,27 @@ def install_p2p_handler(channel: HostChannel, store=None,
     channel.on_p2p_request(handle)
 
 
+def _serve_locally(peer, target: PeerID, name: str, version: Optional[str]):
+    """Single-process mode / self-request: answer from the own store.
+    Returns ``(True, blob)`` when the request never needs the wire."""
+    own_store = getattr(peer, "store", None)
+    if name.startswith("kf."):
+        own_store = getattr(peer, "_ctrl_store", None) or own_store
+    if peer.channel is None or target == peer.config.self_id:
+        st = own_store if own_store is not None else get_local_store()
+        return True, st.get(name, version)
+    return False, None
+
+
 def remote_request(
     peer, target: PeerID, name: str, version: Optional[str] = None,
     timeout: float = 60.0,
 ) -> Optional[bytes]:
     """Pull blob ``name`` from ``target``'s store; None when unavailable."""
     channel = peer.channel
-    own_store = getattr(peer, "store", None)
-    if name.startswith("kf."):
-        own_store = getattr(peer, "_ctrl_store", None) or own_store
-    if channel is None or target == peer.config.self_id:
-        # single-process mode / self-request: serve from the own store
-        st = own_store if own_store is not None else get_local_store()
-        return st.get(name, version)
+    local, blob = _serve_locally(peer, target, name, version)
+    if local:
+        return blob
     req_id = f"{peer.config.self_id.port}-{next(_req_counter)}"
     body = json.dumps({"name": name, "version": version or ""}).encode()
     channel.send(target, f"req.{req_id}", body, ConnType.PEER_TO_PEER)
@@ -81,3 +101,46 @@ def remote_request(
     if rsp[:1] != _OK:
         return None
     return rsp[1:]
+
+
+def remote_request_into(
+    peer, target: PeerID, name: str, buf,
+    version: Optional[str] = None, timeout: float = 60.0,
+):
+    """Pull blob ``name`` from ``target`` INTO ``buf`` (writable
+    contiguous buffer sized to the expected blob) — the gossip hot path.
+    On the native backend the payload goes socket→``buf`` with no copy
+    (registered receive) and the responder writevs straight from its
+    store buffer, so a ~100 MiB model pull costs the wire, not four
+    memcpys (reference fused ``ModelBuffer``,
+    ``tensorflow/ops/cpu/peer_to_peer.cpp:72-424``).
+
+    Returns ``buf`` when filled; the raw bytes when the blob exists but
+    its size does not match ``buf``; ``None`` when the target does not
+    have the blob.
+    """
+    channel = peer.channel
+    local, blob = _serve_locally(peer, target, name, version)
+    if local:
+        return blob
+    req_id = f"{peer.config.self_id.port}-{next(_req_counter)}"
+    body = json.dumps(
+        {"name": name, "version": version or "", "raw": 1}
+    ).encode()
+    # register the destination BEFORE the request leaves: the responder's
+    # writev then streams socket→buf with no queue detour even when it
+    # answers faster than we can turn around
+    posted = channel.post_recv(target, f"rsp.{req_id}", buf,
+                               ConnType.PEER_TO_PEER)
+    try:
+        channel.send(target, f"req.{req_id}", body, ConnType.PEER_TO_PEER)
+    except BaseException:
+        posted.abort()
+        raise
+    if posted.wait(timeout=timeout):
+        return buf
+    # size mismatch: the payload stayed queued — either the miss marker
+    # (empty) or a blob of an unexpected size
+    rsp = channel.recv(target, f"rsp.{req_id}", ConnType.PEER_TO_PEER,
+                       timeout=timeout)
+    return rsp if rsp else None

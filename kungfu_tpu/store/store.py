@@ -9,6 +9,12 @@ from typing import Dict, List, Optional
 DEFAULT_VERSION_COUNT = 3  # reference handler/p2p.go:11
 
 
+def _nbytes(blob) -> int:
+    """Byte length of any buffer-protocol value (len() of a numpy array
+    counts elements, not bytes)."""
+    return memoryview(blob).nbytes
+
+
 class Store:
     """Named blob KV store with size-checked get-or-create
     (reference ``store.go:14-59``)."""
@@ -17,14 +23,20 @@ class Store:
         self._blobs: Dict[str, bytes] = {}
         self._lock = threading.RLock()
 
-    def save(self, name: str, blob: bytes) -> None:
+    def save(self, name: str, blob, copy: bool = True) -> None:
+        """``copy=False`` stores the caller's buffer object as-is (any
+        buffer-protocol value) — the gossip hot path hands over ~100 MiB
+        fused-model views it promises never to mutate; the default
+        snapshots, so a caller reusing its buffer can't corrupt the
+        store."""
         with self._lock:
             existing = self._blobs.get(name)
-            if existing is not None and len(existing) != len(blob):
+            if existing is not None and _nbytes(existing) != _nbytes(blob):
                 raise ValueError(
-                    f"blob {name!r} size changed: {len(existing)} -> {len(blob)}"
+                    f"blob {name!r} size changed: "
+                    f"{_nbytes(existing)} -> {_nbytes(blob)}"
                 )
-            self._blobs[name] = bytes(blob)
+            self._blobs[name] = blob if not copy else bytes(blob)
 
     def get(self, name: str) -> Optional[bytes]:
         with self._lock:
@@ -44,7 +56,8 @@ class VersionedStore:
         self._versions: "OrderedDict[str, Store]" = OrderedDict()
         self._lock = threading.RLock()
 
-    def save(self, name: str, blob: bytes, version: Optional[str] = None) -> None:
+    def save(self, name: str, blob, version: Optional[str] = None,
+             copy: bool = True) -> None:
         version = version or ""
         with self._lock:
             st = self._versions.get(version)
@@ -53,7 +66,7 @@ class VersionedStore:
                 self._versions[version] = st
                 while len(self._versions) > self._window:
                     self._versions.popitem(last=False)
-            st.save(name, blob)
+            st.save(name, blob, copy=copy)
 
     def get(self, name: str, version: Optional[str] = None) -> Optional[bytes]:
         with self._lock:

@@ -150,13 +150,21 @@ class TestMultiEngine:
     def test_broadcast_parameters(self):
         engines, chans = make_engines(2)
         try:
-            def worker(rank, e):
+            # models built BEFORE the worker threads start: torch's seed
+            # is process-global, so seeding inside the racing workers made
+            # rank 0's "seed-0" weights nondeterministic (flaky mismatch
+            # against the ref model, with the broadcast itself correct)
+            models = []
+            for rank in range(2):
                 torch.manual_seed(rank)
-                m = torch.nn.Linear(3, 3)
+                models.append(torch.nn.Linear(3, 3))
+
+            def worker(m, e):
                 collective.broadcast_parameters(m.state_dict(), engine=e)
                 return {k: v.clone() for k, v in m.state_dict().items()}
 
-            outs = run_all([lambda r=r, e=e: worker(r, e) for r, e in enumerate(engines)])
+            outs = run_all([lambda m=m, e=e: worker(m, e)
+                            for m, e in zip(models, engines)])
             torch.manual_seed(0)
             ref = torch.nn.Linear(3, 3).state_dict()
             for sd in outs:
