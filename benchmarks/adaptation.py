@@ -44,14 +44,15 @@ def main(argv=None) -> dict:
     import numpy as np
 
     from kungfu_tpu.comm.device import Communicator
+    from kungfu_tpu.initializer import resync_parameters
 
     sizes = [int(s) for s in args.schedule.split(",")]
     n_devs = len(jax.devices())
     sizes = [s for s in sizes if s <= n_devs]
     n_params = int(args.param_mib * (1 << 20) / 4)
-    params = jnp.asarray(
+    params = {"w": jnp.asarray(
         np.random.default_rng(0).standard_normal(n_params), jnp.float32
-    )
+    )}
 
     transitions = []
     prev = None
@@ -60,11 +61,19 @@ def main(argv=None) -> dict:
         comm = Communicator(devices=jax.devices()[:size], local_size=size)
         t_mesh = time.perf_counter() - t0
 
-        # re-jit: first collective on the new epoch compiles the program
-        stacked = jnp.broadcast_to(params[None], (size, n_params))
+        # state re-sync onto the new epoch: runtime replication (no XLA
+        # compile) — params land replicated on the new mesh
         t0 = time.perf_counter()
-        jax.block_until_ready(comm.broadcast(stacked, root=0))
-        t_compile_bcast = time.perf_counter() - t0
+        params = resync_parameters(params, comm=comm)
+        jax.block_until_ready(params)
+        t_resync = time.perf_counter() - t0
+
+        # first collective on the new epoch still pays its compile (the
+        # training step's re-jit, reported separately)
+        stacked = jnp.broadcast_to(params["w"][None], (size, n_params))
+        t0 = time.perf_counter()
+        jax.block_until_ready(comm.all_reduce(stacked))
+        t_first = time.perf_counter() - t0
 
         # steady-state step on the new epoch (post-compile)
         t0 = time.perf_counter()
@@ -76,12 +85,19 @@ def main(argv=None) -> dict:
                 "from": prev,
                 "to": size,
                 "mesh_s": round(t_mesh, 4),
-                "rebroadcast_s": round(t_compile_bcast, 4),
+                "resync_s": round(t_resync, 4),
+                "first_collective_s": round(t_first, 4),
                 "post_step_s": round(t_step, 4),
             }
         )
         prev = size
-    total = sum(t["mesh_s"] + t["rebroadcast_s"] for t in transitions[1:])
+    # NOTE round-4 metric change: rounds 1-3 recorded "rebroadcast_s" =
+    # compile + first broadcast; the re-sync is now runtime replication
+    # (no compile), reported as "resync_s", with the step re-jit cost in
+    # "first_collective_s".  The aggregate includes the compile so the
+    # headline stays comparable across rounds.
+    total = sum(t["mesh_s"] + t["resync_s"] + t["first_collective_s"]
+                for t in transitions[1:])
     result = {
         "metric": "resize_transition_latency",
         "value": round(total / max(1, len(transitions) - 1), 4),

@@ -94,15 +94,14 @@ def main() -> int:
         import jax
         import jax.numpy as jnp
 
-        from kungfu_tpu.initializer import broadcast_parameters
+        from kungfu_tpu.initializer import resync_parameters
         from kungfu_tpu.parallel.train import dp_train_step
 
         nonlocal params
-        params = broadcast_parameters(params, peer)
-        sh = comm.replicated_sharding()
-        params = jax.tree_util.tree_map(
-            lambda a: jax.device_put(np.asarray(a), sh), params
-        )
+        # device-plane re-sync: survivors + joiners share the new mesh, so
+        # rank 0's weights ride the compiled broadcast (ICI), not the host
+        # TCP channel, and land replicated on the new epoch
+        params = resync_parameters(params, peer, comm=comm)
         tx = synchronous_sgd(opt, comm.axis)
         step = dp_train_step(
             lambda p, b: model.loss(p, b), tx, comm

@@ -404,10 +404,19 @@ class Communicator:
 
         spec = self._spec_in()
 
+        mesh_devs = set(self.mesh.devices.flat)
+
         def wrapped(a):
-            # jax arrays pass through (layout-only resharding); only host
-            # data pays a numpy materialization
-            local = a if isinstance(a, jax.Array) else np.asarray(a)
+            # jax arrays already laid out over THIS mesh pass through
+            # (layout-only resharding); anything else — host data, or an
+            # array committed to other devices (e.g. the process-default
+            # device), which host_local_array_to_global_array would
+            # mis-lift — pays a numpy materialization of the local slice
+            local = (
+                a if isinstance(a, jax.Array)
+                and a.sharding.device_set == mesh_devs
+                else np.asarray(a)
+            )
             g = mh.host_local_array_to_global_array(local, self.mesh, spec)
             out = fn(g)
             return mh.global_array_to_host_local_array(out, self.mesh, spec)

@@ -50,3 +50,74 @@ def broadcast_parameters(params, peer=None, root: int = 0, name: str = "bcast-pa
 def device_broadcast(params, axis, root: int = 0):
     """In-jit broadcast of a param pytree from peer ``root`` over ``axis``."""
     return ops.broadcast(params, axis, root=root)
+
+
+def resync_parameters(params, peer=None, comm=None, root: int = 0):
+    """Post-resize state re-sync, riding the DEVICE plane when a mesh
+    exists (reference ``hooks/elastic.py:54`` re-broadcast, made
+    TPU-native): an in-world resize leaves survivors and joiners sharing
+    the NEW mesh epoch, so rank ``root``'s weights move over ICI instead
+    of the host TCP channel.  Returns ``params`` replicated on the mesh,
+    ready for the next compiled step.
+
+    * single-controller mesh (simulated peers / one process): pure
+      runtime replication — each leaf is ``device_put`` to every mesh
+      device and assembled with ``make_array_from_single_device_arrays``;
+      NO XLA program compiles, so the resize transition doesn't pay a
+      per-epoch broadcast compile;
+    * multi-controller mesh: one compiled device broadcast per mesh
+      epoch (fuse → ``Communicator.broadcast`` → defuse), then a
+      replicated placement;
+    * no mesh (detached / standby / single-process): host-plane
+      :func:`broadcast_parameters` fallback.
+    """
+    if comm is None and peer is not None:
+        try:
+            comm = peer.communicator()
+        except RuntimeError:
+            comm = None
+    if comm is None or comm.size <= 1:
+        if comm is not None:
+            # 1-peer mesh: nothing to sync, just place on it
+            sh = comm.replicated_sharding()
+            return jax.tree_util.tree_map(
+                lambda a: jax.device_put(jnp.asarray(a), sh), params
+            )
+        return broadcast_parameters(params, peer, root=root)
+
+    if not comm._multiproc:
+        # every simulated peer lives in this process: "root's weights"
+        # are the ones passed in — replicate them by runtime transfer
+        sh = comm.replicated_sharding()
+        devs = list(comm.mesh.devices.ravel())
+
+        def leaf(a):
+            a = jnp.asarray(a)
+            bufs = [jax.device_put(a, d) for d in devs]
+            return jax.make_array_from_single_device_arrays(a.shape, sh, bufs)
+
+        return jax.tree_util.tree_map(leaf, params)
+
+    # multi-controller: the joiners' stale values must be overwritten by
+    # root's over the mesh — a compiled broadcast, amortized per epoch.
+    # The eager stacked convention wants the HOST-LOCAL slice as numpy
+    # (a committed jax array would be mis-lifted by the host-local wrap).
+    if root != 0:
+        # Communicator.broadcast roots on a flat DEVICE slot; mapping a
+        # peer rank to its device slot needs the per-process device
+        # counts, which the communicator does not track.  Every current
+        # caller resyncs from rank 0, where the two coincide.
+        raise NotImplementedError(
+            "multi-controller resync_parameters supports root=0 only"
+        )
+    buf, spec = fuse(params, dtype=jnp.float32)
+    n = comm.addressable_n
+    stacked = np.ascontiguousarray(
+        np.broadcast_to(np.asarray(buf)[None], (n,) + buf.shape)
+    )
+    out = np.asarray(comm.broadcast(stacked, root=root))[0]
+    sh = comm.replicated_sharding()
+    synced = defuse(jnp.asarray(out), spec)
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(np.asarray(a), sh), synced
+    )

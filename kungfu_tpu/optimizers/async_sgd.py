@@ -59,7 +59,6 @@ class PairAveragingOptimizer:
         self.fuse_dtype = fuse_dtype
         self._rng = random.Random(seed + peer.rank())
         self._rr_next = 0
-        self._spec = None
         self._step_count = 0
         self._recv_buf = None  # reused registered-receive buffer
         #: cumulative wall seconds / bytes spent inside blob pulls —
@@ -88,7 +87,7 @@ class PairAveragingOptimizer:
 
     # -- store IO --------------------------------------------------------
     def _serialize(self, params):
-        buf, self._spec = fuse(params, dtype=self.fuse_dtype)
+        buf, _ = fuse(params, dtype=self.fuse_dtype)
         # np.asarray of a CPU-resident jax array is a zero-copy readonly
         # view; the store takes it without snapshotting (copy=False) —
         # jax arrays are immutable, so the handover is safe

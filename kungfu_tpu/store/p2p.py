@@ -93,7 +93,10 @@ def remote_request(
     channel = peer.channel
     local, blob = _serve_locally(peer, target, name, version)
     if local:
-        return blob
+        # honor the bytes contract even when the store holds a
+        # copy=False buffer (small legacy/control-plane callers only —
+        # the gossip hot path uses remote_request_into)
+        return blob if blob is None or isinstance(blob, bytes) else bytes(blob)
     req_id = f"{peer.config.self_id.port}-{next(_req_counter)}"
     body = json.dumps({"name": name, "version": version or ""}).encode()
     channel.send(target, f"req.{req_id}", body, ConnType.PEER_TO_PEER)

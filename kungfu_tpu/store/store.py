@@ -20,7 +20,9 @@ class Store:
     (reference ``store.go:14-59``)."""
 
     def __init__(self):
-        self._blobs: Dict[str, bytes] = {}
+        # values are bytes unless saved with copy=False, in which case
+        # any buffer-protocol object the caller handed over
+        self._blobs: Dict[str, object] = {}
         self._lock = threading.RLock()
 
     def save(self, name: str, blob, copy: bool = True) -> None:
@@ -38,7 +40,9 @@ class Store:
                 )
             self._blobs[name] = blob if not copy else bytes(blob)
 
-    def get(self, name: str) -> Optional[bytes]:
+    def get(self, name: str):
+        """The stored value: bytes, or the caller's buffer object for
+        copy=False saves."""
         with self._lock:
             return self._blobs.get(name)
 
@@ -68,7 +72,7 @@ class VersionedStore:
                     self._versions.popitem(last=False)
             st.save(name, blob, copy=copy)
 
-    def get(self, name: str, version: Optional[str] = None) -> Optional[bytes]:
+    def get(self, name: str, version: Optional[str] = None):
         with self._lock:
             if version is not None and version != "":
                 st = self._versions.get(version)
