@@ -126,12 +126,92 @@ def build_optimizer(name: str, axis, batch: int):
     raise ValueError(f"unknown optimizer {name!r}")
 
 
+def host_engine_main(args) -> dict:
+    """Launcher-driven multi-process system bench (the reference's
+    ``kungfu-run -np 4 python benchmark_kungfu.py`` harness shape,
+    ``benchmarks/system/README.md:9-16``): N worker PROCESSES exchange a
+    fused fake-model gradient buffer per step through the NATIVE host
+    engine (the TCP/unix data plane) and apply an SGD update — the path
+    a CPU cluster or a between-mesh-epoch phase trains on.  Run under
+    the launcher; rank 0 prints the JSON row::
+
+        python -m kungfu_tpu.runner.cli -q -np 4 -H 127.0.0.1:4 \\
+            python benchmarks/system.py -- --backend host --model resnet50
+    """
+    import kungfu_tpu as kf
+    from kungfu_tpu.models.fake import fake_model_sizes
+
+    fakes = {"resnet50": "resnet50-imagenet", "vgg16": "vgg16-imagenet",
+             "bert": "bert"}
+    if args.model not in fakes:
+        raise SystemExit(
+            f"--backend host has no fake-size list for {args.model!r}; "
+            f"one of {sorted(fakes)}"
+        )
+    fake_name = fakes[args.model]
+    steps = 5 if args.quick else args.steps
+    warmup = 1 if args.quick else args.warmup
+    peer = kf.init()
+    engine = peer.engine()
+    if engine is None:
+        raise SystemExit(
+            "--backend host measures the multi-process host engine: run "
+            "under the launcher, e.g.  python -m kungfu_tpu.runner.cli "
+            "-np 2 -H 127.0.0.1:2 python benchmarks/system.py -- "
+            "--backend host"
+        )
+    n = peer.size()
+    total = sum(fake_model_sizes(fake_name))
+    rng = np.random.default_rng(peer.rank())
+    params = np.zeros(total, np.float32)
+    grads = rng.standard_normal(total).astype(np.float32)
+    lr = np.float32(1e-3)
+
+    def step_once(i):
+        # fresh salt per step: no two dispatches byte-identical, and the
+        # reduced values stay rank-agreed (same salt everywhere)
+        g = grads + np.float32(i)
+        engine.all_reduce(g, op="mean", inplace=True, name=f"sysg{i}")
+        # in-place on the closed-over buffer (a bare `params -=` would
+        # rebind the name local to this function)
+        params[:] -= lr * g
+
+    for i in range(warmup):
+        step_once(-1 - i)
+    peer.barrier()  # start the timed window together
+    t0 = time.perf_counter()
+    for i in range(steps):
+        step_once(i)
+    dt = time.perf_counter() - t0
+    result = {
+        "metric": f"{args.model}_host_engine_steps_per_sec",
+        "value": round(steps / dt, 3),
+        "unit": "steps/sec",
+        "np": n,
+        "model_mib": round(total * 4 / (1 << 20), 1),
+        "grad_exchange_gib_s": round(total * 4 * steps / dt / (1 << 30), 3),
+        "cmd": ("python -m kungfu_tpu.runner.cli -q -np {n} -H 127.0.0.1:{n} "
+                "python benchmarks/system.py -- --backend host --model {m}"
+                "{extra}").format(
+                    n=n, m=args.model,
+                    extra=(" --quick" if args.quick else
+                           f" --steps {steps} --warmup {warmup}")),
+    }
+    if peer.rank() == 0:
+        print(json.dumps(result))
+    kf.finalize()
+    return result
+
+
 def main(argv=None) -> dict:
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="resnet50",
                    choices=["resnet50", "vgg16", "transformer", "bert"])
     p.add_argument("--optimizer", default="sync-sgd",
                    choices=["sync-sgd", "sma", "gns", "variance", "zero1"])
+    p.add_argument("--backend", default="device", choices=["device", "host"],
+                   help="device = local mesh (default); host = the native "
+                        "host engine across kfrun worker processes")
     p.add_argument("--batch-size", type=int, default=0, help="per-device")
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--warmup", type=int, default=3)
@@ -139,6 +219,9 @@ def main(argv=None) -> dict:
     p.add_argument("--cpu-mesh", type=int, default=0, metavar="N",
                    help="force an N-device virtual CPU mesh (test/CI mode)")
     args = p.parse_args(argv)
+
+    if args.backend == "host":
+        return host_engine_main(args)
 
     if args.cpu_mesh:
         # before any backend init; env vars are too late when jax is preloaded
