@@ -20,6 +20,77 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from bench import measure_group  # noqa: E402
 
 
+def crossover(args):
+    """Kernel-vs-XLA sweep over (N, V) x {fwd, fwd+bwd} — the measured
+    basis of ``token_nll``'s auto routing (ops/pallas/xent.py
+    ``_route_fused``).  Prints one row per cell with both times and the
+    winner; feed disagreements back into the baked thresholds.
+
+        python benchmarks/xent_sweep.py --crossover
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kungfu_tpu.ops.pallas.xent import _route_fused, softmax_cross_entropy
+
+    shapes = [(n, v)
+              for n in (1024, 4096, 8192, 16384)
+              for v in (8192, 32768, 65536)]
+    rng = np.random.default_rng(0)
+    for n, v in shapes:
+        logits = jnp.asarray(rng.standard_normal((n, v)), jnp.bfloat16)
+        targets = jnp.asarray(rng.integers(0, v, n), jnp.int32)
+        for bwd in (False, True):
+            if bwd:
+                def k_step(lg):
+                    dl = jax.grad(lambda x: softmax_cross_entropy(
+                        x, targets).mean())(lg)
+                    return (lg - 0.1 * dl).astype(lg.dtype)
+
+                def x_step(lg):
+                    def plain(x):
+                        logp = jax.nn.log_softmax(x)
+                        return -jnp.take_along_axis(
+                            logp, targets[:, None], axis=-1).mean()
+                    dl = jax.grad(plain)(lg)
+                    return (lg - 0.1 * dl).astype(lg.dtype)
+            else:
+                def k_step(lg):
+                    return lg + softmax_cross_entropy(
+                        lg, targets).mean().astype(lg.dtype)
+
+                def x_step(lg):
+                    logp = jax.nn.log_softmax(lg)
+                    nll = -jnp.take_along_axis(
+                        logp, targets[:, None], axis=-1).mean()
+                    return lg + nll.astype(lg.dtype)
+            times = measure_group(
+                {"pallas": k_step, "xla": x_step}, logits,
+                rounds=args.rounds, on_error="skip", target_sep=0.3,
+            )
+            tp, tx = times.get("pallas"), times.get("xla")
+            routed = _route_fused(n, v, 2, training=bwd)
+            row = {"n": n, "v": v, "bwd": bwd,
+                   "pallas_ms": None if tp is None else round(tp * 1e3, 3),
+                   "xla_ms": None if tx is None else round(tx * 1e3, 3),
+                   "auto_routes_to": "pallas" if routed else "xla"}
+            if tp is not None and tx is not None:
+                row["winner"] = "pallas" if tp < tx else "xla"
+                row["route_correct"] = (row["winner"] == row["auto_routes_to"])
+            elif tx is None and tp is not None:
+                # XLA variant failed (usually OOM) — the kernel is the
+                # only path that runs; routing there is trivially right
+                row["winner"] = "pallas"
+                row["route_correct"] = routed
+            elif tp is None and tx is not None:
+                # the KERNEL failed at a shape auto might route to — the
+                # one disagreement that breaks production, flag loudly
+                row["winner"] = "xla"
+                row["route_correct"] = not routed
+            print(json.dumps(row), flush=True)
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--n", type=int, default=8192)
@@ -28,7 +99,11 @@ def main():
     p.add_argument("--rounds", type=int, default=8)
     p.add_argument("--blocks", type=str, default="",
                    help="comma list of bn:bv pairs")
+    p.add_argument("--crossover", action="store_true",
+                   help="kernel-vs-XLA (N,V) x {fwd,fwd+bwd} routing sweep")
     args = p.parse_args()
+    if args.crossover:
+        return crossover(args)
 
     import jax
     import jax.numpy as jnp

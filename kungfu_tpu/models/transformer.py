@@ -190,7 +190,10 @@ class Transformer:
 
         ids, targets = batch
         logits = self.apply(params, ids, train=train, rng=rng, attn_fn=attn_fn, positions=positions)
-        return token_nll(logits, targets)
+        # train also steers the xent router: eval-only calls take the
+        # fwd-only crossover (the kernel wins much earlier without a
+        # backward to fuse)
+        return token_nll(logits, targets, training=train)
 
 
 def bert_base() -> Transformer:

@@ -237,19 +237,77 @@ def _xent_bwd(block_n, block_v, interpret, res, g):
 _xent.defvjp(_xent_fwd, _xent_bwd)
 
 
-def token_nll(logits, targets):
+#: Per-shape kernel-vs-XLA routing thresholds, seeded from the settled
+#: v5e measurements (BENCH_extra.json tpu_kernels; docs/perf.md):
+#:
+#: * fwd-only: the kernel streams the logits once and beats XLA's
+#:   materialized log-softmax ~2x at HBM scale (2.49 vs 5.00 ms at
+#:   N=8192, V=32768).  Below ~4M logits elements both are microseconds
+#:   and the pallas call overhead can lose — route XLA there.
+#: * fwd+bwd (training): XLA fuses the dlogits-consumer epilogue into
+#:   its backward sweep and wins ~2x (4.69 vs 2.30 ms at the same
+#:   shape) — UNLESS its O(N*V) log-prob + residual set does not fit,
+#:   where the kernel is the only variant that runs at all (the batch-8
+#:   LM OOMs only the XLA path on 16 GiB).  The byte estimate is
+#:   logits + f32 log-probs per element; re-measure the crossover with
+#:   ``benchmarks/xent_sweep.py --crossover`` and adjust via env.
+XENT_FWD_MIN_ELEMENTS = 1 << 22
+XENT_TRAIN_XLA_BUDGET_MB = 2048
+
+
+def _route_fused(n: int, v: int, itemsize: int, training: bool) -> bool:
+    """True = take the Pallas kernel for this (shape, dtype, phase)."""
+    import os
+
+    if training:
+        budget_mb = int(os.environ.get("KF_XENT_XLA_BUDGET_MB",
+                                       str(XENT_TRAIN_XLA_BUDGET_MB)))
+        resid_bytes = n * v * (itemsize + 4)
+        return resid_bytes > (budget_mb << 20)
+    min_el = int(os.environ.get("KF_XENT_FWD_MIN_ELEMENTS",
+                                str(XENT_FWD_MIN_ELEMENTS)))
+    return n * v >= min_el
+
+
+def token_nll(logits, targets, training: bool = True):
     """Mean next-token NLL with the fused/plain dispatch.
 
     The single owner of the ``KF_TPU_XENT`` switch (``fused`` | ``plain``
-    | ``auto``; auto = fused on TPU): both the standalone
+    | ``auto``): both the standalone
     :meth:`~kungfu_tpu.models.transformer.Transformer.loss` head and the
     sharded trainer's pipeline head route through here, so the mode
     semantics can't drift between the two loss paths.  Fused keeps the
-    O(N·V) log-prob tensor and its autodiff residuals out of HBM."""
+    O(N·V) log-prob tensor and its autodiff residuals out of HBM.
+
+    ``auto`` (the default) routes per shape on TPU via
+    :func:`_route_fused` — the round-3 always-fused policy sent every
+    caller to the kernel, including training shapes where XLA's fused
+    backward is ~2x faster.  ``training=False`` lets eval-only callers
+    opt into the fwd-only crossover (the kernel wins much earlier
+    there); the default assumes gradients will flow."""
     import os
 
     mode = os.environ.get("KF_TPU_XENT", "auto").lower()
-    if mode == "fused" or (mode == "auto" and jax.default_backend() == "tpu"):
+    if mode == "xla":
+        mode = "plain"  # long-standing alias
+    if mode not in ("fused", "plain", "auto"):
+        # fail loudly: a typo silently auto-routing (or silently going
+        # plain, as pre-round-4 code did) hides the misconfiguration
+        raise ValueError(
+            f"KF_TPU_XENT={mode!r}: one of fused | plain | xla | auto"
+        )
+    if mode == "fused":
+        fused = True
+    elif mode == "plain" or jax.default_backend() != "tpu":
+        fused = False
+    else:  # auto on TPU: per-shape routing
+        v = logits.shape[-1]
+        n = 1
+        for d in logits.shape[:-1]:
+            n *= d
+        fused = _route_fused(n, v, jnp.dtype(logits.dtype).itemsize,
+                             training)
+    if fused:
         return jnp.mean(softmax_cross_entropy(logits, targets))
     logp = jax.nn.log_softmax(logits)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)

@@ -264,6 +264,52 @@ class TestFusedCrossEntropy:
         np.testing.assert_allclose(float(ref), float(got), atol=1e-5)
 
 
+class TestXentRouting:
+    """Per-shape kernel-vs-XLA auto routing (round-3 VERDICT item 3:
+    auto sent EVERY TPU caller to the kernel, including training shapes
+    where XLA's fused backward is ~2x faster)."""
+
+    def test_training_routes_by_memory_budget(self):
+        from kungfu_tpu.ops.pallas.xent import _route_fused
+
+        # the settled micro-bench shape (N=8192, V=32768, bf16): XLA's
+        # residual estimate is ~1.5 GiB < budget -> XLA wins the train
+        # path (it measured 2.3 vs 4.7 ms)
+        assert _route_fused(8192, 32768, 2, training=True) is False
+        # the batch-8 LM shape that OOMs the XLA variant -> kernel
+        assert _route_fused(16384, 50304, 2, training=True) is True
+
+    def test_eval_routes_by_streaming_scale(self):
+        from kungfu_tpu.ops.pallas.xent import _route_fused
+
+        # fwd-only: kernel measured ~2x at HBM scale
+        assert _route_fused(8192, 32768, 2, training=False) is True
+        # tiny logits: pallas call overhead loses, route XLA
+        assert _route_fused(128, 1024, 4, training=False) is False
+
+    def test_env_budget_override(self, monkeypatch):
+        from kungfu_tpu.ops.pallas.xent import _route_fused
+
+        monkeypatch.setenv("KF_XENT_XLA_BUDGET_MB", "1")
+        assert _route_fused(1024, 1024, 2, training=True) is True
+        monkeypatch.setenv("KF_XENT_XLA_BUDGET_MB", "1048576")
+        assert _route_fused(16384, 50304, 2, training=True) is False
+
+    def test_forced_modes_bypass_routing(self, monkeypatch):
+        """KF_TPU_XENT=fused/plain still win over the shape router, and
+        both compute the same value."""
+        import kungfu_tpu.ops.pallas.xent as X
+
+        logits = jnp.asarray(
+            np.random.default_rng(0).standard_normal((4, 64)), jnp.float32)
+        targets = jnp.asarray([1, 2, 3, 4], jnp.int32)
+        monkeypatch.setenv("KF_TPU_XENT", "plain")
+        ref = float(X.token_nll(logits, targets))
+        monkeypatch.setenv("KF_TPU_XENT", "fused")
+        got = float(X.token_nll(logits, targets))
+        np.testing.assert_allclose(ref, got, atol=1e-5)
+
+
 class TestDefaultBlocks:
     """Adaptive flash block resolution (round-3 v5e sweep: big K/V tiles,
     but never mostly-padding ones)."""
