@@ -1,9 +1,14 @@
 """Model zoo smoke + correctness tests (small shapes, CPU mesh)."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+REPO_BENCH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "benchmarks")
 
 from kungfu_tpu.models import (
     MLP,
@@ -182,6 +187,60 @@ class TestTransformer:
         logits2 = np.asarray(m.apply(params, ids2))
         np.testing.assert_allclose(logits1[0, :-1], logits2[0, :-1], atol=1e-5)
         assert not np.allclose(logits1[0, -1], logits2[0, -1])
+
+
+class TestBNVariants:
+    """benchmarks/bn_sweep.py variant candidates: bf16_norm must be a
+    pure precision change (identical f32 stats, bf16-rounded output);
+    ghost BN must keep shapes and fall back cleanly."""
+
+    def _xpb(self, batch=32, ch=8):
+        from kungfu_tpu.models import nn
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((batch, 4, 4, ch)), jnp.bfloat16)
+        p = nn.batchnorm_init(ch)
+        p["scale"] = jnp.asarray(rng.uniform(0.5, 1.5, ch), jnp.float32)
+        p["bias"] = jnp.asarray(rng.standard_normal(ch), jnp.float32)
+        st = nn.batchnorm_state_init(ch)
+        return x, p, st
+
+    def test_bf16_norm_matches_prod(self):
+        import sys
+        sys.path.insert(0, REPO_BENCH)
+        from bn_sweep import bn_variant
+        from kungfu_tpu.models import nn
+
+        x, p, st = self._xpb()
+        y0, s0 = nn.batchnorm_apply(p, st, x, train=True)
+        y1, s1 = bn_variant("bf16_norm")(p, st, x, train=True)
+        # stats path is bit-identical f32
+        for k in s0:
+            np.testing.assert_array_equal(np.asarray(s0[k]), np.asarray(s1[k]))
+        # output differs only by bf16 rounding of the elementwise chain
+        np.testing.assert_allclose(
+            np.asarray(y0, np.float32), np.asarray(y1, np.float32),
+            atol=0.05, rtol=0.05)
+
+    def test_ghost_groups_and_fallback(self):
+        import sys
+        sys.path.insert(0, REPO_BENCH)
+        from bn_sweep import bn_variant
+        from kungfu_tpu.models import nn
+
+        x, p, st = self._xpb(batch=32)
+        y, s = bn_variant("ghost16")(p, st, x, train=True)
+        assert y.shape == x.shape and y.dtype == x.dtype
+        assert np.isfinite(np.asarray(s["mean"])).all()
+        # per-group normalization: each 16-sample group ~zero mean
+        yg = np.asarray(y, np.float32).reshape(2, -1, x.shape[-1])
+        centered = (yg - np.asarray(p["bias"])) / np.asarray(p["scale"])
+        assert abs(centered.mean(axis=1)).max() < 0.05
+        # batch == group size falls back to prod exactly
+        xs, ps, sts = self._xpb(batch=16)
+        y0, _ = nn.batchnorm_apply(ps, sts, xs, train=True)
+        y1, _ = bn_variant("ghost16")(ps, sts, xs, train=True)
+        np.testing.assert_array_equal(np.asarray(y0), np.asarray(y1))
 
 
 class TestFakeModels:
