@@ -1,8 +1,9 @@
 """``kfrun`` — the kungfu-run analog.
 
 Flag parity with reference ``srcs/go/kungfu/runner/flags.go:29-104`` (the
-subset meaningful on TPU; ``-allow-nvlink`` and NIC inference have no
-analog).  Dispatch parity with ``app/kungfu-run.go:18-116``:
+subset meaningful on TPU; ``-allow-nvlink`` has no analog; the reference's
+NIC-based self discovery is ``-self auto``, ``runner/discovery.py``).
+Dispatch parity with ``app/kungfu-run.go:18-116``:
 
 * default: **SimpleRun** — spawn all local workers, wait
   (``runner/simple.go:13-21``);
@@ -45,7 +46,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "TPU pod, one per pod host)")
     p.add_argument("-H", dest="hosts", default="", help="host spec list ip:slots,...")
     p.add_argument("-hostfile", default="", help="MPI-style hostfile")
-    p.add_argument("-self", dest="self_host", default="127.0.0.1", help="this runner's host ip")
+    p.add_argument("-self", dest="self_host", default="127.0.0.1",
+                   help="this runner's host ip; 'auto' probes which -H "
+                        "entry this machine holds (reference NIC discovery)")
     p.add_argument("-strategy", default="AUTO", help="allreduce strategy name")
     p.add_argument("-w", dest="watch", action="store_true", help="elastic watch mode")
     p.add_argument("-device-world", dest="device_world", action="store_true",
@@ -185,6 +188,19 @@ def apply_platform(ns) -> None:
 def main(argv: Optional[List[str]] = None) -> int:
     ns = build_parser().parse_args(argv)
     apply_platform(ns)
+    if ns.self_host == "auto":
+        # reference runner/discovery.go: same command line on every
+        # host; each runner works out which -H entry it is
+        if not (ns.hosts or ns.hostfile):
+            raise SystemExit("kfrun: -self auto needs -H or -hostfile")
+        from kungfu_tpu.runner.discovery import infer_self_ip
+
+        try:
+            ns.self_host = infer_self_ip(
+                [h.ip for h in build_hostlist(ns).hosts])
+        except RuntimeError as e:
+            raise SystemExit(f"kfrun: {e}") from None
+        _log.info("self host inferred: %s", ns.self_host)
     if ns.np is None:
         ns.np = 1
     if ns.backend is None:

@@ -155,6 +155,40 @@ class TestStrategyTourExample:
         assert "[4] zero1" in r.stdout and "(1/8)" in r.stdout
 
 
+class TestSelfDiscovery:
+    """-self auto (reference runner/discovery.go): probe which -H entry
+    this machine holds (bind probe per candidate)."""
+
+    def test_loopback_infers(self):
+        from kungfu_tpu.runner.discovery import infer_self_ip
+
+        assert infer_self_ip(["127.0.0.1", "203.0.113.7"]) == "127.0.0.1"
+
+    @pytest.mark.skipif(sys.platform != "linux",
+                        reason="whole-127/8 loopback binding is Linux-only")
+    def test_ambiguous_aliases_raise(self):
+        from kungfu_tpu.runner.discovery import infer_self_ip
+
+        with pytest.raises(RuntimeError, match="pass -self"):
+            infer_self_ip(["127.0.0.1", "127.0.0.2"])
+
+    def test_no_local_entry_raises(self):
+        from kungfu_tpu.runner.discovery import infer_self_ip
+
+        with pytest.raises(RuntimeError, match="none of"):
+            infer_self_ip(["203.0.113.7", "203.0.113.8"])
+
+    def test_cli_wires_auto(self):
+        """main() resolves -self auto before building the cluster; with a
+        hostless command line it refuses."""
+        from kungfu_tpu.runner import cli
+
+        with pytest.raises(SystemExit, match="-self auto needs"):
+            # -platform none: the ambient TPU-pod env contract would
+            # otherwise fill -H/-self before the check
+            cli.main(["-self", "auto", "-platform", "none", "true"])
+
+
 class TestCLIParsing:
     def test_parser_flags(self):
         from kungfu_tpu.runner.cli import build_cluster, build_parser
