@@ -127,6 +127,49 @@ class TestHostChannel:
             np.frombuffer(got, np.float32), payload
         )
 
+    def test_post_recv_staged(self, channels):
+        """Staged receive (round-4 gossip pull shape): the destination
+        is registered BEFORE the matching request/response crosses the
+        wire — every backend mix must fill the buffer; mismatches fall
+        back; abort releases the registration."""
+        import numpy as np
+
+        peers, chans = channels
+        payload = np.arange(512, dtype=np.float32)
+
+        # registered first, payload arrives later (the zero-copy path
+        # on the native backend)
+        buf = np.empty(512, np.float32)
+        posted = chans[1].post_recv(peers[0], "pr1", buf)
+        chans[0].send(peers[1], "pr1", payload)  # buffer-protocol send
+        assert posted.wait(timeout=30.0)
+        np.testing.assert_array_equal(buf, payload)
+
+        # payload queued before the post: still resolves
+        chans[0].send(peers[1], "pr2", payload.tobytes())
+        time.sleep(0.3)
+        buf2 = np.empty(512, np.float32)
+        posted = chans[1].post_recv(peers[0], "pr2", buf2)
+        assert posted.wait(timeout=10.0)
+        np.testing.assert_array_equal(buf2, payload)
+
+        # size mismatch -> False, payload stays for recv()
+        small = np.empty(8, np.float32)
+        posted = chans[1].post_recv(peers[0], "pr3", small)
+        chans[0].send(peers[1], "pr3", payload.tobytes())
+        assert not posted.wait(timeout=10.0)
+        got = chans[1].recv(peers[0], "pr3", timeout=10.0)
+        np.testing.assert_array_equal(np.frombuffer(got, np.float32), payload)
+
+        # abort: a later send lands in the queue, not the dead buffer
+        buf3 = np.zeros(512, np.float32)
+        posted = chans[1].post_recv(peers[0], "pr4", buf3)
+        posted.abort()
+        chans[0].send(peers[1], "pr4", payload.tobytes())
+        got = chans[1].recv(peers[0], "pr4", timeout=10.0)
+        np.testing.assert_array_equal(np.frombuffer(got, np.float32), payload)
+        assert not buf3.any(), "aborted buffer must stay untouched"
+
     def test_barrier(self, channels):
         peers, chans = channels
         run_all([lambda c=c: c.barrier(peers) for c in chans])
