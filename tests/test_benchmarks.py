@@ -77,7 +77,7 @@ class TestHarnesses:
         assert set(out["throughput_by_np"]) == {"1", "2"}
         assert out["throughput_by_np"]["1"] > 0
         assert out["baseline_np"] == 1
-        assert out["scaling_efficiency_vs_np1"]["1"] == 1.0
+        assert out["overhead_retention_vs_np1"]["1"] == 1.0
 
     def test_system_zero1(self):
         """Weight-update sharding through the throughput harness."""
