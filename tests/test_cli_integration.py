@@ -92,6 +92,38 @@ class TestCLI:
 
 
 @pytest.mark.slow
+class TestGossipExample:
+    def test_two_workers_mix_and_converge(self, tmp_path):
+        """PairAveraging under the REAL launcher: each worker sees only
+        its own data slice, so converging to the shared truth proves the
+        cross-process model pulls actually mixed the replicas."""
+        import glob
+        import re
+
+        logdir = str(tmp_path / "logs")
+        r = run_cli_prog(
+            [sys.executable, "-m", "kungfu_tpu.runner.cli",
+             "-np", "2", "-H", "127.0.0.1:2", "-logdir", logdir,
+             sys.executable, "examples/gossip_train.py",
+             "--", "--steps", "40"],
+            timeout=300,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        rows = []
+        for f in glob.glob(os.path.join(logdir, "*.stdout.log")):
+            for ln in open(f):
+                m = re.match(r"KFGOSSIP rank=(\d+) size=2 "
+                             r"final_loss=([\d.]+) w_err=([\d.]+) "
+                             r"pulls=(\d+)", ln)
+                if m:
+                    rows.append(tuple(float(x) for x in m.groups()))
+        assert len(rows) == 2, rows
+        for rank, loss, err, pulls in rows:
+            assert loss < 0.05 and err < 0.5, rows
+            assert pulls == 40
+
+
+@pytest.mark.slow
 class TestHostEngineSystemBench:
     def test_np2_through_launcher(self, tmp_path):
         """Round-3 VERDICT item 6: the system bench must run as REAL
