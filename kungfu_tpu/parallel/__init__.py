@@ -17,7 +17,7 @@ from kungfu_tpu.parallel.tp import (
     tp_region_exit,
 )
 from kungfu_tpu.parallel.train import ShardedTrainer, dp_train_step
-from kungfu_tpu.parallel.zero import zero1_train_step
+from kungfu_tpu.parallel.zero import zero1_reshard, zero1_train_step
 
 __all__ = [
     "AXES",
@@ -27,6 +27,7 @@ __all__ = [
     "AXIS_TP",
     "MeshPlan",
     "ShardedTrainer",
+    "zero1_reshard",
     "zero1_train_step",
     "column_dense",
     "row_dense",

@@ -185,6 +185,61 @@ def zero1_train_step(loss_fn, inner: optax.GradientTransformation, comm,
     return step, init_opt
 
 
+def zero1_reshard(opt_shard, params, new_comm):
+    """Re-place a ZeRO-1 optimizer shard onto a NEW mesh epoch.
+
+    The sharded state's geometry (chunk = ceil(total/n), mesh-major
+    scatter order) is baked into each vector leaf, so an elastic resize
+    cannot just keep training — the state must be re-chunked for the
+    new world size.  Each vector leaf is unpadded to the true parameter
+    count (recovered from ``params``), re-padded to the NEW chunk
+    geometry, and placed sharded over the new mesh; scalar leaves (e.g.
+    Adam's step count) are re-placed replicated.  Values are exactly
+    preserved, so training continues as if the optimizer had always run
+    at the new size — the same guarantee the elementwise-equivalence of
+    the step itself gives.
+
+    Single-controller meshes only (the simulated-peer and single-host
+    cases): a multi-controller elastic jump additionally needs a
+    host-plane gather/broadcast of the state — joiners hold none of it
+    — which is the params-resync path (`initializer.resync_parameters`)
+    generalized; raise rather than silently mis-shard there.
+    """
+    from jax.sharding import NamedSharding
+
+    if new_comm._multiproc:
+        raise NotImplementedError(
+            "zero1_reshard on a multi-controller mesh needs a host-plane "
+            "state gather/broadcast; single-controller meshes only"
+        )
+    total = int(np.sum([int(np.prod(l.shape)) for l in
+                        jax.tree_util.tree_leaves(params)]))
+    n = new_comm.size
+    chunk = math.ceil(total / n)
+    padded = chunk * n
+    sharded = NamedSharding(new_comm.mesh, P(new_comm.axis))
+    replicated = new_comm.replicated_sharding()
+
+    def leaf(a):
+        if getattr(a, "ndim", 0) == 0:
+            return jax.device_put(jnp.asarray(a), replicated)
+        if a.shape[0] < total:
+            # the state was built for MORE parameters than ``params``
+            # holds (e.g. a trainable-only subtree was passed):
+            # truncating would silently corrupt the optimizer state
+            raise ValueError(
+                f"optimizer state leaf has {a.shape[0]} elements but "
+                f"params fuse to {total} — zero1_reshard needs the SAME "
+                "param tree the state was built from"
+            )
+        full = np.asarray(a)[:total]  # drop the OLD epoch's padding
+        buf = np.zeros((padded,), full.dtype)
+        buf[:total] = full
+        return jax.device_put(buf, sharded)
+
+    return jax.tree_util.tree_map(leaf, opt_shard)
+
+
 def opt_state_bytes(opt_state) -> int:
     """Total bytes across an optimizer-state pytree (for the memory
     assertion in tests/benchmarks)."""

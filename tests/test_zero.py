@@ -116,6 +116,63 @@ class TestZero1:
                 np.asarray(p[k]), np.asarray(ref_p[k]),
                 rtol=1e-4, atol=1e-5, err_msg=k)
 
+    def test_reshard_across_resize_tracks_reference(self):
+        """An elastic resize mid-run (8 → 4 devices) with zero1_reshard
+        must continue EXACTLY like the replicated optimizer seeing the
+        same global batches: momentum state survives the re-chunking."""
+        from kungfu_tpu.parallel.zero import zero1_reshard
+
+        devs = jax.devices()
+        c8 = Communicator(devices=devs[:8], local_size=8, version=0)
+        c4 = Communicator(devices=devs[:4], local_size=4, version=1)
+        params, batch = _params(), _batch(16)  # 16 divides 8 and 4
+        inner = lambda: optax.adam(1e-2)  # noqa: E731 — two-moment state
+
+        # reference: replicated S-SGD over the SAME global batches, mesh
+        # change irrelevant to its math
+        tx = synchronous_sgd(inner(), c8.axis)
+        ref_step8 = dp_train_step(_loss_fn, tx, c8)
+        tx4 = synchronous_sgd(inner(), c4.axis)
+        ref_step4 = dp_train_step(_loss_fn, tx4, c4)
+        ref_p, ref_o = params, tx.init(params)
+        for _ in range(2):
+            ref_p, ref_o, _ = ref_step8(ref_p, ref_o, batch)
+        # carry the OPTIMIZER state across the mesh change (replicated
+        # state has no geometry — only its placement moves epochs)
+        from kungfu_tpu.initializer import resync_parameters
+
+        ref_p = resync_parameters(ref_p, comm=c4)
+        ref_o = jax.tree_util.tree_map(
+            lambda a: jax.device_put(np.asarray(a), c4.replicated_sharding()),
+            ref_o)
+        for _ in range(2):
+            ref_p, ref_o, _ = ref_step4(ref_p, ref_o, batch)
+
+        step8, init8 = zero1_train_step(_loss_fn, inner(), c8)
+        p, o = params, init8(params)
+        for _ in range(2):
+            p, o, _ = step8(p, o, batch)
+        o = zero1_reshard(o, p, c4)
+        p = resync_parameters(p, comm=c4)  # params re-place replicated
+        step4, _ = zero1_train_step(_loss_fn, inner(), c4)
+        for _ in range(2):
+            p, o, _ = step4(p, o, batch)
+
+        for k in params:
+            np.testing.assert_allclose(
+                np.asarray(p[k]), np.asarray(ref_p[k]),
+                rtol=1e-4, atol=1e-5, err_msg=k)
+
+    def test_reshard_refuses_multicontroller(self):
+        from kungfu_tpu.parallel.zero import zero1_reshard
+
+        comm = Communicator(devices=jax.devices()[:4], local_size=4)
+        _, init_opt = zero1_train_step(_loss_fn, optax.sgd(0.1), comm)
+        o = init_opt(_params())
+        comm._multiproc = True  # simulate a provisioned-world mesh
+        with pytest.raises(NotImplementedError, match="host-plane"):
+            zero1_reshard(o, _params(), comm)
+
     def test_odd_total_size_pads(self):
         """A parameter count not divisible by n exercises the pad path
         end to end (pad grads are zero, pad params stay zero)."""
