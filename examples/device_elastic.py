@@ -82,31 +82,35 @@ def main() -> int:
 
         model = mnist_slp()
         params = model.init(jax.random.PRNGKey(1))  # same init on all slots
-        opt = optax.sgd(0.1)
+        opt = optax.sgd(0.1, momentum=0.9)
+
+    opt_state = None
 
     def train_epoch(comm, v):
-        """A few real S-SGD steps over THIS mesh epoch; params survive the
-        epoch transitions.  Epoch entry does the reference's post-resize
-        state re-sync: host-plane broadcast from rank 0 (joiners adopt the
-        survivors' weights), then an explicit re-placement onto the NEW
-        mesh epoch (arrays stay committed to the old epoch's devices and
-        jit rejects the mismatch otherwise)."""
+        """A few real S-SGD steps over THIS mesh epoch; params AND
+        optimizer state survive the epoch transitions.  Epoch entry does
+        the reference's post-resize state re-sync on the device plane:
+        rank 0's weights and momentum ride a compiled mesh broadcast
+        (joiners adopt the survivors' training trajectory, not a cold
+        restart), landing replicated on the NEW mesh epoch."""
         import jax
         import jax.numpy as jnp
 
         from kungfu_tpu.initializer import resync_parameters
         from kungfu_tpu.parallel.train import dp_train_step
 
-        nonlocal params
-        # device-plane re-sync: survivors + joiners share the new mesh, so
-        # rank 0's weights ride the compiled broadcast (ICI), not the host
-        # TCP channel, and land replicated on the new epoch
-        params = resync_parameters(params, peer, comm=comm)
+        nonlocal params, opt_state
         tx = synchronous_sgd(opt, comm.axis)
         step = dp_train_step(
             lambda p, b: model.loss(p, b), tx, comm
         )
-        opt_state = tx.init(params)
+        # ONE resync collective for params + state: every member supplies
+        # a same-structure tree (a joiner's fresh init is structure, not
+        # values — rank 0's weights AND momentum win the broadcast)
+        local_state = opt_state if opt_state is not None else tx.init(params)
+        params, opt_state = resync_parameters(
+            (params, local_state), peer, comm=comm
+        )
         # FIXED seed: every epoch replays the same global batch sequence,
         # so a changing loss across epochs proves the weights carried over
         # (a silent re-init would repeat epoch 0's loss exactly)
