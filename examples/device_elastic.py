@@ -55,9 +55,16 @@ def main() -> int:
                          "(the multi-controller settled-path proof: every "
                          "process must land the same measured winner, "
                          "printed in the KFEPOCH strategy= field)")
+    ap.add_argument("--zero1", action="store_true",
+                    help="with --train: ZeRO-1 weight-update sharding; the "
+                         "1/n optimizer shards are carried across resizes "
+                         "via zero1_snapshot/zero1_restore (host-plane "
+                         "gather to rank 0, broadcast, re-chunk)")
     ns = ap.parse_args()
     if ns.steps_per_epoch < 1:
         ap.error("--steps-per-epoch must be >= 1")
+    if ns.zero1 and not ns.train:
+        ap.error("--zero1 requires --train")
     schedule = [int(s) for s in ns.schedule.split(",")]
     shutdown_version = len(schedule)
 
@@ -85,6 +92,7 @@ def main() -> int:
         opt = optax.sgd(0.1, momentum=0.9)
 
     opt_state = None
+    z1_snap = None  # rank 0's host snapshot of the sharded state
 
     def train_epoch(comm, v):
         """A few real S-SGD steps over THIS mesh epoch; params AND
@@ -92,25 +100,43 @@ def main() -> int:
         the reference's post-resize state re-sync on the device plane:
         rank 0's weights and momentum ride a compiled mesh broadcast
         (joiners adopt the survivors' training trajectory, not a cold
-        restart), landing replicated on the NEW mesh epoch."""
+        restart), landing replicated on the NEW mesh epoch.  With
+        ``--zero1`` the optimizer state is SHARDED 1/n per member and
+        crosses the resize via zero1_snapshot/zero1_restore instead."""
         import jax
         import jax.numpy as jnp
 
         from kungfu_tpu.initializer import resync_parameters
         from kungfu_tpu.parallel.train import dp_train_step
 
-        nonlocal params, opt_state
-        tx = synchronous_sgd(opt, comm.axis)
-        step = dp_train_step(
-            lambda p, b: model.loss(p, b), tx, comm
-        )
-        # ONE resync collective for params + state: every member supplies
-        # a same-structure tree (a joiner's fresh init is structure, not
-        # values — rank 0's weights AND momentum win the broadcast)
-        local_state = opt_state if opt_state is not None else tx.init(params)
-        params, opt_state = resync_parameters(
-            (params, local_state), peer, comm=comm
-        )
+        nonlocal params, opt_state, z1_snap
+        if ns.zero1:
+            from kungfu_tpu.parallel import (zero1_restore, zero1_snapshot,
+                                             zero1_train_step)
+
+            params = resync_parameters(params, peer, comm=comm)
+            step, init_opt = zero1_train_step(
+                lambda p, b: model.loss(p, b), opt, comm)
+            fresh = init_opt(params)
+            # joiners pass snapshot=None and receive rank 0's over the
+            # host channel; the fresh init supplies structure + the new
+            # chunk geometry
+            opt_state = (fresh if v == 0
+                         else zero1_restore(z1_snap, fresh, params, peer,
+                                            new_comm=comm))
+        else:
+            tx = synchronous_sgd(opt, comm.axis)
+            step = dp_train_step(
+                lambda p, b: model.loss(p, b), tx, comm
+            )
+            # ONE resync collective for params + state: every member
+            # supplies a same-structure tree (a joiner's fresh init is
+            # structure, not values — rank 0's weights AND momentum win)
+            local_state = (opt_state if opt_state is not None
+                           else tx.init(params))
+            params, opt_state = resync_parameters(
+                (params, local_state), peer, comm=comm
+            )
         # FIXED seed: every epoch replays the same global batch sequence,
         # so a changing loss across epochs proves the weights carried over
         # (a silent re-init would repeat epoch 0's loss exactly)
@@ -121,6 +147,10 @@ def main() -> int:
             xb = jnp.asarray(rng.normal(size=(gb, 784)), jnp.float32)
             yb = jnp.asarray(rng.integers(0, 10, gb), jnp.int32)
             params, opt_state, loss = step(params, opt_state, (xb, yb))
+        if ns.zero1:
+            # collective over THIS epoch's membership — must run before
+            # the next resize retires it
+            z1_snap = zero1_snapshot(opt_state, peer)
         return float(loss)
 
     try:

@@ -17,7 +17,8 @@ from kungfu_tpu.parallel.tp import (
     tp_region_exit,
 )
 from kungfu_tpu.parallel.train import ShardedTrainer, dp_train_step
-from kungfu_tpu.parallel.zero import zero1_reshard, zero1_train_step
+from kungfu_tpu.parallel.zero import (zero1_reshard, zero1_restore,
+                                      zero1_snapshot, zero1_train_step)
 
 __all__ = [
     "AXES",
@@ -28,6 +29,8 @@ __all__ = [
     "MeshPlan",
     "ShardedTrainer",
     "zero1_reshard",
+    "zero1_restore",
+    "zero1_snapshot",
     "zero1_train_step",
     "column_dense",
     "row_dense",

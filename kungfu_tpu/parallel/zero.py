@@ -154,8 +154,17 @@ def zero1_train_step(loss_fn, inner: optax.GradientTransformation, comm,
         def outer(params, opt_shard, batch):
             p_flat, opt_shard, loss = inner_step(params, opt_shard, batch)
             # p_flat is the sharded [padded] buffer; defuse's slices make
-            # the partitioner insert the all-gather back to replicated
-            new_params = defuse(p_flat[:total], spec)
+            # the partitioner insert the all-gather back to replicated —
+            # PINNED, not left to compiler choice: a sharded params
+            # output would poison every replicated-convention consumer
+            # (resync, host snapshots) on multi-controller meshes
+            from jax.sharding import NamedSharding
+
+            rep = NamedSharding(mesh, P())
+            new_params = jax.tree_util.tree_map(
+                lambda a: jax.lax.with_sharding_constraint(a, rep),
+                defuse(p_flat[:total], spec),
+            )
             return new_params, opt_shard, loss
 
         return (
@@ -223,21 +232,166 @@ def zero1_reshard(opt_shard, params, new_comm):
     def leaf(a):
         if getattr(a, "ndim", 0) == 0:
             return jax.device_put(jnp.asarray(a), replicated)
-        if a.shape[0] < total:
-            # the state was built for MORE parameters than ``params``
-            # holds (e.g. a trainable-only subtree was passed):
-            # truncating would silently corrupt the optimizer state
-            raise ValueError(
-                f"optimizer state leaf has {a.shape[0]} elements but "
-                f"params fuse to {total} — zero1_reshard needs the SAME "
-                "param tree the state was built from"
-            )
-        full = np.asarray(a)[:total]  # drop the OLD epoch's padding
-        buf = np.zeros((padded,), full.dtype)
-        buf[:total] = full
-        return jax.device_put(buf, sharded)
+        return jax.device_put(_repad(np.asarray(a), total, padded), sharded)
 
     return jax.tree_util.tree_map(leaf, opt_shard)
+
+
+def _repad(full: np.ndarray, total: int, new_padded: int) -> np.ndarray:
+    """Unpad a flat state vector to the true parameter count and re-pad
+    for a new chunk geometry — shared by reshard and restore so their
+    geometry (and its misuse diagnostic) cannot drift."""
+    if full.shape[0] < total:
+        # the state was built for MORE parameters than ``params`` holds
+        # (e.g. a trainable-only subtree was passed): truncating would
+        # silently corrupt the optimizer state
+        raise ValueError(
+            f"optimizer state vector has {full.shape[0]} elements but "
+            f"params fuse to {total} — zero1 reshard/restore needs the "
+            "SAME param tree the state was built from"
+        )
+    buf = np.zeros((new_padded,), full.dtype)
+    buf[:total] = full[:total]
+    return buf
+
+
+def zero1_snapshot(opt_shard, peer=None):
+    """End-of-epoch HOST snapshot of the sharded optimizer state.
+
+    Each member contributes its addressable chunks over the host channel
+    (state_bytes/n each — no HBM spike; only rank 0's HOST RAM holds the
+    assembled state on the snapshot side.  :func:`zero1_restore` then
+    broadcasts the blob, so each member transiently holds ~state_bytes
+    in host RAM while re-chunking — host RAM, not HBM, so the 1/n HBM
+    contract is untouched; a per-range scatter is the future
+    optimization).  Rank 0 returns the blob, everyone else ``None``.
+    The elastic contract is the coordinator's: **rank 0 must survive
+    the resize** (it is the peer proposing it).
+
+    Without a channel (single-process / simulated peers) every chunk is
+    addressable locally and the blob is assembled in place.
+    """
+    import io
+
+    chan = getattr(peer, "channel", None) if peer is not None else None
+    leaves, _ = jax.tree_util.tree_flatten(opt_shard)
+    parts = {}
+    scalars = {}
+    for i, leaf in enumerate(leaves):
+        if getattr(leaf, "ndim", 0) == 0:
+            scalars[f"s{i}"] = np.asarray(leaf)
+            continue
+        if chan is None and not leaf.is_fully_addressable:
+            # mirror zero1_reshard's misuse guard: packing only the
+            # local 1/n without a channel to gather the rest would
+            # build a silently incomplete snapshot
+            raise ValueError(
+                "zero1_snapshot without a host channel needs fully "
+                "addressable state (multi-controller meshes must pass "
+                "the peer)"
+            )
+        for s in leaf.addressable_shards:
+            start = s.index[0].start or 0
+            parts[f"l{i}_o{start}"] = np.asarray(s.data)
+
+    def pack(d):
+        bio = io.BytesIO()
+        np.savez(bio, **d)
+        return bio.getvalue()
+
+    if chan is None:
+        merged = dict(parts)
+        merged.update(scalars)
+        return pack(merged)
+    rank = peer.rank()
+    name = f"kf.z1snap.v{peer.cluster_version}"
+    gathered = chan.gather_bytes(pack(parts), peer.cluster.workers, name)
+    if rank != 0:
+        return None
+    merged = {}
+    for blob in gathered:
+        with np.load(io.BytesIO(blob)) as z:
+            for k in z.files:
+                merged[k] = z[k]
+    merged.update(scalars)  # replicated: rank 0's copy is everyone's
+    return pack(merged)
+
+
+def zero1_restore(snapshot, fresh_opt_shard, params, peer=None,
+                  new_comm=None):
+    """Rebuild the sharded optimizer state on a NEW mesh epoch from a
+    :func:`zero1_snapshot` blob.
+
+    ``fresh_opt_shard`` is ``init_opt(params)`` from the NEW epoch's
+    :func:`zero1_train_step` — it supplies the state STRUCTURE and the
+    new chunk geometry (joiners have no old state to supply either);
+    its values are overwritten.  Rank 0 passes the blob; other members
+    pass ``None`` and receive it over the host channel."""
+    import io
+
+    chan = getattr(peer, "channel", None) if peer is not None else None
+    if chan is not None:
+        if peer.rank() == 0 and snapshot is None:
+            # fail HERE, before the broadcast: a bare assert inside
+            # broadcast_bytes would kill rank 0 and leave every other
+            # member stalling in recv until its timeout
+            raise ValueError(
+                "zero1_restore: rank 0 must supply the snapshot blob"
+            )
+        name = f"kf.z1rest.v{peer.cluster_version}"
+        snapshot = chan.broadcast_bytes(snapshot, peer.cluster.workers, name)
+    if snapshot is None:
+        raise ValueError("zero1_restore: no snapshot (rank 0 must supply it)")
+    total = int(np.sum([int(np.prod(l.shape)) for l in
+                        jax.tree_util.tree_leaves(params)]))
+    leaves, treedef = jax.tree_util.tree_flatten(fresh_opt_shard)
+    with np.load(io.BytesIO(snapshot)) as z:
+        by_leaf = {}
+        for k in z.files:
+            if k.startswith("s"):
+                by_leaf[("s", int(k[1:]))] = z[k]
+            else:
+                li, off = k[1:].split("_o")
+                by_leaf.setdefault(("l", int(li)), []).append(
+                    (int(off), z[k]))
+
+    sharded = None
+    if new_comm is not None:
+        from jax.sharding import NamedSharding
+
+        sharded = NamedSharding(new_comm.mesh, P(new_comm.axis))
+    out = []
+    for i, leaf in enumerate(leaves):
+        if getattr(leaf, "ndim", 0) == 0:
+            val = by_leaf.get(("s", i))
+            if val is None:
+                out.append(leaf)
+            elif new_comm is not None:
+                out.append(jax.device_put(jnp.asarray(val),
+                                          new_comm.replicated_sharding()))
+            else:
+                out.append(jnp.asarray(val))
+            continue
+        chunks = sorted(by_leaf.get(("l", i), []))
+        if not chunks:
+            raise ValueError(f"snapshot holds no chunks for state leaf {i}")
+        # chunks must tile [0, covered) with no interior gap: a
+        # count-based check misses a hole whenever the old padding is at
+        # least one chunk wide, silently restoring zeros into momentum
+        expected = 0
+        for off, c in chunks:
+            if off != expected:
+                raise ValueError(
+                    f"snapshot leaf {i}: chunk gap at offset {expected} "
+                    f"(next chunk starts at {off}) — a contributing "
+                    "member's chunks are missing"
+                )
+            expected = off + c.shape[0]
+        full = np.concatenate([c for _, c in chunks])
+        buf = _repad(full, total, int(leaf.shape[0]))  # NEW padded size
+        out.append(jax.device_put(buf, sharded) if sharded is not None
+                   else jnp.asarray(buf))
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def opt_state_bytes(opt_state) -> int:

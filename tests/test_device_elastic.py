@@ -208,6 +208,41 @@ class TestLiveResize:
                 # two different compiled programs on one collective
                 assert strategy == "ring", (v, world_rank, rows)
 
+    def test_zero1_training_survives_mesh_epochs(self, tmp_path):
+        """ZeRO-1 across live resizes: the 1/n-sharded optimizer state is
+        snapshot/restored over the host plane at each epoch boundary —
+        every member of every epoch must report the bit-identical loss
+        (replicas in sync through two re-chunkings)."""
+        logdir = str(tmp_path / "logs")
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        r = subprocess.run(
+            [sys.executable, "-m", "kungfu_tpu.runner.cli",
+             "-np", "2", "-H", "127.0.0.1:4", "-w", "-device-world",
+             "-builtin-config-port", "9315", "-logdir", logdir, "-q",
+             sys.executable, "examples/device_elastic.py",
+             "--", "--schedule", "2,4,2", "--train", "--zero1"],
+            cwd=REPO, capture_output=True, text=True, timeout=420, env=env,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        lines = []
+        for f in glob.glob(os.path.join(logdir, "*.stdout.log")):
+            with open(f) as fh:
+                lines += fh.read().splitlines()
+        losses = {}
+        for ln in lines:
+            m = re.match(r"KFEPOCH v=(\d+) .*ok=True.* loss=([\d.eE+-]+)", ln)
+            if m:
+                losses.setdefault(int(m.group(1)), []).append(m.group(2))
+        assert sorted(losses) == [0, 1, 2], lines
+        assert [len(losses[v]) for v in (0, 1, 2)] == [2, 4, 2]
+        for v, vals in losses.items():
+            assert len(set(vals)) == 1, f"epoch {v} replicas diverged: {vals}"
+        # the sharded state carried: losses are all distinct epoch to
+        # epoch and the run keeps improving on the repeated batches
+        l0, l1, l2 = (float(losses[v][0]) for v in (0, 1, 2))
+        assert len({l0, l1, l2}) == 3 and l2 < l0, (l0, l1, l2)
+
     def test_autotune_agrees_on_multiprocess_mesh(self, tmp_path):
         """Round-3 VERDICT weak #8: autotune on a multi-controller mesh
         must ride the settled chained-K harness (no eager fallback) and
