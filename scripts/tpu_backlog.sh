@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# The round-4 TPU measurement backlog, in priority order — run the moment
+# the relay tunnel recovers (it was wedged for the whole build session).
+# Each step is independently timeout-guarded so a re-wedge mid-backlog
+# still keeps everything captured up to that point.
+#
+#   bash scripts/tpu_backlog.sh [outdir]
+#
+# Priority order (round-3 VERDICT items):
+#  1. headline ResNet-50 through dp_train_step+synchronous_sgd (item 1)
+#  2. kernels payload (flash + xent table refresh)
+#  3. xent crossover sweep -> audit token_nll's routing table (item 3)
+#  4. BN variant sweep -> pick the winner for the BN tax (item 2)
+#  5. S=8192 long-context refresh with the settled harness (item 1)
+#  6. LM-in-anger payload
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-/tmp/tpu_backlog}"
+mkdir -p "$OUT"
+log() { echo "[backlog $(date +%H:%M:%S)] $*"; }
+
+run() { # name timeout cmd...
+  local name="$1" to="$2"; shift 2
+  log "$name ..."
+  if timeout "$to" "$@" >"$OUT/$name.json" 2>"$OUT/$name.err"; then
+    log "$name OK: $(tail -c 300 "$OUT/$name.json")"
+  else
+    log "$name FAILED (rc=$?) — see $OUT/$name.err"
+  fi
+}
+
+run headline   1800 python bench.py
+run kernels    1500 python bench.py --kernels
+run xent_cross 1800 python benchmarks/xent_sweep.py --crossover
+run bn_sweep   1800 python benchmarks/bn_sweep.py
+run longctx    1500 python bench.py --kernels --seq-len 8192
+run lm         1500 python bench.py --lm
+
+log "done; fold the results into BENCH_extra.json + docs/perf.md:"
+log " - headline/kernels/lm replace the matching BENCH_extra sections"
+log " - xent_cross: any route_correct=false row -> adjust _route_fused"
+log "   thresholds (ops/pallas/xent.py) and re-run"
+log " - bn_sweep: if a variant beats prod at full shape, promote it in"
+log "   models/nn.py behind exactness tests"
+ls -la "$OUT"
