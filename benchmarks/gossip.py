@@ -38,6 +38,26 @@ def main(argv=None) -> dict:
     p.add_argument("--steps", type=int, default=None)
     p.add_argument("--warmup", type=int, default=None)
     p.add_argument("--base-port", type=int, default=28600)
+    p.add_argument("--mode", choices=["blocking", "async", "both"],
+                   default="blocking",
+                   help="blocking = pull on the critical path; async = "
+                        "background puller (AsyncModelAveraging parity); "
+                        "both = run each and report the ratio")
+    p.add_argument("--wire-ms", type=float, default=0.0,
+                   help="inject this much one-way latency into every "
+                        "model pull (slow DCN emulation).  Blocking "
+                        "gossip pays it on the critical path every step; "
+                        "async hides it behind compute — the "
+                        "steps/s ratio is the mechanism proof, and it "
+                        "does not need idle cores to show.")
+    p.add_argument("--device-ms", type=float, default=0.0,
+                   help="emulate device-resident step compute: each step "
+                        "waits this long WITHOUT holding the host CPU — "
+                        "the regime async gossip is built for (on TPU the "
+                        "jitted step runs on the chip while the host "
+                        "serves the wire).  On a 1-core host the plain "
+                        "CPU run cannot show overlap: compute and wire "
+                        "time-slice the same core.")
     p.add_argument("--quick", action="store_true",
                    help="seconds-scale smoke defaults (slp-mnist, 3 steps); "
                         "explicit flags still win")
@@ -57,85 +77,146 @@ def main(argv=None) -> dict:
     import optax
 
     from kungfu_tpu.models.fake import fake_model_sizes
-    from kungfu_tpu.optimizers.async_sgd import PairAveragingOptimizer
+    from kungfu_tpu.optimizers.async_sgd import (
+        AsyncPairAveragingOptimizer,
+        PairAveragingOptimizer,
+    )
     from kungfu_tpu.peer import Peer
     from kungfu_tpu.plan import Cluster, PeerList
     from kungfu_tpu.utils.envs import Config
 
     n = args.np_workers
-    workers = PeerList.parse(
-        ",".join(f"127.0.0.1:{args.base_port + i}" for i in range(n))
-    )
-    cluster = Cluster(PeerList.parse("127.0.0.1:38097"), workers)
-    peers = [Peer(Config(self_id=w, cluster=cluster)) for w in workers]
-    for peer in peers:
-        peer.start()
-
     sizes = fake_model_sizes(args.model)
     nbytes = 4 * sum(sizes)
     params0 = {"buf": jnp.zeros(sum(sizes), jnp.float32)}
 
-    def worker(peer):
-        opt = PairAveragingOptimizer(
-            optax.sgd(0.01), peer, name="bench", selector="roundrobin"
+    def run_mode(mode: str, base_port: int) -> dict:
+        workers = PeerList.parse(
+            ",".join(f"127.0.0.1:{base_port + i}" for i in range(n))
         )
-        params = params0
-        state = opt.init(params)
-        grads = {"buf": jnp.ones(sum(sizes), jnp.float32) * 1e-3}
-        for _ in range(args.warmup):
-            params, state = opt.step(params, grads, state)
-        opt.pull_seconds = 0.0
-        opt.pull_bytes = 0
-        t0 = time.perf_counter()
-        for _ in range(args.steps):
-            params, state = opt.step(params, grads, state)
-        return (args.steps / (time.perf_counter() - t0),
-                opt.pull_seconds, opt.pull_bytes)
-
-    outs = [None] * n
-    errs = []
-
-    def run(i):
-        try:
-            outs[i] = worker(peers[i])
-        except BaseException as e:  # noqa: BLE001
-            errs.append(e)
-
-    ts = [threading.Thread(target=run, args=(i,), daemon=True) for i in range(n)]
-    for t in ts:
-        t.start()
-    # shared deadline: a hung gossip pull fails the harness after ~600 s
-    # total, not 600 s per thread — and loudly, not as a None result
-    deadline = time.monotonic() + 600
-    for t in ts:
-        t.join(max(0.0, deadline - time.monotonic()))
-    hung = [i for i, t in enumerate(ts) if t.is_alive()]
-    if not hung:
+        cluster = Cluster(PeerList.parse("127.0.0.1:38097"), workers)
+        peers = [Peer(Config(self_id=w, cluster=cluster)) for w in workers]
         for peer in peers:
-            peer.close()  # only safe once no worker still uses them
-    if errs:
-        raise errs[0]
-    if hung:
-        raise TimeoutError(f"gossip workers {hung} hung past the deadline")
+            peer.start()
 
-    steps_s = float(np.mean([o[0] for o in outs]))
-    # each step pulls one full model blob (and republishes one)
-    pull_gib_s = steps_s * nbytes / (1 << 30)
-    # the MEASURED pull bandwidth: wall time inside the blob pulls only
-    # (request → buffer filled), not the whole train step
-    tot_s = sum(o[1] for o in outs)
-    tot_b = sum(o[2] for o in outs)
-    measured_gib_s = (tot_b / tot_s / (1 << 30)) if tot_s > 0 else 0.0
+        class _SlowWire:
+            """Peer proxy adding --wire-ms latency to each pull."""
+
+            def __init__(self, inner):
+                self._inner = inner
+
+            def __getattr__(self, k):
+                return getattr(self._inner, k)
+
+            def request_into(self, *a, **kw):
+                time.sleep(args.wire_ms / 1e3)
+                return self._inner.request_into(*a, **kw)
+
+        def worker(peer):
+            if args.wire_ms:
+                peer = _SlowWire(peer)
+            if mode == "async":
+                opt = AsyncPairAveragingOptimizer(
+                    optax.sgd(0.01), peer, name="bench",
+                    selector="roundrobin",
+                )
+            else:
+                opt = PairAveragingOptimizer(
+                    optax.sgd(0.01), peer, name="bench",
+                    selector="roundrobin",
+                )
+            params = params0
+            state = opt.init(params)
+            grads = {"buf": jnp.ones(sum(sizes), jnp.float32) * 1e-3}
+
+            def one_step(params, state):
+                params, state = opt.step(params, grads, state)
+                if args.device_ms:
+                    time.sleep(args.device_ms / 1e3)
+                return params, state
+
+            for _ in range(args.warmup):
+                params, state = one_step(params, state)
+            pull_s0, pull_b0 = opt.pull_seconds, opt.pull_bytes
+            avg0 = opt.averaged_steps
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                params, state = one_step(params, state)
+            wall = time.perf_counter() - t0
+            averaged = opt.averaged_steps - avg0
+            if mode == "async":
+                opt.close()
+            return (args.steps / wall,
+                    opt.pull_seconds - pull_s0,
+                    opt.pull_bytes - pull_b0,
+                    averaged)
+
+        outs = [None] * n
+        errs = []
+
+        def run(i):
+            try:
+                outs[i] = worker(peers[i])
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=run, args=(i,), daemon=True)
+              for i in range(n)]
+        for t in ts:
+            t.start()
+        # shared deadline: a hung gossip pull fails the harness after
+        # ~600 s total, not 600 s per thread — and loudly, not as a None
+        deadline = time.monotonic() + 600
+        for t in ts:
+            t.join(max(0.0, deadline - time.monotonic()))
+        hung = [i for i, t in enumerate(ts) if t.is_alive()]
+        if not hung:
+            for peer in peers:
+                peer.close()  # only safe once no worker still uses them
+        if errs:
+            raise errs[0]
+        if hung:
+            raise TimeoutError(f"gossip workers {hung} hung past the deadline")
+
+        steps_s = float(np.mean([o[0] for o in outs]))
+        # per-step blob traffic implied by the step rate (one pull + one
+        # republish each step in blocking mode)
+        pull_gib_s = steps_s * nbytes / (1 << 30)
+        # the MEASURED pull bandwidth: wall time inside the blob pulls
+        # only (request → buffer filled), not the whole train step
+        tot_s = sum(o[1] for o in outs)
+        tot_b = sum(o[2] for o in outs)
+        measured_gib_s = (tot_b / tot_s / (1 << 30)) if tot_s > 0 else 0.0
+        return {
+            "steps_per_sec": round(steps_s, 3),
+            "pull_bandwidth_gib_s": round(pull_gib_s, 3),
+            "pull_gib_s_measured": round(measured_gib_s, 3),
+            "averaged_step_frac": round(
+                float(np.mean([o[3] for o in outs])) / args.steps, 3),
+        }
+
+    modes = ["blocking", "async"] if args.mode == "both" else [args.mode]
+    per_mode = {}
+    for i, mode in enumerate(modes):
+        per_mode[mode] = run_mode(mode, args.base_port + 100 * i)
+
+    primary = per_mode.get("async") or per_mode[modes[0]]
     result = {
         "metric": "pair_averaging_gossip_steps_per_sec",
-        "value": round(steps_s, 3),
+        "value": primary["steps_per_sec"],
         "unit": "steps/sec/peer",
         "np": n,
+        "mode": args.mode,
         "model": args.model,
         "model_mib": round(nbytes / (1 << 20), 1),
-        "pull_bandwidth_gib_s": round(pull_gib_s, 3),
-        "pull_gib_s_measured": round(measured_gib_s, 3),
+        **{k: v for k, v in primary.items() if k != "steps_per_sec"},
     }
+    if len(per_mode) == 2:
+        result["blocking_steps_per_sec"] = per_mode["blocking"]["steps_per_sec"]
+        result["async_steps_per_sec"] = per_mode["async"]["steps_per_sec"]
+        result["async_speedup"] = round(
+            per_mode["async"]["steps_per_sec"]
+            / max(per_mode["blocking"]["steps_per_sec"], 1e-9), 3)
     print(json.dumps(result))
     return result
 

@@ -30,6 +30,10 @@ def main() -> int:
     ap.add_argument("--steps", type=int, default=40)
     ap.add_argument("--dim", type=int, default=16)
     ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--optimizer", choices=["pair-avg", "async"],
+                    default="pair-avg",
+                    help="async = AsyncPairAveraging: background puller, "
+                         "step averages with the last landed model")
     ns = ap.parse_args()
 
     import jax
@@ -40,7 +44,10 @@ def main() -> int:
     import optax
 
     import kungfu_tpu as kf
-    from kungfu_tpu.optimizers.async_sgd import PairAveragingOptimizer
+    from kungfu_tpu.optimizers.async_sgd import (
+        AsyncPairAveragingOptimizer,
+        PairAveragingOptimizer,
+    )
 
     peer = kf.init()
     rank, size = kf.current_rank(), kf.cluster_size()
@@ -57,12 +64,15 @@ def main() -> int:
         return jnp.mean((X @ p["w"] - Y) ** 2)
 
     grad = jax.jit(jax.grad(loss_fn))
-    opt = PairAveragingOptimizer(optax.sgd(ns.lr), peer, name="gt",
-                                 selector="roundrobin")
+    cls = (AsyncPairAveragingOptimizer if ns.optimizer == "async"
+           else PairAveragingOptimizer)
+    opt = cls(optax.sgd(ns.lr), peer, name="gt", selector="roundrobin")
     params = {"w": jnp.zeros((ns.dim, 1), jnp.float32)}
     state = opt.init(params)
     for _ in range(ns.steps):
         params, state = opt.step(params, grad(params), state)
+    if ns.optimizer == "async":
+        opt.close()
     # the faster worker must not close its peer while a slower one is
     # still pulling from its store (cf. benchmarks/gossip.py's
     # close-after-all-workers-join guard)

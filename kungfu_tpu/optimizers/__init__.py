@@ -17,6 +17,11 @@ async C++ ops — on TPU the collective is part of the compiled program):
   model from its versioned store over the host channel, average 0.5/0.5,
   apply local gradients, publish (reference ``async_sgd.py:71-142``).
   Deliberately *not* a collective — host-side p2p.
+* :class:`AsyncPairAveragingOptimizer` — same algorithm with the pull
+  moved off the critical path: a background thread keeps a
+  triple-buffered registered receive in flight; the step averages with
+  the last *landed* model (reference ``AsyncModelAveraging`` /
+  ``AsyncRequestModel``, ``peer_to_peer.cpp:156-258,411-466``).
 * :func:`monitor_gradient_noise_scale` / :func:`monitor_gradient_variance`
   — S-SGD plus in-graph training statistics (reference
   ``grad_noise_scale.py``, ``grad_variance.py``).
@@ -29,7 +34,10 @@ over that mesh.
 from kungfu_tpu.optimizers.sync_sgd import synchronous_sgd
 from kungfu_tpu.optimizers.sma_sgd import synchronous_averaging
 from kungfu_tpu.optimizers.ada_sgd import adaptive_sgd
-from kungfu_tpu.optimizers.async_sgd import PairAveragingOptimizer
+from kungfu_tpu.optimizers.async_sgd import (
+    AsyncPairAveragingOptimizer,
+    PairAveragingOptimizer,
+)
 from kungfu_tpu.optimizers.monitors import (
     monitor_gradient_noise_scale,
     monitor_gradient_variance,
@@ -40,6 +48,7 @@ __all__ = [
     "synchronous_averaging",
     "adaptive_sgd",
     "PairAveragingOptimizer",
+    "AsyncPairAveragingOptimizer",
     "monitor_gradient_noise_scale",
     "monitor_gradient_variance",
 ]

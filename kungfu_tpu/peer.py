@@ -109,8 +109,8 @@ class Peer:
                 )
                 from kungfu_tpu.store import install_p2p_handler
 
-                install_p2p_handler(self._channel, self.store,
-                                    self._ctrl_store)
+                self._p2p_stop = install_p2p_handler(
+                    self._channel, self.store, self._ctrl_store)
             if self.config.coordinator and self.config.num_processes > 1:
                 self._init_jax_distributed()
             from kungfu_tpu.utils.affinity import bind_local_rank
@@ -253,6 +253,9 @@ class Peer:
         with self._lock:
             if self._channel is not None:
                 self._notify_done()
+                if getattr(self, "_p2p_stop", None) is not None:
+                    self._p2p_stop()
+                    self._p2p_stop = None
                 self._channel.close()
                 self._channel = None
             if self._metrics_server is not None:

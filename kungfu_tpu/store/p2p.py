@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import queue
 import threading
 from typing import Optional
 
@@ -31,9 +32,20 @@ def install_p2p_handler(channel: HostChannel, store=None,
     process-global store).  Names under the reserved ``kf.`` prefix are
     served from ``control_store`` instead — control-plane blobs (e.g. the
     device-strategy epoch record) must not share an eviction window with
-    gossip model traffic, whose per-step versions would push them out."""
+    gossip model traffic, whose per-step versions would push them out.
 
-    def handle(name: str, payload: bytes, src: str):
+    Serving happens on a dedicated responder thread, NEVER on the
+    channel's receive path: a ~100 MiB model reply blocks on TCP
+    backpressure, and if the stream thread is the one writing it, it
+    stops draining its own socket — with two peers pulling from each
+    other continuously (async gossip), that deadlocks both directions
+    until a timeout.  One responder thread per endpoint also matches the
+    reference, which answers ``Request`` from its own goroutine, not the
+    connection reader (``rchannel/handler/p2p.go:36-47``)."""
+
+    serve_q: "queue.Queue" = queue.Queue()
+
+    def serve(name: str, payload: bytes, src: str):
         # name = "req.<id>"; payload = json {"name":..., "version":...,
         # "raw": 0|1}
         req_id = name[len("req."):]
@@ -70,7 +82,32 @@ def install_p2p_handler(channel: HostChannel, store=None,
         except ConnectionError as e:
             _log.warning("cannot answer %s: %s", src, e)
 
+    def responder():
+        while True:
+            item = serve_q.get()
+            if item is None:
+                return
+            try:
+                serve(*item)
+            except Exception as e:  # noqa: BLE001 — keep serving
+                _log.warning("p2p serve failed: %s", e)
+
+    t = threading.Thread(target=responder, name="kf-p2p-responder",
+                         daemon=True)
+    t.start()
+
+    def handle(name: str, payload: bytes, src: str):
+        # runs on the channel's receive path — hand off and return so the
+        # stream keeps draining
+        serve_q.put((name, payload, src))
+
     channel.on_p2p_request(handle)
+
+    def stop(join_timeout: float = 5.0):
+        serve_q.put(None)
+        t.join(join_timeout)
+
+    return stop
 
 
 def _serve_locally(peer, target: PeerID, name: str, version: Optional[str]):

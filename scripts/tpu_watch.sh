@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Poll the relay tunnel; the moment device enumeration works, fire the
+# measurement backlog (scripts/tpu_backlog.sh) exactly once.
+#
+#   bash scripts/tpu_watch.sh [interval_s] [outdir]
+set -u
+cd "$(dirname "$0")/.."
+INTERVAL="${1:-600}"
+OUT="${2:-/tmp/tpu_backlog}"
+log() { echo "[tpu-watch $(date +%H:%M:%S)] $*"; }
+
+while true; do
+  if timeout 120 python - <<'EOF' 2>/dev/null
+import jax
+ds = jax.devices()
+assert ds and ds[0].platform == "tpu", ds
+EOF
+  then
+    log "tunnel ALIVE — running backlog into $OUT"
+    bash scripts/tpu_backlog.sh "$OUT"
+    log "backlog complete"
+    exit 0
+  fi
+  log "tunnel dead; sleeping ${INTERVAL}s"
+  sleep "$INTERVAL"
+done

@@ -306,3 +306,234 @@ class TestPairAveraging:
             for p in peers:
                 p.close()
             reset_local_store()
+
+
+class _FakePullPeer:
+    """Drives _ModelPuller without a wire: request_into fills the buffer
+    with an incrementing fill value, or misses when told to."""
+
+    def __init__(self):
+        self.pulls = 0
+        self.miss = False
+        self.delay = 0.0
+
+    def request_into(self, target, name, buf, version=None, timeout=None):
+        import time
+
+        if self.delay:
+            time.sleep(self.delay)
+        if self.miss:
+            return None
+        self.pulls += 1
+        buf[:] = float(self.pulls)
+        return buf
+
+
+class TestAsyncPairAveraging:
+    def _puller(self, peer, **kw):
+        from kungfu_tpu.optimizers.async_sgd import _ModelPuller
+
+        kw.setdefault("min_interval", 0.0)
+        return _ModelPuller(peer, "m", np.dtype(np.float32), 8,
+                            lambda: 1, **kw)
+
+    def test_puller_lands_and_reuses(self):
+        import time
+
+        peer = _FakePullPeer()
+        p = self._puller(peer, min_interval=60.0)  # exactly one landing
+        p.start()
+        try:
+            assert p.wait_landed(5.0)
+            buf, seq = p.take()
+            assert seq == 1
+            np.testing.assert_allclose(buf, 1.0)
+            # no new landing: take() reuses the same model + seq
+            buf2, seq2 = p.take()
+            assert seq2 == 1 and buf2 is buf
+        finally:
+            p.close()
+        assert not p.is_alive()
+
+    def test_puller_freshest_wins(self):
+        import time
+
+        peer = _FakePullPeer()
+        p = self._puller(peer)
+        p.start()
+        try:
+            assert p.wait_landed(5.0)
+            deadline = time.monotonic() + 5.0
+            while peer.pulls < 5 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            buf, seq = p.take()
+            assert seq >= 2  # skipped straight to the freshest landing
+            later_buf, later_seq = p.take()
+            assert later_seq >= seq
+        finally:
+            p.close()
+
+    def test_puller_miss_path(self):
+        peer = _FakePullPeer()
+        peer.miss = True
+        p = self._puller(peer)
+        p.start()
+        try:
+            assert not p.wait_landed(0.3)
+            assert p.take() is None
+            assert p.misses > 0
+        finally:
+            p.close()
+        assert not p.is_alive()
+
+    def test_puller_teardown_with_slow_wire(self):
+        """close() returns promptly even with a pull in flight."""
+        import time
+
+        peer = _FakePullPeer()
+        peer.delay = 0.5
+        p = self._puller(peer, pull_timeout=1.0)
+        p.start()
+        t0 = time.monotonic()
+        p.close()
+        assert time.monotonic() - t0 < 5.0
+        assert not p.is_alive()
+
+    def test_two_peer_async_gossip_averaging(self):
+        """Real TCP channels: the background pull lands and the step
+        averages with it off the critical path."""
+        from kungfu_tpu.optimizers import AsyncPairAveragingOptimizer
+        from kungfu_tpu.peer import Peer
+        from kungfu_tpu.plan import Cluster, PeerList
+        from kungfu_tpu.store.store import reset_local_store
+        from kungfu_tpu.utils.envs import Config
+
+        reset_local_store()
+        workers = PeerList.parse("127.0.0.1:24011,127.0.0.1:24012")
+        runners = PeerList.parse("127.0.0.1:38082")
+        cluster = Cluster(runners, workers)
+        peers = [Peer(Config(self_id=workers[i], cluster=cluster))
+                 for i in range(2)]
+        for p in peers:
+            p.start()
+        opts = []
+        try:
+            opts = [AsyncPairAveragingOptimizer(
+                optax.sgd(0.0), peer=p, selector="roundrobin",
+                pull_timeout=10.0) for p in peers]
+            params = [
+                {"w": jnp.zeros(4, jnp.float32)},
+                {"w": jnp.ones(4, jnp.float32) * 2.0},
+            ]
+            import threading
+
+            states = [None, None]
+
+            def init_one(i):
+                states[i] = opts[i].init(params[i])
+
+            ts = [threading.Thread(target=init_one, args=(i,))
+                  for i in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=30)
+            grads = {"w": jnp.zeros(4, jnp.float32)}
+            # first step blocks for the first landing (reference
+            # semantics), so the average is deterministic: 0.5*(0+2)=1
+            params0, _ = opts[0].step(params[0], grads, states[0])
+            np.testing.assert_allclose(np.asarray(params0["w"]),
+                                       np.ones(4), rtol=1e-6)
+            assert opts[0].averaged_steps == 1
+            assert opts[0].pull_bytes >= 16
+        finally:
+            for o in opts:
+                o.close()
+            for p in peers:
+                p.close()
+            reset_local_store()
+
+    def test_staleness_bound_blocks_for_fresh_landing(self):
+        """After max_staleness consumptions of one landing, the step
+        waits (bounded) for a fresh one instead of diverging."""
+        from kungfu_tpu.optimizers.async_sgd import AsyncPairAveragingOptimizer
+
+        opt = AsyncPairAveragingOptimizer.__new__(AsyncPairAveragingOptimizer)
+        # drive only the staleness logic with a hand-built puller
+        peer = _FakePullPeer()
+        from kungfu_tpu.optimizers.async_sgd import _ModelPuller
+
+        p = _ModelPuller(peer, "m", np.dtype(np.float32), 4, lambda: 1,
+                         min_interval=30.0)  # one landing, then silence
+        p.start()
+        try:
+            assert p.wait_landed(5.0)
+            _, seq = p.take()
+            # consume the same landing repeatedly; wait_landed on a silent
+            # wire returns False after the bound, not hang
+            import time
+
+            t0 = time.monotonic()
+            assert not p.wait_landed(0.3)
+            assert time.monotonic() - t0 < 2.0
+        finally:
+            p.close()
+
+    def test_async_step_latency_independent_of_wire(self):
+        """The whole point: with a slow wire, async step wall time stays
+        at compute scale (blocking would pay the wire every step)."""
+        import time
+
+        import optax
+
+        class _FakeGossipPeer:
+            """Just enough peer surface for the optimizer + puller."""
+
+            def __init__(self, wire_s=0.3):
+                self.wire_s = wire_s
+                self.blobs = {}
+
+            def rank(self):
+                return 0
+
+            def size(self):
+                return 2
+
+            def save(self, name, blob, version=None, copy=True):
+                self.blobs[name] = np.asarray(blob).copy()
+
+            def barrier(self):
+                pass
+
+            def request_into(self, target, name, buf, version=None,
+                             timeout=None):
+                time.sleep(self.wire_s)
+                buf[:] = 7.0
+                return buf
+
+        from kungfu_tpu.optimizers.async_sgd import (
+            AsyncPairAveragingOptimizer,
+        )
+
+        peer = _FakeGossipPeer(wire_s=0.3)
+        opt = AsyncPairAveragingOptimizer(optax.sgd(0.0), peer=peer,
+                                          pull_timeout=5.0)
+        params = {"w": jnp.zeros(1024, jnp.float32)}
+        state = opt.init(params)
+        grads = {"w": jnp.zeros(1024, jnp.float32)}
+        # first step blocks for the first landing; time the next 5
+        params, state = opt.step(params, grads, state)
+        try:
+            t0 = time.perf_counter()
+            for _ in range(5):
+                params, state = opt.step(params, grads, state)
+            wall = time.perf_counter() - t0
+            # blocking would cost >= 5 * 0.3s; async stays at compute
+            # scale plus at most one staleness wait
+            assert wall < 1.0, f"async steps paid the wire: {wall:.2f}s"
+            assert opt.averaged_steps + opt.local_steps == 6
+            # the averaged value actually came from the landed model:
+            # step 1 averaged 0 with 7 -> 3.5
+            assert float(np.asarray(params["w"])[0]) > 0.0
+        finally:
+            opt.close()
