@@ -45,6 +45,11 @@ def main() -> int:
                          "(S-SGD over the re-carved Communicator), carrying "
                          "the model across resizes")
     ap.add_argument("--steps-per-epoch", type=int, default=2)
+    ap.add_argument("--resync-root", type=int, default=0,
+                    help="peer rank whose weights win the post-resize "
+                         "re-sync (clamped to the epoch's membership); "
+                         "non-zero exercises the rank->device-slot "
+                         "mapping of the multi-controller broadcast")
     ap.add_argument("--strategy", default="",
                     help="install an allreduce schedule (psum/two_stage/"
                          "ring) on the FIRST mesh epoch; later epochs "
@@ -110,20 +115,21 @@ def main() -> int:
         from kungfu_tpu.parallel.train import dp_train_step
 
         nonlocal params, opt_state, z1_snap
+        rroot = min(ns.resync_root, comm.size - 1)
         if ns.zero1:
-            from kungfu_tpu.parallel import (zero1_restore, zero1_snapshot,
+            from kungfu_tpu.parallel import (zero1_reshard, zero1_snapshot,
                                              zero1_train_step)
 
-            params = resync_parameters(params, peer, comm=comm)
+            params = resync_parameters(params, peer, comm=comm, root=rroot)
             step, init_opt = zero1_train_step(
                 lambda p, b: model.loss(p, b), opt, comm)
             fresh = init_opt(params)
-            # joiners pass snapshot=None and receive rank 0's over the
-            # host channel; the fresh init supplies structure + the new
-            # chunk geometry
+            # ONE reshard entry point: rank 0 passes the pre-resize
+            # snapshot, joiners pass None and receive it over the host
+            # channel; `fresh` supplies the state structure
             opt_state = (fresh if v == 0
-                         else zero1_restore(z1_snap, fresh, params, peer,
-                                            new_comm=comm))
+                         else zero1_reshard(fresh, params, comm, peer,
+                                            snapshot=z1_snap))
         else:
             tx = synchronous_sgd(opt, comm.axis)
             step = dp_train_step(
@@ -135,7 +141,7 @@ def main() -> int:
             local_state = (opt_state if opt_state is not None
                            else tx.init(params))
             params, opt_state = resync_parameters(
-                (params, local_state), peer, comm=comm
+                (params, local_state), peer, comm=comm, root=rroot
             )
         # FIXED seed: every epoch replays the same global batch sequence,
         # so a changing loss across epochs proves the weights carried over

@@ -404,17 +404,25 @@ class Communicator:
 
         spec = self._spec_in()
 
-        mesh_devs = set(self.mesh.devices.flat)
+        # the legitimate pass-through layout is an array on THIS
+        # process's slice of the mesh: host_local_array_to_global_array
+        # lifts exactly that.  (Comparing against the FULL mesh device
+        # set could never match a host-local slice in multi-controller
+        # mode — every eager input then paid a numpy materialization —
+        # and the one layout it did match, a fully-global array, is the
+        # input the lift would mis-handle.)
+        local_mesh_devs = set(self.mesh.local_devices)
 
         def wrapped(a):
-            # jax arrays already laid out over THIS mesh pass through
-            # (layout-only resharding); anything else — host data, or an
-            # array committed to other devices (e.g. the process-default
-            # device), which host_local_array_to_global_array would
-            # mis-lift — pays a numpy materialization of the local slice
+            # jax arrays already on this process's mesh devices pass
+            # through (layout-only resharding); anything else — host
+            # data, or an array committed elsewhere (the process-default
+            # device, or an already-global array) — pays a numpy
+            # materialization of the local slice, which also rejects
+            # non-addressable inputs loudly
             local = (
                 a if isinstance(a, jax.Array)
-                and a.sharding.device_set == mesh_devs
+                and a.sharding.device_set <= local_mesh_devs
                 else np.asarray(a)
             )
             g = mh.host_local_array_to_global_array(local, self.mesh, spec)
@@ -532,6 +540,50 @@ class Communicator:
             return self._cached(key, build)(a)
 
         return jax.tree_util.tree_map(leaf, x)
+
+    def first_slot_of_process(self, proc: int) -> int:
+        """First flat device slot owned by jax process ``proc`` — the
+        slot a :meth:`broadcast` roots on to broadcast *that process's*
+        value (the mesh is carved in worker-rank order, so a worker's
+        devices are contiguous in flat-slot order)."""
+        for i, d in enumerate(self.mesh.devices.ravel()):
+            if d.process_index == proc:
+                return i
+        raise ValueError(
+            f"process {proc} owns no device in this communicator")
+
+    def broadcast_value(self, value, root_slot: int = 0):
+        """Broadcast ONE host value from ``root_slot``'s process without
+        the stacked eager convention: every process passes its own
+        ``value`` (ignored unless it owns the root slot) and receives the
+        root's as numpy.  Unlike ``broadcast(np.broadcast_to(v, (n,)+...))``
+        this never materializes n stacked model copies in host RAM — each
+        local device gets the single row by runtime ``device_put`` and the
+        global array is assembled shard-wise (used by the post-resize
+        parameter re-sync, where ``value`` is a full fused model)."""
+        a = np.asarray(value)
+        if not 0 <= root_slot < self._n:
+            raise ValueError(f"root {root_slot} out of range [0, {self._n})")
+        key = ("bcv", root_slot, a.shape, a.dtype.name)
+        fn = self._fns.get(key)
+        if fn is None:
+            def body(s):
+                contrib = jnp.where(_flat_index() == root_slot, s,
+                                    jnp.zeros_like(s))
+                return jax.lax.psum(contrib, GLOBAL_AXES)
+
+            # deliberately NOT _cached(): no host-local wrap — the global
+            # array is assembled here, one row per addressable device
+            fn = self._shard_jit(body)
+            self._fns[key] = fn
+        pi = jax.process_index() if self._multiproc else None
+        local_devs = [d for d in self.mesh.devices.ravel()
+                      if pi is None or d.process_index == pi]
+        rows = [jax.device_put(a[None], d) for d in local_devs]
+        g = jax.make_array_from_single_device_arrays(
+            (self._n,) + a.shape, self.data_sharding(), rows)
+        out = fn(g)
+        return np.asarray(out.addressable_shards[0].data)[0]
 
     def all_gather(self, x):
         """out[i] = stack_j x[j] — every peer sees all slices; eager result

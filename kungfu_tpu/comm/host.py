@@ -667,19 +667,32 @@ class NativeHostChannel(_ChannelOps):
         handle = t.recv_begin(s, name, ct, buf)
 
         class _Posted:
-            # the native handle is consumed by finish/abort — single shot
-            _h = handle
+            # the native handle is consumed by finish/abort — single shot.
+            # _buf pins the destination: the C++ stream thread writes into
+            # it until finish/abort resolves, so the registration must
+            # keep the buffer alive even if the caller drops their
+            # reference first (use-after-free otherwise).  INSTANCE
+            # attributes — a class-level `_buf` would only be shadowed by
+            # the release assignment, keeping the buffer pinned for the
+            # handle's whole lifetime
+            def __init__(self):
+                self._h = handle
+                self._buf = buf
 
             def wait(self, timeout: Optional[float] = 60.0) -> bool:
                 if self._h is None:  # mismatching payload already queued
                     return False
                 h, self._h = self._h, None
-                return t.recv_finish(s, name, ct, timeout, h)
+                try:
+                    return t.recv_finish(s, name, ct, timeout, h)
+                finally:
+                    self._buf = None
 
             def abort(self) -> None:
                 if self._h is not None:
                     h, self._h = self._h, None
                     t.recv_abort(s, name, ct, h)
+                    self._buf = None
 
         return _Posted()
 

@@ -92,6 +92,9 @@ class ConfigServer:
                 self._reply(200, b"{}")
 
         self._server = ThreadingHTTPServer((host, port), Handler)
+        # port=0 asks the kernel for an ephemeral port — reflect the
+        # actual binding so .url works
+        self.port = self._server.server_address[1]
         self._server.daemon_threads = True
 
     @property

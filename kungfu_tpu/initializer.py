@@ -100,22 +100,25 @@ def resync_parameters(params, peer=None, comm=None, root: int = 0):
 
     # multi-controller: the joiners' stale values must be overwritten by
     # root's over the mesh — a compiled broadcast, amortized per epoch.
-    # The eager stacked convention wants the HOST-LOCAL slice as numpy
-    # (a committed jax array would be mis-lifted by the host-local wrap).
-    if root != 0:
-        # Communicator.broadcast roots on a flat DEVICE slot; mapping a
-        # peer rank to its device slot needs the per-process device
-        # counts, which the communicator does not track.  Every current
-        # caller resyncs from rank 0, where the two coincide.
-        raise NotImplementedError(
-            "multi-controller resync_parameters supports root=0 only"
-        )
+    # broadcast_value sends ONE fused row per process (each local device
+    # gets it by runtime device_put), so a resize costs 1x model host RAM,
+    # not the (n_local+1)x of stacking the eager collective convention.
+    # Peer rank -> device slot: the mesh is carved in worker-rank order,
+    # so the root worker's jax process (its provisioned world slot when a
+    # world exists, its spawn rank otherwise) owns a contiguous run of
+    # flat slots starting at first_slot_of_process.
+    root_proc = root
+    if peer is not None:
+        world = getattr(peer.config, "world_peers", None)
+        if world is not None:
+            wr = world.rank(peer.cluster.workers[root])
+            if wr is None:
+                raise ValueError(
+                    f"resync root {root} is outside the provisioned world")
+            root_proc = wr
     buf, spec = fuse(params, dtype=jnp.float32)
-    n = comm.addressable_n
-    stacked = np.ascontiguousarray(
-        np.broadcast_to(np.asarray(buf)[None], (n,) + buf.shape)
-    )
-    out = np.asarray(comm.broadcast(stacked, root=root))[0]
+    out = comm.broadcast_value(
+        np.asarray(buf), comm.first_slot_of_process(root_proc))
     sh = comm.replicated_sharding()
     synced = defuse(jnp.asarray(out), spec)
     return jax.tree_util.tree_map(

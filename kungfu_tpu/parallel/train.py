@@ -79,8 +79,8 @@ class ShardedTrainer:
         capacity_factor: float = 1.25,
         schedule: str = "psum",
     ):
-        if cfg.pos != "rope":
-            raise NotImplementedError("sharded trainer supports rope positions")
+        if cfg.pos not in ("rope", "learned"):
+            raise ValueError(f"unknown position mode {cfg.pos!r}")
         if cfg.n_layers % plan.pp:
             raise ValueError(f"n_layers {cfg.n_layers} % pp {plan.pp} != 0")
         if cfg.n_heads % plan.tp:
@@ -146,6 +146,8 @@ class ShardedTrainer:
             "ln_f": {"scale": (P(None), "replicated"), "bias": (P(None), "replicated")},
             "head": {"w": (P(None, None), "replicated")},
         }
+        if cfg.pos == "learned":
+            tree["pos_embed"] = {"table": (P(None, None), "replicated")}
         is_leaf = lambda x: isinstance(x, tuple) and len(x) == 2 and isinstance(x[1], str)
         specs = jax.tree_util.tree_map(lambda t: t[0], tree, is_leaf=is_leaf)
         kinds = jax.tree_util.tree_map(lambda t: t[1], tree, is_leaf=is_leaf)
@@ -157,6 +159,9 @@ class ShardedTrainer:
         params: Dict[str, Any] = {}
         key, k = jax.random.split(key)
         params["embed"] = nn.embedding_init(k, cfg.vocab_size, cfg.d_model)
+        if cfg.pos == "learned":
+            key, k = jax.random.split(key)
+            params["pos_embed"] = nn.embedding_init(k, cfg.max_seq, cfg.d_model)
         per_layer = []
         for _ in range(cfg.n_layers):
             key, *ks = jax.random.split(key, 8)
@@ -208,6 +213,8 @@ class ShardedTrainer:
             "ln_f": tparams["ln_f"],
             "head": tparams["head"],
         }
+        if self.cfg.pos == "learned":
+            stacked["pos_embed"] = tparams["pos_embed"]
         return self.shard_params(stacked)
 
     # -- the per-device math ----------------------------------------------
@@ -229,7 +236,8 @@ class ShardedTrainer:
             return t.reshape(B, S, H_loc, cfg.head_dim).transpose(0, 2, 1, 3)
 
         q, k, v = heads(q), heads(k), heads(v)
-        q, k = _rope(q, k, positions)
+        if cfg.pos == "rope":
+            q, k = _rope(q, k, positions)
         o = ring_attention(q, k, v, causal=cfg.causal, axis=AXIS_SP)
         B, _, S, _ = o.shape
         o = o.transpose(0, 2, 1, 3).reshape(B, S, H_loc * cfg.head_dim)
@@ -270,6 +278,12 @@ class ShardedTrainer:
         ids_mb = ids.reshape(n_micro, B_mb, S_loc)
         tgt_mb = targets.reshape(n_micro, B_mb, S_loc)
         h0 = nn.embedding_apply(lparams["embed"], ids_mb, dtype=cfg.compute_dtype)
+        if cfg.pos == "learned":
+            # positions carry the sp-global offsets, so the learned table
+            # lookup is shard-correct under sequence parallelism too
+            pe = nn.embedding_apply(lparams["pos_embed"], positions,
+                                    dtype=cfg.compute_dtype)
+            h0 = h0 + pe[None]
 
         T = n_micro + Pp - 1
         if T > n_micro:

@@ -194,7 +194,7 @@ def zero1_train_step(loss_fn, inner: optax.GradientTransformation, comm,
     return step, init_opt
 
 
-def zero1_reshard(opt_shard, params, new_comm):
+def zero1_reshard(opt_shard, params, new_comm, peer=None, snapshot=None):
     """Re-place a ZeRO-1 optimizer shard onto a NEW mesh epoch.
 
     The sharded state's geometry (chunk = ceil(total/n), mesh-major
@@ -208,24 +208,43 @@ def zero1_reshard(opt_shard, params, new_comm):
     at the new size — the same guarantee the elementwise-equivalence of
     the step itself gives.
 
-    Single-controller meshes only (the simulated-peer and single-host
-    cases): a multi-controller elastic jump additionally needs a
-    host-plane gather/broadcast of the state — joiners hold none of it
-    — which is the params-resync path (`initializer.resync_parameters`)
-    generalized; raise rather than silently mis-shard there.
+    Two modes:
+
+    * **Single-controller** (simulated peers / one host), no
+      ``snapshot``: every old chunk is addressable — direct runtime
+      re-placement, no host channel involved.
+    * **Multi-controller** (or an explicit ``snapshot``): the old
+      chunks live in other processes — some of which a shrink just
+      retired — so the state must have been captured with
+      :func:`zero1_snapshot` over the OLD epoch's membership *before*
+      the resize (rank 0 holds the blob; the chunk owners may no longer
+      be reachable afterwards).  Rank 0 passes it as ``snapshot``;
+      everyone else passes ``None`` and receives it over ``peer``'s
+      host channel.  ``opt_shard`` supplies only the state STRUCTURE
+      here (a joiner passes its fresh ``init_opt(params)``) — vector
+      geometry is synthesized for the new mesh, values come from the
+      snapshot.  This folds the former snapshot→restore detour under
+      the one reshard entry point (reference elastic-state contract:
+      ``peer/peer.go:236-276``).
     """
     from jax.sharding import NamedSharding
 
-    if new_comm._multiproc:
-        raise NotImplementedError(
-            "zero1_reshard on a multi-controller mesh needs a host-plane "
-            "state gather/broadcast; single-controller meshes only"
-        )
     total = int(np.sum([int(np.prod(l.shape)) for l in
                         jax.tree_util.tree_leaves(params)]))
     n = new_comm.size
     chunk = math.ceil(total / n)
     padded = chunk * n
+
+    if new_comm._multiproc or snapshot is not None:
+        # host-plane path: structure from opt_shard, geometry synthesized
+        # for the new mesh, values from the (broadcast) snapshot
+        fresh = jax.tree_util.tree_map(
+            lambda a: (a if getattr(a, "ndim", 0) == 0
+                       else jax.ShapeDtypeStruct((padded,), a.dtype)),
+            opt_shard,
+        )
+        return zero1_restore(snapshot, fresh, params, peer, new_comm)
+
     sharded = NamedSharding(new_comm.mesh, P(new_comm.axis))
     replicated = new_comm.replicated_sharding()
 

@@ -11,9 +11,11 @@ workers have exited.
 from __future__ import annotations
 
 import json
+import os
 import queue
 import time
-from typing import Dict, Set
+import urllib.request
+from typing import Dict, Optional, Set
 
 from kungfu_tpu.comm.host import ConnType, bind_own_host_channel
 from kungfu_tpu.plan.cluster import Cluster
@@ -24,6 +26,24 @@ from kungfu_tpu.runner.proc import kill_group, start_proc
 from kungfu_tpu.utils.log import get_logger
 
 _log = get_logger("watch")
+
+#: natural-end grace window (seconds, ``KF_CONFIG_WATCH_GRACE``): how
+#: long a runner whose local workers all exited cleanly waits for an
+#: in-flight resize stage before concluding the job is over
+WATCH_GRACE_ENV = "KF_CONFIG_WATCH_GRACE"
+DEFAULT_WATCH_GRACE_S = 10.0
+
+
+def _config_server_version(url: str, timeout: float = 3.0) -> Optional[int]:
+    """The config server's current cluster version, or None when it
+    cannot be reached (no server configured / transient outage)."""
+    if not url:
+        return None
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return int(json.loads(resp.read().decode())["version"])
+    except (OSError, ValueError, KeyError):
+        return None
 
 
 def watch_run(ns, cluster: Cluster, job: Job) -> int:
@@ -103,12 +123,31 @@ def watch_run(ns, cluster: Cluster, job: Job) -> int:
                 if current.workers.on_host(self_host):
                     # natural end — but a shrink's detached workers can
                     # exit BEFORE rank 0's "update" for that stage reaches
-                    # us; give an in-flight stage a grace window before
-                    # concluding the job is over
+                    # us (rank 0 may sit in compile/re-sync for a while
+                    # before _notify_runners); give an in-flight stage a
+                    # grace window, and when the window expires confirm
+                    # against the config server: a version ahead of ours
+                    # means a stage IS coming — keep serving, or this
+                    # host is orphaned for every later re-grow
                     if natural_end_at is None:
-                        natural_end_at = time.monotonic() + 3.0
+                        grace = float(os.environ.get(
+                            WATCH_GRACE_ENV, DEFAULT_WATCH_GRACE_S))
+                        natural_end_at = time.monotonic() + grace
                     elif time.monotonic() >= natural_end_at:
-                        break
+                        # job.config_server carries the RESOLVED URL in
+                        # builtin-config-server mode, where
+                        # ns.config_server stays empty
+                        cs_ver = _config_server_version(
+                            getattr(job, "config_server", "")
+                            or getattr(ns, "config_server", ""))
+                        if cs_ver is not None and cs_ver != version:
+                            _log.info(
+                                "config server at v%d, we applied v%d — "
+                                "stage in flight, extending grace",
+                                cs_ver, version)
+                            natural_end_at = None
+                        else:
+                            break
             else:
                 natural_end_at = None
             # poll membership updates

@@ -162,7 +162,17 @@ def remote_request_into(
     channel = peer.channel
     local, blob = _serve_locally(peer, target, name, version)
     if local:
-        return blob
+        if blob is None:
+            return None
+        # honor the 'buf when filled' contract on the local path too: the
+        # store may hold a copy=False non-bytes view whose owner keeps
+        # mutating it — callers must get their own buffer, not an alias
+        src = memoryview(blob)
+        dst = memoryview(buf)
+        if src.nbytes == dst.nbytes:
+            dst.cast("B")[:] = src.cast("B")
+            return buf
+        return bytes(src)  # size mismatch: raw bytes, like the wire path
     req_id = f"{peer.config.self_id.port}-{next(_req_counter)}"
     body = json.dumps(
         {"name": name, "version": version or "", "raw": 1}

@@ -349,3 +349,31 @@ class TestFuse:
         assert buf.shape == (N, 11)
         out = defuse(buf, spec, batch_axes=1)
         assert out[0].shape == (N, 2, 3)
+
+
+class TestBroadcastValue:
+    def test_broadcast_value_roots_on_slot(self):
+        """broadcast_value sends ONE host row (no stacked (n, ...) input)
+        and returns the root slot's value on every process."""
+        from kungfu_tpu.comm.device import Communicator
+
+        devs = jax.devices()
+        comm = Communicator(devices=devs[:4], local_size=2)
+        v = np.arange(6, dtype=np.float32)
+        # single-controller: every slot's "own" value is the same passed
+        # array, so any root returns it — exactness is the contract
+        for root in (0, 3):
+            out = comm.broadcast_value(v, root_slot=root)
+            np.testing.assert_array_equal(out, v)
+        with pytest.raises(ValueError):
+            comm.broadcast_value(v, root_slot=4)
+
+    def test_first_slot_of_process(self):
+        from kungfu_tpu.comm.device import Communicator
+
+        devs = jax.devices()
+        comm = Communicator(devices=devs[:4], local_size=2)
+        # single-controller CPU world: all devices belong to process 0
+        assert comm.first_slot_of_process(0) == 0
+        with pytest.raises(ValueError):
+            comm.first_slot_of_process(99)

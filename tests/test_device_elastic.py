@@ -283,7 +283,7 @@ class TestLiveResize:
              "-np", "2", "-H", "127.0.0.1:4", "-w", "-device-world",
              "-builtin-config-port", "9312", "-logdir", logdir, "-q",
              sys.executable, "examples/device_elastic.py",
-             "--", "--schedule", "2,4,2", "--train"],
+             "--", "--schedule", "2,4,2", "--train", "--resync-root", "1"],
             cwd=REPO, capture_output=True, text=True, timeout=420, env=env,
         )
         assert r.returncode == 0, r.stdout + r.stderr

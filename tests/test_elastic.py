@@ -145,3 +145,35 @@ class TestElasticCLI:
         r = self._run("2:4,1:4", 2, 29126)
         assert r.returncode == 0, r.stdout + r.stderr
         assert "sizes seen [1, 2]" in r.stdout
+
+
+def test_watch_natural_end_probes_config_server():
+    """The natural-end grace check asks the config server whether a
+    resize stage is in flight (version ahead of the runner's) before
+    concluding the job ended — a runner exiting early orphans its host
+    for every later re-grow."""
+    from kungfu_tpu.elastic.configserver import ConfigServer
+    from kungfu_tpu.plan import Cluster, PeerList
+    from kungfu_tpu.runner.watch import _config_server_version
+
+    cluster = Cluster(PeerList.parse("127.0.0.1:38071"),
+                      PeerList.parse("127.0.0.1:24061"))
+    srv = ConfigServer(port=0, cluster=cluster).start()
+    try:
+        url = srv.url
+        assert _config_server_version(url) == 0
+        # a PUT bumps the version: the runner (still at v0) must see it
+        import json as _json
+        import urllib.request
+
+        req = urllib.request.Request(
+            url.replace("/get", "/put"),
+            data=cluster.to_json().encode(), method="PUT")
+        with urllib.request.urlopen(req, timeout=5):
+            pass
+        assert _config_server_version(url) == 1
+    finally:
+        srv.stop()
+    # unreachable server -> None (callers fall back to the grace timeout)
+    assert _config_server_version("http://127.0.0.1:9/get") is None
+    assert _config_server_version("") is None

@@ -435,3 +435,33 @@ class TestDPTrainStep:
         # replicas stay near each other (pulled toward the average)
         w = np.asarray(sp["w"])
         assert np.max(np.std(w, axis=0)) < 0.2
+
+
+@pytest.mark.parametrize("plan", [MeshPlan(dp=2, pp=1, sp=2, tp=2),
+                                  MeshPlan(dp=2, pp=2, sp=1, tp=2)], ids=str)
+def test_sharded_loss_learned_positions_matches(plan):
+    """Learned (absolute) positions under full sharding: the pos_embed
+    table rides the replicated layout, the lookup uses sp-global
+    offsets, and the loss matches the unsharded model."""
+    cfg = TransformerConfig(**{**CFG, "pos": "learned"})
+    model = Transformer(cfg)
+    tparams = model.init(jax.random.PRNGKey(0))
+    batch = _batch()
+    ref_loss = model.loss(tparams, batch, train=False)
+
+    trainer = ShardedTrainer(cfg, plan, n_micro=2 if plan.pp > 1 else 1)
+    params = trainer.from_transformer_params(tparams)
+    assert "pos_embed" in params
+    state = {"params": params, "opt_state": trainer.tx.init(params), "step": 0}
+    loss = trainer.loss(state, batch)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+
+
+def test_sharded_init_learned_positions():
+    cfg = TransformerConfig(**{**CFG, "pos": "learned"})
+    trainer = ShardedTrainer(cfg, MeshPlan(dp=2, pp=1, sp=1, tp=1))
+    state = trainer.init(jax.random.PRNGKey(1))
+    pe = state["params"]["pos_embed"]["table"]
+    assert pe.shape == (cfg.max_seq, cfg.d_model)
+    s, loss = trainer.step(state, _batch())
+    assert np.isfinite(float(loss))

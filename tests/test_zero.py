@@ -208,15 +208,27 @@ class TestZero1:
             zero1_restore(bio.getvalue(), init_opt(params), params,
                           new_comm=comm)
 
-    def test_reshard_refuses_multicontroller(self):
-        from kungfu_tpu.parallel.zero import zero1_reshard
+    def test_reshard_multicontroller_routes_to_host_plane(self):
+        """A multi-controller mesh routes reshard through the
+        snapshot/restore host plane (one entry point); without the
+        snapshot the contract violation is loud, not a silent
+        mis-shard."""
+        from kungfu_tpu.parallel.zero import zero1_reshard, zero1_snapshot
 
         comm = Communicator(devices=jax.devices()[:4], local_size=4)
         _, init_opt = zero1_train_step(_loss_fn, optax.sgd(0.1), comm)
         o = init_opt(_params())
         comm._multiproc = True  # simulate a provisioned-world mesh
-        with pytest.raises(NotImplementedError, match="host-plane"):
+        with pytest.raises(ValueError, match="snapshot"):
             zero1_reshard(o, _params(), comm)
+        # with the pre-resize snapshot the fold works even on the
+        # simulated multi-controller flag (all chunks addressable here)
+        blob = zero1_snapshot(o)
+        comm._multiproc = False  # placement back on the real local mesh
+        got = zero1_reshard(o, _params(), comm, snapshot=blob)
+        for a, b in zip(jax.tree_util.tree_leaves(o),
+                        jax.tree_util.tree_leaves(got)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
     def test_odd_total_size_pads(self):
         """A parameter count not divisible by n exercises the pad path
@@ -240,3 +252,34 @@ class TestZero1:
         p1, _, _ = step(params, init_opt(params), batch)
         np.testing.assert_allclose(np.asarray(p1["w"]), np.asarray(ref_p["w"]),
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestReshardSnapshotFold:
+    def test_reshard_with_snapshot_matches_direct(self):
+        """zero1_reshard(snapshot=...) — the folded host-plane path — is
+        value-identical to the direct single-controller re-placement,
+        with structure supplied by a FRESH init (the joiner contract)."""
+        from kungfu_tpu.parallel.zero import (zero1_reshard, zero1_snapshot,
+                                              zero1_train_step)
+
+        devs = jax.devices()
+        c8 = Communicator(devices=devs[:8], local_size=8, version=0)
+        c4 = Communicator(devices=devs[:4], local_size=4, version=1)
+        params, batch = _params(), _batch()
+        step8, init8 = zero1_train_step(_loss_fn, optax.adam(1e-2), c8)
+        p, o = params, init8(params)
+        for _ in range(2):
+            p, o, _ = step8(p, o, batch)
+
+        want = zero1_reshard(o, p, c4)
+        blob = zero1_snapshot(o)
+        _, init4 = zero1_train_step(_loss_fn, optax.adam(1e-2), c4)
+        got = zero1_reshard(init4(p), p, c4, snapshot=blob)
+        for a, b in zip(jax.tree_util.tree_leaves(want),
+                        jax.tree_util.tree_leaves(got)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # the sharded placement really is 1/n on the new mesh
+        vec = [l for l in jax.tree_util.tree_leaves(got)
+               if getattr(l, "ndim", 0) == 1]
+        assert vec and all(
+            len(l.sharding.device_set) == 4 for l in vec)
