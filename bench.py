@@ -337,6 +337,16 @@ def payload_lm(args) -> dict:
         logits = model.apply(params, ids_, train=True, attn_fn=default_attention)
         return plain_nll(logits, targets_)
 
+    from kungfu_tpu.ops.pallas.lm_head import lm_head_nll
+
+    def loss_fused_head(params, batch_):
+        # round-5 contestant: flash attention + the fused LM-head kernel
+        # pair — neither logits nor dlogits materialize in HBM (the
+        # head matmul fwd AND bwd run inside the xent kernels)
+        ids_, targets_ = batch_
+        h = model.hidden(params, ids_, train=True, attn_fn=flash_attn)
+        return jnp.mean(lm_head_nll(h, params["head"]["w"], targets_))
+
     tx = synchronous_sgd(optax.sgd(0.05, momentum=0.9), comm.axis)
     opt0 = tx.init(params)  # one momentum tree, shared by both variants
 
@@ -351,6 +361,7 @@ def payload_lm(args) -> dict:
 
     step_p, step_c_p = make_step(loss_pallas)
     step_x, step_c_x = make_step(loss_xla)
+    step_f, step_c_f = make_step(loss_fused_head)
 
     # FLOP count from the XLA variant (same math): flash/xent flops live
     # inside pallas_call custom calls, which XLA cost analysis counts as
@@ -369,17 +380,25 @@ def payload_lm(args) -> dict:
     # and one interleaved timing group, so a relay congestion burst can't
     # land on just one side of the ratio
     carry = (params, opt0, jnp.float32(0.0))
-    t = measure_group({"pallas": step_c_p, "xla": step_c_x}, carry,
-                      k_lo=2, k_hi=8)
-    t_p, t_x = t["pallas"], t["xla"]
+    t = measure_group(
+        {"pallas": step_c_p, "xla": step_c_x, "fused_head": step_c_f},
+        carry, k_lo=2, k_hi=8,
+    )
+    t_p, t_x, t_f = t["pallas"], t["xla"], t["fused_head"]
     if t_p is None or t_x is None:
         raise RuntimeError("lm payload: unmeasurable (relay noise; "
                            "K-differencing never separated)")
+    kernel_path = "flash+xent"
+    headline_step = step_p
+    if t_f is not None and t_f < t_p:
+        # headline rides the best kernel variant; the JSON names which,
+        # and the training-proof loop below runs the SAME variant
+        t_p, kernel_path, headline_step = t_f, "flash+fused_head", step_f
 
-    # prove real training on the kernel path
+    # prove real training on the kernel path the headline claims
     p_, o_, loss = params, opt0, None
     for _ in range(args.steps):
-        p_, o_, loss = step_p(p_, o_, (ids, targets))
+        p_, o_, loss = headline_step(p_, o_, (ids, targets))
     final_loss = float(loss) if loss is not None else None
 
     tokens_per_sec = batch * seq / t_p
@@ -396,6 +415,9 @@ def payload_lm(args) -> dict:
         "batch": batch,
         "seq_len": seq,
         "xla_variant_tokens_per_sec": round(batch * seq / t_x, 1),
+        "kernel_path": kernel_path,
+        "fused_head_tokens_per_sec": (round(batch * seq / t_f, 1)
+                                      if t_f is not None else None),
         "final_loss": round(final_loss, 4) if final_loss is not None else None,
         "achieved_tflops": round(achieved, 2) if achieved else None,
         "mfu": round(achieved / peak, 4) if achieved and peak else None,

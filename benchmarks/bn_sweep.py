@@ -50,6 +50,15 @@ def bn_variant(kind: str, ghost: int = 0):
             return prod(p, stats, x, False, momentum, eps, axis_name)
         return apply
 
+    if kind == "f32_norm":
+        # the pre-round-5 production path: all-f32 elementwise chain
+        # (prod now defaults to the activation dtype — this row keeps the
+        # sweep's before/after comparison meaningful)
+        def apply(p, stats, x, train, momentum=0.9, eps=1e-5, axis_name=None):
+            return prod(p, stats, x, train, momentum, eps, axis_name,
+                        compute_dtype=jnp.float32)
+        return apply
+
     if kind == "bf16_norm":
         def apply(p, stats, x, train, momentum=0.9, eps=1e-5, axis_name=None):
             if not train:
@@ -125,7 +134,7 @@ def main(argv=None) -> dict:
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU backend BEFORE init (a wedged TPU "
                         "tunnel hangs backend discovery)")
-    p.add_argument("--variants", default="prod,eval_bn,bf16_norm,ghost16")
+    p.add_argument("--variants", default="prod,eval_bn,f32_norm,ghost16")
     args = p.parse_args(argv)
 
     import jax

@@ -218,6 +218,12 @@ def main(argv=None) -> dict:
     p.add_argument("--quick", action="store_true")
     p.add_argument("--cpu-mesh", type=int, default=0, metavar="N",
                    help="force an N-device virtual CPU mesh (test/CI mode)")
+    p.add_argument("--fuse-grads", action="store_true",
+                   help="bucket the gradient pytree into one flat buffer "
+                        "before the collective (sync-sgd only)")
+    p.add_argument("--donate", action="store_true",
+                   help="donate params/opt-state buffers to the step "
+                        "(in-place update)")
     args = p.parse_args(argv)
 
     if args.backend == "host":
@@ -247,8 +253,15 @@ def main(argv=None) -> dict:
         step, init_opt = zero1_train_step(loss_fn, inner_optimizer(), comm)
         opt_state = init_opt(params)
     else:
-        tx, replicated = build_optimizer(args.optimizer, comm.axis, batch)
-        step = dp_train_step(loss_fn, tx, comm, replicated_params=replicated)
+        if args.optimizer == "sync-sgd" and args.fuse_grads:
+            from kungfu_tpu.optimizers import synchronous_sgd
+
+            tx, replicated = synchronous_sgd(
+                inner_optimizer(), comm.axis, fuse_grads=True), True
+        else:
+            tx, replicated = build_optimizer(args.optimizer, comm.axis, batch)
+        step = dp_train_step(loss_fn, tx, comm, replicated_params=replicated,
+                             donate=args.donate)
         opt_state = tx.init(params)
         if not replicated:
             params = stack_for_replicas(params, n)

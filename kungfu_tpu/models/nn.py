@@ -123,9 +123,20 @@ def batchnorm_state_init(ch: int):
     return {"mean": jnp.zeros((ch,), jnp.float32), "var": jnp.ones((ch,), jnp.float32)}
 
 
-def batchnorm_apply(p, stats, x, train: bool, momentum=0.9, eps=1e-5, axis_name=None):
+def batchnorm_apply(p, stats, x, train: bool, momentum=0.9, eps=1e-5,
+                    axis_name=None, compute_dtype=None):
     """Returns (y, new_stats).  In train mode, batch stats; cross-replica
-    mean via psum when ``axis_name`` given (sync BN over the DP axis)."""
+    mean via psum when ``axis_name`` given (sync BN over the DP axis).
+
+    The statistics (moments, running stats) are ALWAYS f32.  The
+    normalize/scale/shift elementwise chain — BN's big HBM reads and
+    writes — runs in ``compute_dtype``: the activation dtype by default,
+    so bf16 activations stay 2 bytes end to end (the round-4 BN-tax
+    diagnosis: the f32 chain cost ~20% of the ResNet-50 step,
+    ``benchmarks/bn_sweep.py`` ``bf16_norm`` variant; the per-channel
+    mean/inv fold to scalars, so only bf16 rounding of the normalized
+    output differs).  ``KF_TPU_BN_COMPUTE=f32`` restores the legacy
+    all-f32 chain globally; an explicit ``compute_dtype`` wins."""
     xf = x.astype(jnp.float32)
     if train:
         axes = tuple(range(x.ndim - 1))
@@ -145,8 +156,15 @@ def batchnorm_apply(p, stats, x, train: bool, momentum=0.9, eps=1e-5, axis_name=
     else:
         mean, var = stats["mean"], stats["var"]
         new_stats = stats
-    inv = jax.lax.rsqrt(var + eps) * p["scale"]
-    y = (xf - mean) * inv + p["bias"]
+    if compute_dtype is None:
+        import os
+
+        compute_dtype = (jnp.float32
+                         if os.environ.get("KF_TPU_BN_COMPUTE") == "f32"
+                         else x.dtype)
+    cd = jnp.dtype(compute_dtype)
+    inv = (jax.lax.rsqrt(var + eps) * p["scale"]).astype(cd)
+    y = (xf.astype(cd) - mean.astype(cd)) * inv + p["bias"].astype(cd)
     return y.astype(x.dtype), new_stats
 
 

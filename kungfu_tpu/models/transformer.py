@@ -143,17 +143,25 @@ class Transformer:
         ``attn_fn(q, k, v, causal)`` overrides attention (ring attention /
         flash kernel); ``positions`` overrides token positions (sequence
         parallelism passes the global positions of the local shard)."""
+        h = self.hidden(params, ids, train=train, rng=rng, attn_fn=attn_fn,
+                        positions=positions)
+        return nn.dense_apply(params["head"], h).astype(jnp.float32)
+
+    def hidden(self, params, ids, train: bool = False, rng=None,
+               attn_fn: Optional[Callable] = None, positions=None):
+        """Features after the final norm, BEFORE the LM head — the input
+        the fused LM-head kernel (:func:`kungfu_tpu.ops.pallas.lm_head.
+        lm_head_nll`) consumes together with ``params["head"]["w"]``, so
+        the [*, vocab] logits never materialize."""
         cfg = self.cfg
         dt = cfg.compute_dtype
         attn = attn_fn or pick_attention()
         B, S = ids.shape
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(S), (B, S))
-
         h = nn.embedding_apply(params["embed"], ids, dtype=dt)
         if cfg.pos == "learned":
             h = h + nn.embedding_apply(params["pos_embed"], positions, dtype=dt)
-
         for i in range(cfg.n_layers):
             lp = params[f"layer_{i}"]
             x = nn.layernorm_apply(lp["ln1"], h)
@@ -165,16 +173,13 @@ class Transformer:
             o = attn(q, k, v, cfg.causal)
             o = self._merge(o)
             h = h + nn.dense_apply(lp["wo"], o, dtype=dt)
-
             x = nn.layernorm_apply(lp["ln2"], h)
             y = nn.gelu(nn.dense_apply(lp["ffn_in"], x, dtype=dt))
             if train and cfg.dropout > 0 and rng is not None:
                 rng, sub = jax.random.split(rng)
                 y = nn.dropout(sub, y, cfg.dropout, train)
             h = h + nn.dense_apply(lp["ffn_out"], y, dtype=dt)
-
-        h = nn.layernorm_apply(params["ln_f"], h)
-        return nn.dense_apply(params["head"], h).astype(jnp.float32)
+        return nn.layernorm_apply(params["ln_f"], h)
 
     def _heads(self, x):
         B, S, _ = x.shape

@@ -14,12 +14,14 @@ from kungfu_tpu.ops.pallas.attention import (
     flash_attention_with_lse,
     make_flash_attn,
 )
+from kungfu_tpu.ops.pallas.lm_head import lm_head_nll
 from kungfu_tpu.ops.pallas.xent import softmax_cross_entropy, token_nll
 
 __all__ = [
     "flash_attention",
     "flash_attention_with_lse",
     "make_flash_attn",
+    "lm_head_nll",
     "softmax_cross_entropy",
     "token_nll",
 ]

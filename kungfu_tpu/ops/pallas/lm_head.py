@@ -1,0 +1,319 @@
+"""Fused LM-head + softmax-cross-entropy — logits never touch HBM.
+
+The round-4 analysis (docs/perf.md "disposition of the 0.49× row")
+identified the only honest way to beat XLA's fused xent backward: fuse
+the *consumers* of dlogits — the LM-head matmuls dW = hᵀ·dlogits and
+dh = dlogits·Wᵀ — so the [N, V] dlogits (and the [N, V] logits) never
+materialize.  This module is that kernel pair, flash-attention-shaped:
+
+* **forward** — grid (row blocks, vocab blocks): the logits tile is
+  computed ON THE MXU (h_blk @ W_blk) into VMEM, fed straight to the
+  online-softmax accumulators (max / sumexp / target-logit scratch, as
+  in :mod:`kungfu_tpu.ops.pallas.xent`), and discarded.  Residuals:
+  ``(h, W, targets, lse)`` — O(N·D + D·V), not O(N·V).
+* **backward** — two sweeps, each recomputing the logits tile from the
+  residuals (the flash trade: FLOPs for HBM):
+  - dh kernel, vocab innermost: ``dh += dlogits_tile @ Wᵀ`` accumulated
+    in VMEM scratch across the vocab sweep;
+  - dW kernel, rows innermost: ``dW += hᵀ @ dlogits_tile`` accumulated
+    across the row sweep.
+  ``dlogits_tile = (exp(logits_tile − lse) − onehot)·g`` lives only in
+  VMEM.
+
+Roofline (docs/perf.md carries the signed-off version): per logits
+element the fusion saves ~12 HBM bytes (bf16 logits write+read, f32
+log-probs write+read, bf16 dlogits write+read) and pays 2·D recompute
+MACs — at v5e ratios (197 TFLOP/s : 819 GB/s ≈ 240 FLOP/byte) the
+wall-clock crossover sits near D ≈ 740, so GPT-2-small dims are
+break-even on time and the capacity win (no O(N·V) residual set) is
+the real prize: batch sizes that OOM the XLA path outright run here.
+
+Interpret mode on CPU for exactness tests; compiled on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kungfu_tpu.ops.pallas._sharding import vma_of as _vma
+
+DEFAULT_BLOCK_N = 256
+DEFAULT_BLOCK_V = 1024
+_NEG_INF = -1e30
+_LANES = 128
+
+
+def _dot(a, b):
+    return jax.lax.dot_general(
+        a, b, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+def _fwd_kernel(h_ref, w_ref, targets_ref, loss_ref, lse_ref,
+                m_ref, l_ref, t_ref, *, vocab, block_v, masked):
+    """Grid = (row blocks, vocab blocks), vocab innermost; the logits
+    tile is an MXU product consumed in VMEM (cf. xent._fwd_kernel for
+    the online-softmax scheme and the in-sweep target accumulation)."""
+    j = pl.program_id(1)
+    n_v = pl.num_programs(1)
+    blk = _dot(h_ref[...], w_ref[...])  # [block_n, block_v] f32
+    n = blk.shape[0]
+    tgt = targets_ref[...][:, :1]
+
+    @pl.when(j == 0)
+    def _():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        t_ref[...] = jnp.zeros_like(t_ref)
+
+    k_pos = j * block_v + jax.lax.broadcasted_iota(jnp.int32, (n, block_v), 1)
+    if masked:
+        blk = jnp.where(k_pos < vocab, blk, _NEG_INF)
+    m = m_ref[...]
+    m_new = jnp.maximum(m, jnp.max(blk, axis=-1, keepdims=True))
+    corr = jnp.exp(m - m_new)
+    l_new = l_ref[...] * corr + jnp.sum(
+        jnp.exp(blk - m_new), axis=-1, keepdims=True
+    )
+    is_tgt = k_pos == tgt
+    t_new = t_ref[...] + jnp.sum(jnp.where(is_tgt, blk, 0.0), axis=-1,
+                                 keepdims=True)
+    m_ref[...] = m_new
+    l_ref[...] = l_new
+    t_ref[...] = t_new
+
+    @pl.when(j == n_v - 1)
+    def _():
+        lse = m_new + jnp.log(jnp.maximum(l_new, 1e-30))
+        lanes = loss_ref.shape
+        loss_ref[...] = jnp.broadcast_to(lse - t_new, lanes)
+        lse_ref[...] = jnp.broadcast_to(lse, lanes)
+
+
+def _dlogits_tile(h_blk, w_blk, targets, lse, g, j, vocab, block_v, masked):
+    """Recompute one logits tile and form its dlogits in VMEM."""
+    blk = _dot(h_blk, w_blk)
+    n = blk.shape[0]
+    k_pos = j * block_v + jax.lax.broadcasted_iota(jnp.int32, (n, block_v), 1)
+    p = jnp.exp(blk - lse)
+    if masked:
+        p = jnp.where(k_pos < vocab, p, 0.0)
+    onehot = (k_pos == targets).astype(jnp.float32)
+    return (p - onehot) * g
+
+
+def _bwd_dh_kernel(h_ref, w_ref, targets_ref, lse_ref, g_ref, dh_ref,
+                   acc_ref, *, vocab, block_v, masked):
+    """Grid = (row blocks, vocab blocks), vocab innermost: dh accumulates
+    in VMEM across the vocab sweep, written once at the end."""
+    j = pl.program_id(1)
+    n_v = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    dlog = _dlogits_tile(
+        h_ref[...], w_ref[...], targets_ref[...][:, :1], lse_ref[...][:, :1],
+        g_ref[...][:, :1], j, vocab, block_v, masked,
+    )
+    # [bn, bv] @ [bv, D] on the MXU
+    acc_ref[...] += jax.lax.dot_general(
+        dlog, w_ref[...], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(j == n_v - 1)
+    def _():
+        dh_ref[...] = acc_ref[...].astype(dh_ref.dtype)
+
+
+def _bwd_dw_kernel(h_ref, w_ref, targets_ref, lse_ref, g_ref, dw_ref,
+                   acc_ref, *, vocab, block_v, masked):
+    """Grid = (vocab blocks, row blocks), rows innermost: dW accumulates
+    in VMEM across the row sweep."""
+    j = pl.program_id(0)
+    i = pl.program_id(1)
+    n_n = pl.num_programs(1)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    h_blk = h_ref[...]
+    dlog = _dlogits_tile(
+        h_blk, w_ref[...], targets_ref[...][:, :1], lse_ref[...][:, :1],
+        g_ref[...][:, :1], j, vocab, block_v, masked,
+    )
+    # [D, bn] @ [bn, bv] on the MXU
+    acc_ref[...] += jax.lax.dot_general(
+        h_blk, dlog, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(i == n_n - 1)
+    def _():
+        dw_ref[...] = acc_ref[...].astype(dw_ref.dtype)
+
+
+def _pad_nd(h, w, targets, block_n, block_v):
+    n, d = h.shape
+    v = w.shape[1]
+    n_pad = ((n + block_n - 1) // block_n) * block_n
+    v_pad = ((v + block_v - 1) // block_v) * block_v
+    d_pad = ((d + _LANES - 1) // _LANES) * _LANES
+    if n_pad != n or d_pad != d:
+        h = jnp.pad(h, [(0, n_pad - n), (0, d_pad - d)])
+        targets = jnp.pad(targets, [(0, n_pad - n)])
+    if v_pad != v or d_pad != d:
+        w = jnp.pad(w, [(0, d_pad - d), (0, v_pad - v)])
+    return h, w, targets, n_pad, v_pad, d_pad
+
+
+def _fwd_call(h, w, targets, block_n, block_v, interpret):
+    n, _ = h.shape
+    v = w.shape[1]
+    h, w, targets, n_pad, v_pad, d_pad = _pad_nd(h, w, targets,
+                                                 block_n, block_v)
+    row = pl.BlockSpec((block_n, _LANES), lambda i, j: (i, 0))
+    kernel = functools.partial(_fwd_kernel, vocab=v, block_v=block_v,
+                               masked=v_pad != v)
+    loss, lse = pl.pallas_call(
+        kernel,
+        grid=(n_pad // block_n, v_pad // block_v),
+        in_specs=[
+            pl.BlockSpec((block_n, d_pad), lambda i, j: (i, 0)),
+            pl.BlockSpec((d_pad, block_v), lambda i, j: (0, j)),
+            row,
+        ],
+        out_specs=[row, row],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_pad, _LANES), jnp.float32,
+                                 vma=_vma(h, w, targets)),
+            jax.ShapeDtypeStruct((n_pad, _LANES), jnp.float32,
+                                 vma=_vma(h, w, targets)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_n, 1), jnp.float32),
+            pltpu.VMEM((block_n, 1), jnp.float32),
+            pltpu.VMEM((block_n, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(h, w, jnp.broadcast_to(targets[:, None], (n_pad, _LANES)))
+    return loss[:n, 0], lse[:n, 0]
+
+
+def _bwd_call(h, w, targets, lse, g, block_n, block_v, interpret):
+    n, d = h.shape
+    v = w.shape[1]
+    h, w, targets, n_pad, v_pad, d_pad = _pad_nd(h, w, targets,
+                                                 block_n, block_v)
+    if n_pad != n:
+        # padded rows: lse=+inf zeroes their softmax, g=0 their gradient
+        lse = jnp.pad(lse, [(0, n_pad - n)], constant_values=1e30)
+        g = jnp.pad(g, [(0, n_pad - n)])
+    row = pl.BlockSpec((block_n, _LANES), lambda i, j: (i, 0))
+    lanes = lambda t: jnp.broadcast_to(t[:, None], (n_pad, _LANES))  # noqa: E731
+    masked = v_pad != v
+
+    dh = pl.pallas_call(
+        functools.partial(_bwd_dh_kernel, vocab=v, block_v=block_v,
+                          masked=masked),
+        grid=(n_pad // block_n, v_pad // block_v),
+        in_specs=[
+            pl.BlockSpec((block_n, d_pad), lambda i, j: (i, 0)),
+            pl.BlockSpec((d_pad, block_v), lambda i, j: (0, j)),
+            row, row, row,
+        ],
+        out_specs=pl.BlockSpec((block_n, d_pad), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_pad, d_pad), h.dtype,
+                                       vma=_vma(h, w, targets, lse, g)),
+        scratch_shapes=[pltpu.VMEM((block_n, d_pad), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(h, w, lanes(targets), lanes(lse), lanes(g))
+
+    row_dw = pl.BlockSpec((block_n, _LANES), lambda j, i: (i, 0))
+    dw = pl.pallas_call(
+        functools.partial(_bwd_dw_kernel, vocab=v, block_v=block_v,
+                          masked=masked),
+        grid=(v_pad // block_v, n_pad // block_n),
+        in_specs=[
+            pl.BlockSpec((block_n, d_pad), lambda j, i: (i, 0)),
+            pl.BlockSpec((d_pad, block_v), lambda j, i: (0, j)),
+            row_dw, row_dw, row_dw,
+        ],
+        out_specs=pl.BlockSpec((d_pad, block_v), lambda j, i: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((d_pad, v_pad), w.dtype,
+                                       vma=_vma(h, w, targets, lse, g)),
+        scratch_shapes=[pltpu.VMEM((d_pad, block_v), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(h, w, lanes(targets), lanes(lse), lanes(g))
+    return dh[:n, :d], dw[:d, :v]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _lmh(h, w, targets, block_n, block_v, interpret):
+    loss, _ = _fwd_call(h, w, targets, block_n, block_v, interpret)
+    return loss
+
+
+def _lmh_fwd(h, w, targets, block_n, block_v, interpret):
+    loss, lse = _fwd_call(h, w, targets, block_n, block_v, interpret)
+    return loss, (h, w, targets, lse)
+
+
+def _lmh_bwd(block_n, block_v, interpret, res, g):
+    h, w, targets, lse = res
+    dh, dw = _bwd_call(h, w, targets, lse, g, block_n, block_v, interpret)
+    return dh.astype(h.dtype), dw.astype(w.dtype), None
+
+
+_lmh.defvjp(_lmh_fwd, _lmh_bwd)
+
+
+def lm_head_nll(
+    h,
+    w,
+    targets,
+    block_n: Optional[int] = None,
+    block_v: Optional[int] = None,
+    interpret: Optional[bool] = None,
+):
+    """Per-token NLL of ``softmax(h @ w)`` vs int ``targets`` with the
+    LM-head matmul fused into both the xent forward and backward —
+    neither logits nor dlogits ever reach HBM.
+
+    ``h``: [..., D] features (post-final-norm), ``w``: [D, V] head
+    weights, ``targets``: [...] int.  Differentiable w.r.t. ``h`` and
+    ``w``.  Matches ``-log_softmax(h @ w)[target]`` (f32 accumulation
+    on the MXU) to float tolerance."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    v = w.shape[-1]
+    if block_v is None:
+        block_v = min(DEFAULT_BLOCK_V, ((max(v, 1) + 127) // 128) * 128)
+    if block_n is None:
+        block_n = DEFAULT_BLOCK_N
+    lead = h.shape[:-1]
+    out = _lmh(
+        h.reshape(-1, h.shape[-1]),
+        w,
+        targets.reshape(-1).astype(jnp.int32),
+        block_n, block_v, interpret,
+    )
+    return out.reshape(lead)

@@ -222,6 +222,36 @@ class TestBNVariants:
             np.asarray(y0, np.float32), np.asarray(y1, np.float32),
             atol=0.05, rtol=0.05)
 
+    def test_bn_compute_dtype_default_and_optout(self, monkeypatch):
+        """Round-5 BN-tax fix: the elementwise chain defaults to the
+        activation dtype; stats stay bit-identical f32; KF_TPU_BN_COMPUTE
+        =f32 (or an explicit compute_dtype) restores the legacy chain."""
+        from kungfu_tpu.models import nn
+
+        x, p, st = self._xpb()
+        monkeypatch.delenv("KF_TPU_BN_COMPUTE", raising=False)
+        y_act, s_act = nn.batchnorm_apply(p, st, x, train=True)
+        y_f32, s_f32 = nn.batchnorm_apply(p, st, x, train=True,
+                                          compute_dtype=jnp.float32)
+        for k in s_act:
+            np.testing.assert_array_equal(np.asarray(s_act[k]),
+                                          np.asarray(s_f32[k]))
+        assert y_act.dtype == x.dtype == y_f32.dtype
+        np.testing.assert_allclose(
+            np.asarray(y_act, np.float32), np.asarray(y_f32, np.float32),
+            atol=0.05, rtol=0.05)
+        # env opt-out is exactly the explicit-f32 chain
+        monkeypatch.setenv("KF_TPU_BN_COMPUTE", "f32")
+        y_env, s_env = nn.batchnorm_apply(p, st, x, train=True)
+        np.testing.assert_array_equal(np.asarray(y_env), np.asarray(y_f32))
+        # f32 activations: both chains are the same f32 math
+        xf = x.astype(jnp.float32)
+        monkeypatch.delenv("KF_TPU_BN_COMPUTE", raising=False)
+        ya, _ = nn.batchnorm_apply(p, st, xf, train=True)
+        yb, _ = nn.batchnorm_apply(p, st, xf, train=True,
+                                   compute_dtype=jnp.float32)
+        np.testing.assert_array_equal(np.asarray(ya), np.asarray(yb))
+
     def test_ghost_groups_and_fallback(self):
         import sys
         sys.path.insert(0, REPO_BENCH)

@@ -75,6 +75,36 @@ class TestSyncSGD:
         out = np.asarray(per_peer(comm, step)(params0, grads))
         np.testing.assert_allclose(out, params0 - grads.sum(0), rtol=1e-5)
 
+    def test_fused_buckets_match_per_leaf(self, comm):
+        """fuse_grads=True (one flat-buffer collective) must be
+        value-identical to the per-leaf path, mixed shapes and dtypes
+        included, on every schedule."""
+        lr = 0.1
+        tree_p = {
+            "w": stacked((4, 3)),
+            "b": stacked((3,), seed=5),
+        }
+        tree_g = {
+            "w": stacked((4, 3), seed=6),
+            "b": stacked((3,), seed=7),
+        }
+        for sched in ("psum", "ring", "two_stage"):
+            outs = {}
+            for fused in (False, True):
+                opt = synchronous_sgd(optax.sgd(lr), axis=comm.axis,
+                                      schedule=sched, fuse_grads=fused)
+
+                def step(p, g):
+                    updates, _ = opt.update(g, opt.init(p), p)
+                    return optax.apply_updates(p, updates)
+
+                outs[fused] = per_peer(comm, step)(tree_p, tree_g)
+            for a, b in zip(jax.tree_util.tree_leaves(outs[False]),
+                            jax.tree_util.tree_leaves(outs[True])):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=1e-5, atol=1e-7,
+                                           err_msg=sched)
+
     def test_replicas_stay_in_sync(self, comm):
         """After a sync step from identical params, replicas are identical."""
         p0 = np.broadcast_to(np.arange(4, dtype=np.float32), (N, 4)).copy()

@@ -341,3 +341,105 @@ class TestDefaultBlocks:
 
         assert _default_blocks(2048, 32, 64) == (32, 64)
         assert _default_blocks(2048, None, 64) == (256, 64)
+
+
+class TestFusedLMHead:
+    """lm_head.py — the LM-head matmuls fused into the xent fwd+bwd:
+    loss and BOTH gradients must match the plain logits path."""
+
+    def _ref(self, h, w, t):
+        logits = (h.astype(jnp.float32) @ w.astype(jnp.float32))
+        lse = jax.scipy.special.logsumexp(logits, -1)
+        tl = jnp.take_along_axis(logits, t[:, None], -1)[:, 0]
+        return lse - tl
+
+    @pytest.mark.parametrize("shape", [
+        (16, 32, 256),    # aligned
+        (20, 48, 300),    # ragged N, D, V (pad paths in every dim)
+        (8, 128, 1000),   # ragged V only
+    ])
+    def test_loss_and_grads_match_reference(self, shape):
+        from kungfu_tpu.ops.pallas.lm_head import lm_head_nll
+
+        n, d, v = shape
+        rng = np.random.default_rng(1)
+        h = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((d, v)) * 0.1, jnp.float32)
+        t = jnp.asarray(rng.integers(0, v, n), jnp.int32)
+
+        l_ref = self._ref(h, w, t)
+        l_k = lm_head_nll(h, w, t, block_n=8, block_v=128)
+        np.testing.assert_allclose(np.asarray(l_k), np.asarray(l_ref),
+                                   rtol=2e-5, atol=1e-6)
+
+        g_ref = jax.grad(lambda h, w: jnp.mean(self._ref(h, w, t)),
+                         argnums=(0, 1))(h, w)
+        g_k = jax.grad(
+            lambda h, w: jnp.mean(lm_head_nll(h, w, t, block_n=8,
+                                              block_v=128)),
+            argnums=(0, 1))(h, w)
+        for a, b in zip(g_k, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-6)
+
+    def test_bf16_inputs(self):
+        from kungfu_tpu.ops.pallas.lm_head import lm_head_nll
+
+        rng = np.random.default_rng(2)
+        n, d, v = 16, 64, 384
+        h = jnp.asarray(rng.standard_normal((n, d)), jnp.bfloat16)
+        w = jnp.asarray(rng.standard_normal((d, v)) * 0.1, jnp.bfloat16)
+        t = jnp.asarray(rng.integers(0, v, n), jnp.int32)
+        l_ref = self._ref(h, w, t)
+        loss, grads = jax.value_and_grad(
+            lambda h, w: jnp.mean(lm_head_nll(h, w, t, block_n=8,
+                                              block_v=128)),
+            argnums=(0, 1))(h, w)
+        np.testing.assert_allclose(float(loss), float(jnp.mean(l_ref)),
+                                   rtol=5e-3)
+        assert grads[0].dtype == jnp.bfloat16
+        assert grads[1].dtype == jnp.bfloat16
+        g_ref = jax.grad(lambda h, w: jnp.mean(self._ref(h, w, t)),
+                         argnums=(0, 1))(h, w)
+        for a, b in zip(grads, g_ref):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=0.1, atol=5e-3)
+
+    def test_leading_batch_dims(self):
+        from kungfu_tpu.ops.pallas.lm_head import lm_head_nll
+
+        rng = np.random.default_rng(3)
+        b, s, d, v = 2, 10, 32, 200
+        h = jnp.asarray(rng.standard_normal((b, s, d)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((d, v)) * 0.1, jnp.float32)
+        t = jnp.asarray(rng.integers(0, v, (b, s)), jnp.int32)
+        out = lm_head_nll(h, w, t, block_n=8, block_v=128)
+        assert out.shape == (b, s)
+        ref = self._ref(h.reshape(-1, d), w, t.reshape(-1)).reshape(b, s)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=1e-6)
+
+    def test_model_hidden_path_matches_apply(self):
+        """Transformer.hidden + lm_head_nll == token_nll over apply's
+        logits — the bench contestant computes the same training loss."""
+        from kungfu_tpu.models.transformer import (Transformer,
+                                                   TransformerConfig)
+        from kungfu_tpu.ops.pallas.lm_head import lm_head_nll
+
+        cfg = TransformerConfig(vocab_size=128, d_model=32, n_layers=1,
+                                n_heads=2, d_ff=64, max_seq=16,
+                                dtype="float32")
+        model = Transformer(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(4)
+        ids = jnp.asarray(rng.integers(0, 128, (2, 16)), jnp.int32)
+        tgt = jnp.asarray(rng.integers(0, 128, (2, 16)), jnp.int32)
+        logits = model.apply(params, ids)
+        lse_ref = -jnp.take_along_axis(
+            jax.nn.log_softmax(logits), tgt[..., None], -1).squeeze(-1)
+        h = model.hidden(params, ids)
+        fused = lm_head_nll(h, params["head"]["w"], tgt, block_n=8,
+                            block_v=128)
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(lse_ref),
+                                   rtol=2e-5, atol=1e-5)
