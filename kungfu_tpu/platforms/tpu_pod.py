@@ -101,3 +101,70 @@ def parse_tpu_pod_env(env=None, slots_per_host: int = 1) -> Optional[PodInfo]:
         info.num_hosts, info.self_host, wid, info.slice_id, info.num_slices,
     )
     return info
+
+
+def slice_device_groups(devices=None, by: str = "slice"):
+    """Group the global device list by slice, outer-sorted by slice id.
+
+    ``by="slice"``: real multislice TPU — devices carry ``slice_index``
+    (libtpu federates the slices through the MEGASCALE coordinator and
+    every process sees all chips).  ``by="process"``: the emulation
+    contract — one jax process per "slice" (CPU devices report a
+    constant ``slice_index``, so the process index IS the slice id
+    there, ``MEGASCALE_SLICE_ID`` = process id).
+    """
+    import jax
+
+    devs = list(devices) if devices is not None else jax.devices()
+
+    def slice_of(d):
+        if by == "process":
+            return d.process_index
+        si = getattr(d, "slice_index", None)
+        return si if si is not None else d.process_index
+
+    groups = {}
+    for d in devs:
+        groups.setdefault(slice_of(d), []).append(d)
+    return [groups[k] for k in sorted(groups)]
+
+
+def multislice_communicator(num_slices: Optional[int] = None, devices=None,
+                            version: int = 0):
+    """Build a hierarchical Communicator whose OUTER mesh axis is the
+    slice (DCN) and inner axis the within-slice chips (ICI) — the
+    two-level topology the ``two_stage`` schedule decomposes over:
+    reduce within each slice over ICI, exchange once across slices over
+    DCN, broadcast back (SURVEY §5.8; reference local/cross split,
+    ``session/strategy.go:176-210``).
+
+    ``num_slices`` defaults to the ``MEGASCALE_NUM_SLICES`` contract and
+    is validated against the devices actually visible; raises when the
+    federation does not show the expected slice count (a half-joined
+    multislice job must fail loudly, not silently train one slice).
+    """
+    from kungfu_tpu.comm.device import Communicator
+
+    if num_slices is None:
+        num_slices = int(os.environ.get(MEGASCALE_NUM_SLICES, "0") or 0) or None
+    groups = slice_device_groups(devices)
+    if num_slices is not None and len(groups) != num_slices:
+        # emulation: one jax process per slice (CPU devices report a
+        # constant slice_index — regroup by the process contract)
+        by_proc = slice_device_groups(devices, by="process")
+        if len(by_proc) == num_slices:
+            groups = by_proc
+        else:
+            raise ValueError(
+                f"{MEGASCALE_NUM_SLICES}={num_slices} but the device "
+                f"world shows {len(groups)} slice group(s) "
+                f"({len(by_proc)} process group(s))"
+            )
+    per = len(groups[0])
+    if any(len(g) != per for g in groups):
+        raise ValueError(
+            f"uneven slice sizes {[len(g) for g in groups]} — multislice "
+            "meshes need identical slices"
+        )
+    flat = [d for g in groups for d in g]
+    return Communicator(devices=flat, local_size=per, version=version)

@@ -1,0 +1,49 @@
+"""Wheel build with the native transport compiled in.
+
+The reference ships a CMake + setup.py build so ``kungfu-distribute``
+pushes a runnable artifact (``/root/reference`` ``CMakeLists.txt``,
+``setup.py``); here the equivalent is a platform wheel whose
+``kungfu_tpu/native/libkfnative.so`` (transport + SIMD reduce) is built
+at WHEEL time — target hosts need no compiler.  The lazy first-use
+build in :mod:`kungfu_tpu.native` remains as the source-checkout path.
+
+    pip wheel . --no-deps -w dist/        # build
+    kf-distribute -H <hosts> -- pip install <wheel>   # push (docs/deploy.md)
+
+``KF_WHEEL_SKIP_NATIVE=1`` builds a pure-python wheel (the numpy
+fallback engine serves the data plane then).
+"""
+
+import os
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+from setuptools.dist import Distribution
+
+_SKIP = os.environ.get("KF_WHEEL_SKIP_NATIVE") == "1"
+
+
+class build_py_with_native(build_py):
+    def run(self):
+        super().run()
+        if _SKIP:
+            return
+        here = os.path.dirname(os.path.abspath(__file__))
+        src = os.path.join(here, "kungfu_tpu", "native")
+        subprocess.run(["make", "-C", src], check=True)
+        target = os.path.join(self.build_lib, "kungfu_tpu", "native")
+        self.mkpath(target)
+        self.copy_file(os.path.join(src, "libkfnative.so"),
+                       os.path.join(target, "libkfnative.so"))
+
+
+class BinaryDistribution(Distribution):
+    """Tag the wheel for this platform: it carries a compiled .so."""
+
+    def has_ext_modules(self):
+        return not _SKIP
+
+
+setup(cmdclass={"build_py": build_py_with_native},
+      distclass=BinaryDistribution)
