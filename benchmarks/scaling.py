@@ -26,11 +26,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def run_size(n: int, model: str, optimizer: str, quick: bool,
-             timeout: float) -> dict:
+             timeout: float, extra=()) -> dict:
     cmd = [sys.executable, os.path.join(REPO, "benchmarks", "system.py"),
            "--model", model, "--optimizer", optimizer, "--cpu-mesh", str(n)]
     if quick:
         cmd.append("--quick")
+    cmd += list(extra)
     try:
         r = subprocess.run(cmd, capture_output=True, text=True,
                            timeout=timeout, cwd=REPO)
@@ -52,13 +53,21 @@ def main(argv=None) -> dict:
     p.add_argument("--optimizer", default="sync-sgd")
     p.add_argument("--quick", action="store_true")
     p.add_argument("--timeout", type=float, default=420.0, help="per size")
+    p.add_argument("--fuse-grads", action="store_true",
+                   help="bucketed gradient sync (one flat-buffer "
+                        "collective) at every rung — sync-sgd only, "
+                        "like system.py's flag")
+    p.add_argument("--donate", action="store_true",
+                   help="donate the train state at every rung")
     args = p.parse_args(argv)
     sizes = [int(s) for s in args.sizes.split(",") if s]
+    extra = ([x for x, on in (("--fuse-grads", args.fuse_grads),
+                              ("--donate", args.donate)) if on])
 
     by_np, unit = {}, None
     for n in sizes:
         out = run_size(n, args.model, args.optimizer, args.quick,
-                       args.timeout)
+                       args.timeout, extra)
         by_np[str(n)] = out.get("value") if "error" not in out else None
         unit = out.get("unit", unit)
         if "error" in out:

@@ -190,10 +190,36 @@ class Transformer:
         return x.transpose(0, 2, 1, 3).reshape(B, S, H * D)
 
     def loss(self, params, batch, train: bool = True, rng=None, attn_fn=None, positions=None):
-        """Next-token LM loss; batch = (ids, targets) both [B, S]."""
-        from kungfu_tpu.ops.pallas.xent import token_nll
+        """Next-token LM loss; batch = (ids, targets) both [B, S].
+
+        ``KF_TPU_LM_HEAD`` (``fused`` | ``plain`` | ``auto``, default
+        auto) selects the head implementation: ``fused`` computes the
+        NLL straight from the pre-head features with the fused LM-head
+        kernel pair (:func:`kungfu_tpu.ops.pallas.lm_head.lm_head_nll`
+        — neither logits nor dlogits reach HBM); ``auto`` takes it on
+        TPU exactly when the plain path's O(N·V) residual set would
+        blow the same HBM budget the xent router uses (the shapes where
+        XLA OOMs outright).  Otherwise the logits materialize and
+        :func:`token_nll`'s own router picks the xent implementation."""
+        import os
+
+        from kungfu_tpu.ops.pallas.xent import (route_fused_lm_head,
+                                                token_nll)
 
         ids, targets = batch
+        mode = os.environ.get("KF_TPU_LM_HEAD", "auto").lower()
+        if mode not in ("fused", "plain", "auto"):
+            raise ValueError(
+                f"KF_TPU_LM_HEAD={mode!r}: one of fused | plain | auto")
+        fused_head = mode == "fused"
+        if mode == "auto" and train and jax.default_backend() == "tpu":
+            fused_head = route_fused_lm_head(ids.size, self.cfg.vocab_size)
+        if fused_head:
+            from kungfu_tpu.ops.pallas.lm_head import lm_head_nll
+
+            h = self.hidden(params, ids, train=train, rng=rng,
+                            attn_fn=attn_fn, positions=positions)
+            return jnp.mean(lm_head_nll(h, params["head"]["w"], targets))
         logits = self.apply(params, ids, train=train, rng=rng, attn_fn=attn_fn, positions=positions)
         # train also steers the xent router: eval-only calls take the
         # fwd-only crossover (the kernel wins much earlier without a

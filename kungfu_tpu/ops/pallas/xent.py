@@ -269,6 +269,17 @@ def _route_fused(n: int, v: int, itemsize: int, training: bool) -> bool:
     return n * v >= min_el
 
 
+def route_fused_lm_head(n_tokens: int, vocab: int) -> bool:
+    """Should a training loss skip materializing logits entirely and take
+    the fused LM-head kernel (:mod:`kungfu_tpu.ops.pallas.lm_head`)?
+
+    Owns the one assumption callers kept duplicating: the plain path's
+    logits are f32 (``Transformer.apply`` casts), so the residual bound
+    is the training branch of :func:`_route_fused` at itemsize 4 — the
+    same budget that routes :func:`token_nll` to the xent kernel."""
+    return _route_fused(n_tokens, vocab, 4, training=True)
+
+
 def token_nll(logits, targets, training: bool = True):
     """Mean next-token NLL with the fused/plain dispatch.
 

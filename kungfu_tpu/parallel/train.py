@@ -78,6 +78,7 @@ class ShardedTrainer:
         devices=None,
         capacity_factor: float = 1.25,
         schedule: str = "psum",
+        fuse_grads: bool = False,
     ):
         if cfg.pos not in ("rope", "learned"):
             raise ValueError(f"unknown position mode {cfg.pos!r}")
@@ -105,6 +106,9 @@ class ShardedTrainer:
         #: (kungfu_tpu.ops.schedules; pass comm.strategy to honor an
         #: installed/autotuned choice)
         self.schedule = schedule
+        #: bucket the gradient sync: one collective per sync-kind
+        #: (exact — leaves of a kind share axes and denominator)
+        self.fuse_grads = fuse_grads
         self.mesh = plan.build_mesh(devices)
         self.param_specs, self.param_kinds = self._layout()
         self._step_fn = None
@@ -332,13 +336,32 @@ class ShardedTrainer:
         plan = self.plan
         from kungfu_tpu.ops.schedules import all_reduce_scheduled
 
-        def f(g, kind):
-            axes, denom_axes = _KIND_AXES[kind]
-            g = all_reduce_scheduled(g, axes, op="sum",
-                                     schedule=self.schedule)
-            return g / _axis_prod(plan, denom_axes)
+        if not self.fuse_grads:
+            def f(g, kind):
+                axes, denom_axes = _KIND_AXES[kind]
+                g = all_reduce_scheduled(g, axes, op="sum",
+                                         schedule=self.schedule)
+                return g / _axis_prod(plan, denom_axes)
 
-        return jax.tree_util.tree_map(f, grads, self.param_kinds)
+            return jax.tree_util.tree_map(f, grads, self.param_kinds)
+
+        # bucketed: ONE collective per sync-kind (leaves of a kind share
+        # reduce axes and denominator, so fusing them is exact) — the
+        # reference's fuse/defuse bucketing, per mesh-axis group here
+        from kungfu_tpu.ops.fuse import defuse, fuse
+
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat_k = jax.tree_util.tree_leaves(self.param_kinds)
+        for kind in sorted(set(flat_k)):
+            idxs = [i for i, k in enumerate(flat_k) if k == kind]
+            buf, spec = fuse([flat_g[i] for i in idxs])
+            axes, denom_axes = _KIND_AXES[kind]
+            buf = all_reduce_scheduled(buf, axes, op="sum",
+                                       schedule=self.schedule)
+            buf = buf / _axis_prod(plan, denom_axes)
+            for i, g in zip(idxs, defuse(buf, spec)):
+                flat_g[i] = g
+        return jax.tree_util.tree_unflatten(treedef, flat_g)
 
     # -- jitted step -------------------------------------------------------
     def _build_step(self):

@@ -443,3 +443,26 @@ class TestFusedLMHead:
                             block_v=128)
         np.testing.assert_allclose(np.asarray(fused), np.asarray(lse_ref),
                                    rtol=2e-5, atol=1e-5)
+
+    def test_model_loss_lm_head_switch(self, monkeypatch):
+        """KF_TPU_LM_HEAD=fused routes Transformer.loss through the
+        fused head; the value matches the plain path."""
+        from kungfu_tpu.models.transformer import (Transformer,
+                                                   TransformerConfig)
+
+        cfg = TransformerConfig(vocab_size=128, d_model=32, n_layers=1,
+                                n_heads=2, d_ff=64, max_seq=16,
+                                dtype="float32")
+        model = Transformer(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(5)
+        batch = (jnp.asarray(rng.integers(0, 128, (2, 16)), jnp.int32),
+                 jnp.asarray(rng.integers(0, 128, (2, 16)), jnp.int32))
+        monkeypatch.setenv("KF_TPU_LM_HEAD", "plain")
+        plain = float(model.loss(params, batch, train=True))
+        monkeypatch.setenv("KF_TPU_LM_HEAD", "fused")
+        fused = float(model.loss(params, batch, train=True))
+        np.testing.assert_allclose(fused, plain, rtol=2e-5)
+        monkeypatch.setenv("KF_TPU_LM_HEAD", "bogus")
+        with pytest.raises(ValueError, match="KF_TPU_LM_HEAD"):
+            model.loss(params, batch)
