@@ -61,6 +61,11 @@ def main(argv=None) -> dict:
                    help="donate the train state at every rung")
     args = p.parse_args(argv)
     sizes = [int(s) for s in args.sizes.split(",") if s]
+    if args.fuse_grads and args.optimizer != "sync-sgd":
+        # system.py would silently drop the flag — the sweep would then
+        # claim fused numbers it never measured
+        p.error(f"--fuse-grads only applies to sync-sgd "
+                f"(got --optimizer {args.optimizer})")
     extra = ([x for x, on in (("--fuse-grads", args.fuse_grads),
                               ("--donate", args.donate)) if on])
 

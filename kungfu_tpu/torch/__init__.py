@@ -15,6 +15,7 @@ reference's CUDA ``HandlerManager`` (``ops/cuda/collective.cpp:20-90``).
 """
 
 from kungfu_tpu.torch.ops.collective import (  # noqa: F401
+    all_gather,
     all_reduce,
     all_reduce_async,
     broadcast,

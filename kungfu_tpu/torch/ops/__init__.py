@@ -1,4 +1,5 @@
 from kungfu_tpu.torch.ops.collective import (  # noqa: F401
+    all_gather,
     all_reduce,
     all_reduce_async,
     broadcast,

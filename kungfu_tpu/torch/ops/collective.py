@@ -123,6 +123,17 @@ def broadcast(
     return clib.from_numpy(out, t).reshape(t.shape)
 
 
+def all_gather(t: "torch.Tensor", engine=None, name: str = "") -> "torch.Tensor":
+    """Stack every rank's tensor on a new leading axis (reference
+    ``torch/ops/collective.py:48-52``): returns shape ``[np, *t.shape]``."""
+    engine = engine if engine is not None else _default_engine()
+    if engine is None:
+        return t.clone().unsqueeze(0)
+    a = clib.to_numpy(t)
+    out = engine.all_gather(a, name=name or _next_name("ag"))
+    return clib.from_numpy(out, t).reshape((-1,) + tuple(t.shape))
+
+
 def broadcast_parameters(
     params: Union[dict, Iterable["torch.Tensor"]], root: int = 0, engine=None
 ) -> None:

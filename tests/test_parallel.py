@@ -494,10 +494,21 @@ def test_sharded_fused_grad_sync_matches():
 
 
 def test_sharded_fused_grad_sync_moe():
+    """The 'expert' sync-kind rides the bucketed path too: fused and
+    per-leaf sync must produce identical post-step params for an MoE
+    trainer (same init, same batch)."""
     plan = MeshPlan(dp=2, pp=1, sp=1, tp=2)
     cfg = TransformerConfig(**CFG)
-    model = Transformer(cfg)
-    trainer = ShardedTrainer(cfg, plan, n_experts=2, fuse_grads=True)
-    state = trainer.init(jax.random.PRNGKey(1))
-    state, loss = trainer.step(state, _batch())
-    assert np.isfinite(float(loss))
+    batch = _batch()
+    outs = {}
+    for fused in (False, True):
+        trainer = ShardedTrainer(cfg, plan, n_experts=2,
+                                 tx=optax.sgd(0.05), fuse_grads=fused)
+        state = trainer.init(jax.random.PRNGKey(1))
+        state, loss = trainer.step(state, batch)
+        assert np.isfinite(float(loss))
+        outs[fused] = state["params"]
+    for a, b in zip(jax.tree_util.tree_leaves(outs[False]),
+                    jax.tree_util.tree_leaves(outs[True])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)

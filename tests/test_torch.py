@@ -98,6 +98,23 @@ class TestMultiEngine:
         finally:
             close_all(engines, chans)
 
+    def test_all_gather_stacks_ranks(self):
+        engines, chans = make_engines(3)
+        try:
+            tensors = [torch.full((2, 2), float(i)) for i in range(3)]
+            outs = run_all(
+                [lambda e=e, t=t: collective.all_gather(t, engine=e,
+                                                        name="ag0")
+                 for e, t in zip(engines, tensors)]
+            )
+            for o in outs:
+                assert o.shape == (3, 2, 2)
+                for r in range(3):
+                    assert torch.allclose(o[r], torch.full((2, 2),
+                                                           float(r)))
+        finally:
+            close_all(engines, chans)
+
     def test_async_handles(self):
         engines, chans = make_engines(2)
         try:
