@@ -430,6 +430,32 @@ class Channel {
 
     bool ok() const { return listen_fd_ >= 0; }
 
+    // RAII in-flight marker; declare FIRST in an entry point so its
+    // release (and the close_all wakeup) runs after every lock is gone.
+    // The count changes ONLY under q_mu_ — the same mutex close_all's
+    // drain predicate evaluates under — so (a) an entry that raced past
+    // the predicate load cannot be missed, and (b) the releasing thread
+    // cannot touch a freed channel: while it holds q_mu_ for the
+    // decrement, close_all is still inside its cv_ wait.  Entries are
+    // REFUSED once running_ is false (`ok` = false; callers return
+    // their closed status) — a late send must not dial out and install
+    // fresh pool fds on a channel being torn down.
+    struct ApiGuard {
+        Channel *ch;
+        bool ok;
+        explicit ApiGuard(Channel *c) : ch(c), ok(false) {
+            std::lock_guard<std::mutex> lk(ch->q_mu_);
+            if (!ch->running_.load()) { return; }
+            ++ch->api_inflight_;
+            ok = true;
+        }
+        ~ApiGuard() {
+            if (!ok) { return; }
+            std::lock_guard<std::mutex> lk(ch->q_mu_);
+            if (--ch->api_inflight_ == 0) { ch->cv_.notify_all(); }
+        }
+    };
+
     ~Channel() { close_all(); }
 
     void close_all() {
@@ -469,15 +495,21 @@ class Channel {
             if (slot->thread.joinable()) { slot->thread.join(); }
         }
         conns_.clear();
-        reset_connections();
+        reset_connections_impl();  // running_ is false; the gated public
+        // entry would refuse, but the pool must still be torn down
         listen_fd_ = -1;
         // a blocked receiver woke with rc=2 (closed); wait until every
-        // recv call has actually left before the caller may delete us
+        // recv call AND every other in-flight API entry has actually
+        // left before the caller may delete us
         std::unique_lock<std::mutex> lk(q_mu_);
-        cv_.wait(lk, [this] { return recv_inflight_ == 0; });
+        cv_.wait(lk, [this] {
+            return recv_inflight_ == 0 && api_inflight_ == 0;
+        });
     }
 
     void set_token(uint32_t token) {
+        ApiGuard api{this};
+        if (!api.ok) { return; }
         std::lock_guard<std::mutex> lk(q_mu_);
         token_ = token;
         for (auto it = queues_.begin(); it != queues_.end();) {
@@ -497,6 +529,8 @@ class Channel {
     // 0 ok, -1 unreachable, -3 payload over kMaxFrame
     int send(const std::string &peer, const std::string &name,
              const uint8_t *payload, uint32_t len, int conn_type, int retries) {
+        ApiGuard api{this};
+        if (!api.ok) { return -1; }  // closed: unreachable by definition
         if (len > kMaxFrame) { return -3; }
         std::string host;
         uint16_t port = 0;
@@ -595,6 +629,8 @@ class Channel {
     // same rb — the map holds a raw pointer into the caller's frame.
     int recv_register(const std::string &src, const std::string &name,
                       int conn_type, RegBuf *rb) {
+        ApiGuard api{this};
+        if (!api.ok) { return 2; }  // closed
         QueueKey key{static_cast<uint8_t>(conn_type), src, name,
                      conn_type == kConnCollective ? token_.load() : 0};
         std::unique_lock<std::mutex> lk(q_mu_);
@@ -622,6 +658,10 @@ class Channel {
     // return, no live pointer to rb remains anywhere in the channel.
     void recv_cancel(const std::string &src, const std::string &name,
                      int conn_type, RegBuf *rb) {
+        ApiGuard api{this};
+        if (!api.ok) { return; }  // closed: stream threads are gone and
+        // the map is never consulted again, so skipping the deregister
+        // leaves no live pointer behind
         QueueKey key{static_cast<uint8_t>(conn_type), src, name,
                      conn_type == kConnCollective ? token_.load() : 0};
         std::unique_lock<std::mutex> lk(q_mu_);
@@ -783,6 +823,8 @@ class Channel {
     }
 
     int ping(const std::string &peer, double timeout_s) {
+        ApiGuard api{this};
+        if (!api.ok) { return 1; }  // closed: not reachable
         std::string host;
         uint16_t port = 0;
         if (!split_peer(peer, host, port)) { return -1; }
@@ -799,6 +841,12 @@ class Channel {
     }
 
     void reset_connections() {
+        ApiGuard api{this};
+        if (!api.ok) { return; }  // close_all resets the pool itself
+        reset_connections_impl();
+    }
+
+    void reset_connections_impl() {
         std::vector<std::shared_ptr<PoolEntry>> entries;
         {
             std::lock_guard<std::mutex> lk(pool_mu_);
@@ -818,12 +866,16 @@ class Channel {
 
     // newline-separated "src bytes" ingress totals; returns bytes written
     int ingress_snapshot(char *out, int cap) {
+        ApiGuard api{this};
+        if (!api.ok) { return 0; }
         return counter_snapshot(ingress_, out, cap);
     }
 
     // egress totals — counted in send() so traffic from the native engine
     // executor (which never crosses the python send wrapper) is included
     int egress_snapshot(char *out, int cap) {
+        ApiGuard api{this};
+        if (!api.ok) { return 0; }
         return counter_snapshot(egress_, out, cap);
     }
 
@@ -1022,6 +1074,13 @@ class Channel {
     std::map<QueueKey, std::deque<std::string>> queues_;
     std::map<QueueKey, RegBuf *> regbufs_;  // guarded by q_mu_; borrowed ptrs
     int recv_inflight_ = 0;  // guarded by q_mu_
+    // in-flight count for API entries NOT covered by recv_inflight_
+    // (send / recv_register / recv_cancel / ping / ...): close_all()
+    // drains BOTH before the caller may delete the channel — a thread
+    // still inside send() while another thread closed the channel was
+    // a use-after-free (gossip puller vs. peer teardown).  Guarded by
+    // q_mu_ (see ApiGuard for why atomicity alone is not enough).
+    int api_inflight_ = 0;
 
     std::mutex pool_mu_;
     std::map<std::string, std::shared_ptr<PoolEntry>> pool_;

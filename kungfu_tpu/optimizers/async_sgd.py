@@ -142,7 +142,15 @@ class PairAveragingOptimizer:
                             jax.tree_util.tree_leaves(self._last_params)]))
             self._recv_buf = np.empty(n, np.dtype(self.fuse_dtype))
         t0 = _time.perf_counter()
-        got = self.peer.request_into(target, self.name, self._recv_buf)
+        try:
+            # misses are tolerated by design — bound the connect ladder
+            # so a dead target costs seconds, not 500x200 ms on the
+            # critical path
+            got = self.peer.request_into(target, self.name,
+                                         self._recv_buf, send_retries=25)
+        except (TimeoutError, ConnectionError, OSError) as e:
+            _log.debug("pull from %d failed: %s", target, e)
+            return None
         dt = _time.perf_counter() - t0
         if got is None:
             return None
@@ -256,9 +264,13 @@ class _ModelPuller(threading.Thread):
                 w = self._free.pop()
             t0 = time.perf_counter()
             try:
+                # bounded connect ladder: a dead target must fail within
+                # ~pull_timeout, or close() could not join this thread
+                # and the peer teardown would race the in-flight call
                 got = self.peer.request_into(
                     target, self.blob_name, self._slots[w],
                     timeout=self.pull_timeout,
+                    send_retries=max(1, int(self.pull_timeout / 0.2)),
                 )
             except Exception as e:  # noqa: BLE001 — peer churn is normal
                 _log.debug("async pull from %d failed: %s", target, e)
@@ -313,10 +325,19 @@ class _ModelPuller(threading.Thread):
     def close(self, timeout: Optional[float] = None) -> None:
         self._stop_evt.set()
         if self.is_alive():
-            # the in-flight pull returns within pull_timeout even when the
-            # target died mid-request
+            # worst-case in-flight pull: the bounded connect ladder
+            # (~pull_timeout), the registered wait (pull_timeout), and
+            # the size-mismatch fallback recv (pull_timeout) in sequence
             self.join(timeout if timeout is not None
-                      else self.pull_timeout + 5.0)
+                      else 3.0 * self.pull_timeout + 5.0)
+            if self.is_alive():
+                # teardown proceeding under a live pull would race the
+                # channel free (the C++ ApiGuard makes the close wait,
+                # but the situation deserves a loud trace)
+                _log.warning(
+                    "gossip puller still in flight after %.0fs join; "
+                    "channel close will drain it",
+                    3.0 * self.pull_timeout + 5.0)
 
 
 class AsyncPairAveragingOptimizer(PairAveragingOptimizer):

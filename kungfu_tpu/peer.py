@@ -694,11 +694,15 @@ class Peer:
 
     def request_into(self, target_rank: int, name: str, buf,
                      version: Optional[str] = None,
-                     timeout: float = 60.0):
+                     timeout: float = 60.0,
+                     send_retries: Optional[int] = None):
         """Pull a named blob INTO a preallocated buffer — zero-copy on
-        the native backend (see :func:`remote_request_into`)."""
+        the native backend (see :func:`remote_request_into`).
+        ``send_retries`` bounds the request's connect ladder (miss-
+        tolerant callers like gossip fail fast on a dead target)."""
         from kungfu_tpu.store import remote_request_into
 
         target = self.cluster.workers[target_rank]
         return remote_request_into(self, target, name, buf, version,
-                                   timeout=timeout)
+                                   timeout=timeout,
+                                   send_retries=send_retries)

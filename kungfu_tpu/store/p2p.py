@@ -146,6 +146,7 @@ def remote_request(
 def remote_request_into(
     peer, target: PeerID, name: str, buf,
     version: Optional[str] = None, timeout: float = 60.0,
+    send_retries: Optional[int] = None,
 ):
     """Pull blob ``name`` from ``target`` INTO ``buf`` (writable
     contiguous buffer sized to the expected blob) — the gossip hot path.
@@ -182,8 +183,13 @@ def remote_request_into(
     # answers faster than we can turn around
     posted = channel.post_recv(target, f"rsp.{req_id}", buf,
                                ConnType.PEER_TO_PEER)
+    # gossip pulls tolerate misses by design — a bounded send_retries
+    # makes a dead target fail in seconds instead of riding the full
+    # 500x200 ms connect ladder while the step (or teardown) waits
+    kw = {} if send_retries is None else {"retries": send_retries}
     try:
-        channel.send(target, f"req.{req_id}", body, ConnType.PEER_TO_PEER)
+        channel.send(target, f"req.{req_id}", body, ConnType.PEER_TO_PEER,
+                     **kw)
     except BaseException:
         posted.abort()
         raise

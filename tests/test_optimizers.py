@@ -347,7 +347,8 @@ class _FakePullPeer:
         self.miss = False
         self.delay = 0.0
 
-    def request_into(self, target, name, buf, version=None, timeout=None):
+    def request_into(self, target, name, buf, version=None, timeout=None,
+                     send_retries=None):
         import time
 
         if self.delay:
@@ -536,7 +537,7 @@ class TestAsyncPairAveraging:
                 pass
 
             def request_into(self, target, name, buf, version=None,
-                             timeout=None):
+                             timeout=None, send_retries=None):
                 time.sleep(self.wire_s)
                 buf[:] = 7.0
                 return buf
@@ -567,3 +568,71 @@ class TestAsyncPairAveraging:
             assert float(np.asarray(params["w"])[0]) > 0.0
         finally:
             opt.close()
+
+    def test_async_gossip_survives_peer_departure(self):
+        """A peer closing mid-gossip must not kill the puller thread or
+        the survivors' steps: pulls from the dead peer miss (timeout or
+        connection error), the staleness bound keeps the step bounded,
+        and averaging resumes between the survivors."""
+        from kungfu_tpu.optimizers import AsyncPairAveragingOptimizer
+        from kungfu_tpu.peer import Peer
+        from kungfu_tpu.plan import Cluster, PeerList
+        from kungfu_tpu.store.store import reset_local_store
+        from kungfu_tpu.utils.envs import Config
+
+        reset_local_store()
+        workers = PeerList.parse(
+            "127.0.0.1:24021,127.0.0.1:24022,127.0.0.1:24023")
+        cluster = Cluster(PeerList.parse("127.0.0.1:38083"), workers)
+        peers = [Peer(Config(self_id=workers[i], cluster=cluster))
+                 for i in range(3)]
+        for p in peers:
+            p.start()
+        opts = []
+        try:
+            opts = [AsyncPairAveragingOptimizer(
+                optax.sgd(0.0), peer=p, selector="roundrobin",
+                pull_timeout=2.0, max_staleness=2) for p in peers]
+            params = [{"w": jnp.full(4, float(i), jnp.float32)}
+                      for i in range(3)]
+            import threading
+
+            states = [None] * 3
+
+            def init_one(i):
+                states[i] = opts[i].init(params[i])
+
+            ts = [threading.Thread(target=init_one, args=(i,))
+                  for i in range(3)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=30)
+            grads = {"w": jnp.zeros(4, jnp.float32)}
+            for i in range(3):
+                params[i], states[i] = opts[i].step(params[i], grads,
+                                                    states[i])
+            # peer 2 leaves without ceremony
+            opts[2].close()
+            peers[2].close()
+            # survivors keep stepping; round-robin targets include the
+            # dead peer — those pulls miss, the thread must survive
+            import time
+
+            t0 = time.monotonic()
+            for _ in range(4):
+                for i in range(2):
+                    params[i], states[i] = opts[i].step(params[i], grads,
+                                                        states[i])
+            assert time.monotonic() - t0 < 60.0
+            for i in range(2):
+                assert opts[i]._puller.is_alive()
+                # landed from SOMEONE after the departure (live peer or
+                # the last landing reused) — the step never went dark
+                assert opts[i].averaged_steps >= 1
+        finally:
+            for o in opts[:2]:
+                o.close()
+            for p in peers[:2]:
+                p.close()
+            reset_local_store()
