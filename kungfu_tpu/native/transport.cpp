@@ -443,9 +443,13 @@ class Channel {
     struct ApiGuard {
         Channel *ch;
         bool ok;
-        explicit ApiGuard(Channel *c) : ch(c), ok(false) {
+        // force=true: count the entry even while closing (never refuse)
+        // — for calls whose CLEANUP contract must hold during the close
+        // window (recv_cancel: a registration may still be claimed by a
+        // stream thread that close_all has not joined yet)
+        explicit ApiGuard(Channel *c, bool force = false) : ch(c), ok(false) {
             std::lock_guard<std::mutex> lk(ch->q_mu_);
-            if (!ch->running_.load()) { return; }
+            if (!force && !ch->running_.load()) { return; }
             ++ch->api_inflight_;
             ok = true;
         }
@@ -502,9 +506,17 @@ class Channel {
         // recv call AND every other in-flight API entry has actually
         // left before the caller may delete us
         std::unique_lock<std::mutex> lk(q_mu_);
-        cv_.wait(lk, [this] {
-            return recv_inflight_ == 0 && api_inflight_ == 0;
-        });
+        while (recv_inflight_ != 0 || api_inflight_ != 0) {
+            if (cv_.wait_for(lk, std::chrono::milliseconds(200)) ==
+                std::cv_status::timeout) {
+                // re-sweep: shut down any pool fd a racing send managed
+                // to install anyway, so its blocked writev unblocks and
+                // the in-flight call can drain
+                lk.unlock();
+                reset_connections_impl();
+                lk.lock();
+            }
+        }
     }
 
     void set_token(uint32_t token) {
@@ -551,10 +563,17 @@ class Channel {
             entry = slot;
         }
         std::lock_guard<std::mutex> lk(entry->mu);
+        // a connect that finishes after close_all's pool sweep must not
+        // install a socket nothing will ever shut down (the close drain
+        // would then hang behind a writev blocked on backpressure)
+        auto install_open = [&](int fd) -> bool {
+            if (!running_.load()) { ::close(fd); return false; }
+            entry->install_fd(fd);
+            return true;
+        };
         if (entry->fd < 0) {
             int fd = connect_retry(host, port, retries);
-            if (fd < 0) { return -1; }
-            entry->install_fd(fd);
+            if (fd < 0 || !install_open(fd)) { return -1; }
         }
         if (!writev_all(entry->fd, head.data(), head.size(), payload, len)) {
             // stale pooled socket (peer restarted): reconnect once.
@@ -562,8 +581,7 @@ class Channel {
             // concurrent reset_connections sees fd=-1, not a dead number
             entry->retire_fd();
             int fd = connect_retry(host, port, retries);
-            if (fd < 0) { return -1; }
-            entry->install_fd(fd);
+            if (fd < 0 || !install_open(fd)) { return -1; }
             if (!writev_all(entry->fd, head.data(), head.size(), payload, len)) {
                 entry->retire_fd();
                 return -1;
@@ -658,10 +676,10 @@ class Channel {
     // return, no live pointer to rb remains anywhere in the channel.
     void recv_cancel(const std::string &src, const std::string &name,
                      int conn_type, RegBuf *rb) {
-        ApiGuard api{this};
-        if (!api.ok) { return; }  // closed: stream threads are gone and
-        // the map is never consulted again, so skipping the deregister
-        // leaves no live pointer behind
+        // forced: even mid-close a stream thread may hold a claim on rb
+        // (state 3) until close_all joins it — returning early would let
+        // the caller free rb under that live pointer
+        ApiGuard api{this, /*force=*/true};
         QueueKey key{static_cast<uint8_t>(conn_type), src, name,
                      conn_type == kConnCollective ? token_.load() : 0};
         std::unique_lock<std::mutex> lk(q_mu_);

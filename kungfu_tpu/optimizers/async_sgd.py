@@ -328,16 +328,16 @@ class _ModelPuller(threading.Thread):
             # worst-case in-flight pull: the bounded connect ladder
             # (~pull_timeout), the registered wait (pull_timeout), and
             # the size-mismatch fallback recv (pull_timeout) in sequence
-            self.join(timeout if timeout is not None
+            waited = (timeout if timeout is not None
                       else 3.0 * self.pull_timeout + 5.0)
+            self.join(waited)
             if self.is_alive():
                 # teardown proceeding under a live pull would race the
                 # channel free (the C++ ApiGuard makes the close wait,
                 # but the situation deserves a loud trace)
                 _log.warning(
                     "gossip puller still in flight after %.0fs join; "
-                    "channel close will drain it",
-                    3.0 * self.pull_timeout + 5.0)
+                    "channel close will drain it", waited)
 
 
 class AsyncPairAveragingOptimizer(PairAveragingOptimizer):

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import os
 import queue
 import threading
 from typing import Optional
@@ -34,14 +35,13 @@ def install_p2p_handler(channel: HostChannel, store=None,
     device-strategy epoch record) must not share an eviction window with
     gossip model traffic, whose per-step versions would push them out.
 
-    Serving happens on a dedicated responder thread, NEVER on the
-    channel's receive path: a ~100 MiB model reply blocks on TCP
-    backpressure, and if the stream thread is the one writing it, it
-    stops draining its own socket — with two peers pulling from each
-    other continuously (async gossip), that deadlocks both directions
-    until a timeout.  One responder thread per endpoint also matches the
-    reference, which answers ``Request`` from its own goroutine, not the
-    connection reader (``rchannel/handler/p2p.go:36-47``)."""
+    Serving happens on a small responder pool, NEVER on the channel's
+    receive path: a ~100 MiB model reply blocks on TCP backpressure, and
+    if the stream thread is the one writing it, it stops draining its
+    own socket — with two peers pulling from each other continuously
+    (async gossip), that deadlocks both directions until a timeout.
+    The reference answers each ``Request`` from its own goroutine, not
+    the connection reader (``rchannel/handler/p2p.go:36-47``)."""
 
     serve_q: "queue.Queue" = queue.Queue()
 
@@ -92,9 +92,16 @@ def install_p2p_handler(channel: HostChannel, store=None,
             except Exception as e:  # noqa: BLE001 — keep serving
                 _log.warning("p2p serve failed: %s", e)
 
-    t = threading.Thread(target=responder, name="kf-p2p-responder",
-                         daemon=True)
-    t.start()
+    # a small pool, not one thread: the reference answers each request
+    # on its own goroutine, and with several peers pulling concurrently
+    # a single responder would serialize ~100 MiB serves behind the
+    # slowest receiver.  KF_CONFIG_P2P_RESPONDERS sizes it.
+    n_threads = max(1, int(os.environ.get("KF_CONFIG_P2P_RESPONDERS", "2")))
+    threads = [threading.Thread(target=responder,
+                                name=f"kf-p2p-responder-{i}", daemon=True)
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
 
     def handle(name: str, payload: bytes, src: str):
         # runs on the channel's receive path — hand off and return so the
@@ -104,8 +111,10 @@ def install_p2p_handler(channel: HostChannel, store=None,
     channel.on_p2p_request(handle)
 
     def stop(join_timeout: float = 5.0):
-        serve_q.put(None)
-        t.join(join_timeout)
+        for _ in threads:
+            serve_q.put(None)
+        for t in threads:
+            t.join(join_timeout)
 
     return stop
 

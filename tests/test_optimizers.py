@@ -615,6 +615,7 @@ class TestAsyncPairAveraging:
             # peer 2 leaves without ceremony
             opts[2].close()
             peers[2].close()
+            avg_before = [opts[i].averaged_steps for i in range(2)]
             # survivors keep stepping; round-robin targets include the
             # dead peer — those pulls miss, the thread must survive
             import time
@@ -627,9 +628,10 @@ class TestAsyncPairAveraging:
             assert time.monotonic() - t0 < 60.0
             for i in range(2):
                 assert opts[i]._puller.is_alive()
-                # landed from SOMEONE after the departure (live peer or
-                # the last landing reused) — the step never went dark
-                assert opts[i].averaged_steps >= 1
+                # averaging CONTINUED after the departure (fresh landings
+                # from the live peer, or reuse of the last landing) —
+                # the pre-departure steps alone must not satisfy this
+                assert opts[i].averaged_steps > avg_before[i]
         finally:
             for o in opts[:2]:
                 o.close()

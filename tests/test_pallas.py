@@ -466,3 +466,34 @@ class TestFusedLMHead:
         monkeypatch.setenv("KF_TPU_LM_HEAD", "bogus")
         with pytest.raises(ValueError, match="KF_TPU_LM_HEAD"):
             model.loss(params, batch)
+
+    def test_random_shape_sweep(self):
+        """Randomized ragged shapes and block sizes: loss + grads must
+        match the reference everywhere (pad/mask path fuzz)."""
+        from kungfu_tpu.ops.pallas.lm_head import lm_head_nll
+
+        rng = np.random.default_rng(11)
+        for _ in range(5):
+            n = int(rng.integers(1, 40))
+            d = int(rng.integers(8, 96))
+            v = int(rng.integers(16, 520))
+            bn = int(rng.choice([8, 16, 32]))
+            bv = int(rng.choice([128, 256]))
+            h = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+            w = jnp.asarray(rng.standard_normal((d, v)) * 0.1, jnp.float32)
+            t = jnp.asarray(rng.integers(0, v, n), jnp.int32)
+            msg = f"n={n} d={d} v={v} bn={bn} bv={bv}"
+            l_k = lm_head_nll(h, w, t, block_n=bn, block_v=bv)
+            np.testing.assert_allclose(
+                np.asarray(l_k), np.asarray(self._ref(h, w, t)),
+                rtol=2e-5, atol=1e-5, err_msg=msg)
+            g_ref = jax.grad(lambda h, w: jnp.mean(self._ref(h, w, t)),
+                             argnums=(0, 1))(h, w)
+            g_k = jax.grad(
+                lambda h, w: jnp.mean(lm_head_nll(h, w, t, block_n=bn,
+                                                  block_v=bv)),
+                argnums=(0, 1))(h, w)
+            for a, b in zip(g_k, g_ref):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=1e-4, atol=1e-5,
+                                           err_msg=msg)
