@@ -38,6 +38,9 @@ def main(argv=None) -> dict:
     p.add_argument("--steps", type=int, default=None)
     p.add_argument("--warmup", type=int, default=None)
     p.add_argument("--base-port", type=int, default=28600)
+    p.add_argument("--dtype", choices=["f32", "bf16"], default="f32",
+                   help="fused-model wire dtype: bf16 halves the bytes "
+                        "every pull and publish move")
     p.add_argument("--mode", choices=["blocking", "async", "both"],
                    default="blocking",
                    help="blocking = pull on the critical path; async = "
@@ -87,7 +90,8 @@ def main(argv=None) -> dict:
 
     n = args.np_workers
     sizes = fake_model_sizes(args.model)
-    nbytes = 4 * sum(sizes)
+    fuse_dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    nbytes = jnp.dtype(fuse_dtype).itemsize * sum(sizes)
     params0 = {"buf": jnp.zeros(sum(sizes), jnp.float32)}
 
     def run_mode(mode: str, base_port: int) -> dict:
@@ -115,16 +119,10 @@ def main(argv=None) -> dict:
         def worker(peer):
             if args.wire_ms:
                 peer = _SlowWire(peer)
-            if mode == "async":
-                opt = AsyncPairAveragingOptimizer(
-                    optax.sgd(0.01), peer, name="bench",
-                    selector="roundrobin",
-                )
-            else:
-                opt = PairAveragingOptimizer(
-                    optax.sgd(0.01), peer, name="bench",
-                    selector="roundrobin",
-                )
+            cls = (AsyncPairAveragingOptimizer if mode == "async"
+                   else PairAveragingOptimizer)
+            opt = cls(optax.sgd(0.01), peer, name="bench",
+                      selector="roundrobin", fuse_dtype=fuse_dtype)
             params = params0
             state = opt.init(params)
             grads = {"buf": jnp.ones(sum(sizes), jnp.float32) * 1e-3}
@@ -207,6 +205,7 @@ def main(argv=None) -> dict:
         "unit": "steps/sec/peer",
         "np": n,
         "mode": args.mode,
+        "dtype": args.dtype,
         "model": args.model,
         "model_mib": round(nbytes / (1 << 20), 1),
         **{k: v for k, v in primary.items() if k != "steps_per_sec"},

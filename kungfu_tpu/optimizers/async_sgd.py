@@ -91,24 +91,34 @@ class PairAveragingOptimizer:
         )
 
     # -- store IO --------------------------------------------------------
+    # The model travels as RAW BYTES (a uint8 view): the store/serve/
+    # registered-receive chain rides the buffer protocol, which ml_dtypes
+    # extension dtypes (bfloat16 — the fuse_dtype that HALVES gossip wire
+    # bytes) do not export.  The view is zero-copy both ways.
     def _serialize(self, params):
         buf, _ = fuse(params, dtype=self.fuse_dtype)
         # np.asarray of a CPU-resident jax array is a zero-copy readonly
         # view; the store takes it without snapshotting (copy=False) —
         # jax arrays are immutable, so the handover is safe
-        return np.asarray(buf)
+        return np.asarray(buf).view(np.uint8)
 
     def _deserialize_buf(self, blob):
-        return jnp.asarray(
-            np.frombuffer(blob, dtype=np.dtype(self.fuse_dtype))
-        )
+        raw = (np.frombuffer(blob, np.uint8)
+               if isinstance(blob, (bytes, bytearray, memoryview))
+               else np.asarray(blob).view(np.uint8))
+        return jnp.asarray(raw.view(np.dtype(self.fuse_dtype)))
+
+    def _model_nbytes(self, params) -> int:
+        numel = int(np.sum([int(np.prod(l.shape)) for l in
+                            jax.tree_util.tree_leaves(params)]))
+        return numel * np.dtype(self.fuse_dtype).itemsize
 
     def _publish(self, params) -> None:
         self.peer.save(self.name, self._serialize(params),
                        version=str(self._step_count), copy=False)
 
     def _publish_buf(self, fused) -> None:
-        self.peer.save(self.name, np.asarray(fused),
+        self.peer.save(self.name, np.asarray(fused).view(np.uint8),
                        version=str(self._step_count), copy=False)
 
     def _select_peer(self) -> Optional[int]:
@@ -138,9 +148,8 @@ class PairAveragingOptimizer:
         import time as _time
 
         if self._recv_buf is None:
-            n = int(np.sum([int(np.prod(l.shape)) for l in
-                            jax.tree_util.tree_leaves(self._last_params)]))
-            self._recv_buf = np.empty(n, np.dtype(self.fuse_dtype))
+            self._recv_buf = np.empty(self._model_nbytes(self._last_params),
+                                      np.uint8)
         t0 = _time.perf_counter()
         try:
             # misses are tolerated by design — bound the connect ladder
@@ -206,8 +215,7 @@ class _ModelPuller(threading.Thread):
         self,
         peer,
         name: str,
-        nbytes_elt: np.dtype,
-        numel: int,
+        nbytes: int,
         select: Callable[[], Optional[int]],
         pull_timeout: float = 10.0,
         min_interval: float = 0.0,
@@ -217,7 +225,10 @@ class _ModelPuller(threading.Thread):
         self.peer = peer
         self.blob_name = name
         self._select = select
-        self._slots = [np.empty(numel, nbytes_elt) for _ in range(3)]
+        # raw byte buffers: the wire rides the buffer protocol, which
+        # ml_dtypes fuse dtypes (bfloat16) do not export — the consumer
+        # reinterprets on take (PairAveragingOptimizer._deserialize_buf)
+        self._slots = [np.empty(nbytes, np.uint8) for _ in range(3)]
         self._free = [0, 1, 2]
         self._ready: Optional[int] = None
         self._read: Optional[int] = None
@@ -371,10 +382,8 @@ class AsyncPairAveragingOptimizer(PairAveragingOptimizer):
     def _ensure_puller(self, params) -> None:
         if self._puller is not None:
             return
-        numel = int(np.sum([int(np.prod(l.shape)) for l in
-                            jax.tree_util.tree_leaves(params)]))
         self._puller = _ModelPuller(
-            self.peer, self.name, np.dtype(self.fuse_dtype), numel,
+            self.peer, self.name, self._model_nbytes(params),
             self._select_peer, pull_timeout=self._pull_timeout,
             min_interval=self._min_interval, paced=True,
         )
@@ -422,7 +431,7 @@ class AsyncPairAveragingOptimizer(PairAveragingOptimizer):
             self._consumed_same = (self._consumed_same + 1
                                    if seq == self._consumed_seq else 0)
             self._consumed_seq = seq
-            other = jnp.asarray(buf)
+            other = self._deserialize_buf(buf)
             params, state, fused = self._step_avg_jit(params, grads, state,
                                                       other)
             self.averaged_steps += 1

@@ -365,8 +365,7 @@ class TestAsyncPairAveraging:
         from kungfu_tpu.optimizers.async_sgd import _ModelPuller
 
         kw.setdefault("min_interval", 0.0)
-        return _ModelPuller(peer, "m", np.dtype(np.float32), 8,
-                            lambda: 1, **kw)
+        return _ModelPuller(peer, "m", 32, lambda: 1, **kw)
 
     def test_puller_lands_and_reuses(self):
         import time
@@ -494,7 +493,7 @@ class TestAsyncPairAveraging:
         peer = _FakePullPeer()
         from kungfu_tpu.optimizers.async_sgd import _ModelPuller
 
-        p = _ModelPuller(peer, "m", np.dtype(np.float32), 4, lambda: 1,
+        p = _ModelPuller(peer, "m", 16, lambda: 1,
                          min_interval=30.0)  # one landing, then silence
         p.start()
         try:
@@ -636,5 +635,56 @@ class TestAsyncPairAveraging:
             for o in opts[:2]:
                 o.close()
             for p in peers[:2]:
+                p.close()
+            reset_local_store()
+
+    def test_bf16_wire_gossip(self):
+        """fuse_dtype=bfloat16 halves gossip wire bytes; the whole
+        store/serve/registered-receive chain must survive an ml_dtypes
+        dtype that does not export the buffer protocol (the model
+        travels as raw uint8 views)."""
+        import threading
+
+        from kungfu_tpu.optimizers import AsyncPairAveragingOptimizer
+        from kungfu_tpu.peer import Peer
+        from kungfu_tpu.plan import Cluster, PeerList
+        from kungfu_tpu.store.store import reset_local_store
+        from kungfu_tpu.utils.envs import Config
+
+        reset_local_store()
+        workers = PeerList.parse("127.0.0.1:24031,127.0.0.1:24032")
+        cluster = Cluster(PeerList.parse("127.0.0.1:38084"), workers)
+        peers = [Peer(Config(self_id=workers[i], cluster=cluster))
+                 for i in range(2)]
+        for p in peers:
+            p.start()
+        opts = []
+        try:
+            opts = [AsyncPairAveragingOptimizer(
+                optax.sgd(0.0), peer=p, selector="roundrobin",
+                fuse_dtype=jnp.bfloat16) for p in peers]
+            params = [{"w": jnp.zeros(64, jnp.float32)},
+                      {"w": jnp.ones(64, jnp.float32) * 2.0}]
+            states = [None, None]
+
+            def init_one(i):
+                states[i] = opts[i].init(params[i])
+
+            ts = [threading.Thread(target=init_one, args=(i,))
+                  for i in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=30)
+            grads = {"w": jnp.zeros(64, jnp.float32)}
+            p0, _ = opts[0].step(params[0], grads, states[0])
+            np.testing.assert_allclose(
+                np.asarray(p0["w"], np.float32), np.ones(64), rtol=1e-2)
+            # 64 params x 2 bytes on the wire per landed model
+            assert opts[0].pull_bytes % 128 == 0 and opts[0].pull_bytes > 0
+        finally:
+            for o in opts:
+                o.close()
+            for p in peers:
                 p.close()
             reset_local_store()
