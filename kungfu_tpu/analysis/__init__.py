@@ -1,0 +1,25 @@
+"""kf-lint: project-invariant static analysis for the kungfu-tpu tree.
+
+Four AST/structural checkers enforce invariants that code review kept
+missing (see docs/lint.md for the catalog and suppression syntax):
+
+* ``env-contract``  — every ``KF_*`` env read (Python and C++) appears in
+  the :mod:`kungfu_tpu.utils.envs` registry, and every registry entry has
+  a reader (:mod:`kungfu_tpu.analysis.envcheck`).
+* ``jit-sync``      — no host-sync / side-effect calls inside
+  ``@jax.jit``/``pmap``/``shard_map`` bodies or their direct callees
+  (:mod:`kungfu_tpu.analysis.jitpurity`).
+* ``blocking-io``   — no timeout-less blocking calls in modules that run
+  background threads (:mod:`kungfu_tpu.analysis.blockingio`).
+* ``lock-discipline`` — every write to a ``// guarded_by(<mutex>)``
+  C++ field happens in a scope holding that mutex
+  (:mod:`kungfu_tpu.analysis.lockcheck`).
+
+This package is intentionally stdlib-only (no jax/numpy import) so
+``scripts/kflint`` runs in any environment, including bare CI images.
+"""
+
+from kungfu_tpu.analysis.core import Violation, repo_root
+from kungfu_tpu.analysis.cli import CHECKERS, run_checkers
+
+__all__ = ["Violation", "repo_root", "CHECKERS", "run_checkers"]

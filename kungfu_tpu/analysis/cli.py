@@ -1,0 +1,69 @@
+"""kflint entry point: run the project checkers, print, exit nonzero.
+
+Usage (via ``scripts/kflint``)::
+
+    kflint                  # all checkers over the repo
+    kflint --checker jit-sync --checker env-contract
+    kflint --root /path/to/tree
+    kflint --list
+
+Exit code 0 = clean, 1 = violations, 2 = usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Dict, List, Optional, Sequence
+
+from kungfu_tpu.analysis import blockingio, envcheck, jitpurity, lockcheck
+from kungfu_tpu.analysis.core import Violation, repo_root
+
+CHECKERS: Dict[str, object] = {
+    envcheck.CHECKER: envcheck.check,
+    jitpurity.CHECKER: jitpurity.check,
+    blockingio.CHECKER: blockingio.check,
+    lockcheck.CHECKER: lockcheck.check,
+}
+
+
+def run_checkers(root: Optional[str] = None,
+                 names: Optional[Sequence[str]] = None) -> List[Violation]:
+    """All violations from the selected checkers (default: all four)."""
+    root = root or repo_root()
+    out: List[Violation] = []
+    for name in names or CHECKERS:
+        out.extend(CHECKERS[name](root))
+    return sorted(out, key=lambda v: (v.path, v.line, v.checker))
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="kflint", description="kungfu-tpu project-invariant linter")
+    p.add_argument("--root", default=None,
+                   help="tree to lint (default: auto-detected repo root)")
+    p.add_argument("--checker", action="append", choices=sorted(CHECKERS),
+                   help="run only this checker (repeatable)")
+    p.add_argument("--list", action="store_true",
+                   help="list available checkers and exit")
+    args = p.parse_args(argv)
+    if args.list:
+        for name in sorted(CHECKERS):
+            print(name)
+        return 0
+    try:
+        violations = run_checkers(args.root, args.checker)
+    except Exception as e:  # noqa: BLE001 - CLI surface
+        print(f"kflint: internal error: {e}", file=sys.stderr)
+        return 2
+    for v in violations:
+        print(v.render())
+    n = len(violations)
+    checkers = args.checker or sorted(CHECKERS)
+    print(f"kflint: {n} violation(s) [{', '.join(checkers)}]",
+          file=sys.stderr)
+    return 1 if n else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,100 @@
+"""Shared plumbing for the kf-lint checkers.
+
+A checker is a callable ``check(root) -> list[Violation]``.  Suppression
+is per-line: a trailing ``# kflint: allow(<rule>)`` (Python) or
+``// kflint: allow(<rule>)`` (C++) comment on the flagged line silences
+that rule there — and ONLY there, so every waiver is visible in the diff
+that introduces it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Set
+
+#: directories under the repo root that the tree-wide checkers scan
+PY_SCAN_DIRS = ("kungfu_tpu", "scripts", "benchmarks")
+
+_SUPPRESS_RE = re.compile(r"(?:#|//)\s*kflint:\s*allow\(([a-z0-9_,\s-]+)\)")
+
+
+@dataclass(frozen=True)
+class Violation:
+    checker: str
+    path: str  # repo-root relative
+    line: int  # 1-based
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.checker}] {self.message}"
+
+
+def repo_root(start: str = None) -> str:
+    """The tree to lint: the directory holding the ``kungfu_tpu``
+    package (walks up from ``start`` / this file)."""
+    d = os.path.abspath(start or os.path.dirname(__file__))
+    while True:
+        if os.path.isdir(os.path.join(d, "kungfu_tpu")):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            raise RuntimeError("cannot locate repo root (no kungfu_tpu/)")
+        d = parent
+
+
+def iter_py_files(root: str, dirs: Iterable[str] = PY_SCAN_DIRS) -> Iterable[str]:
+    for base in dirs:
+        top = os.path.join(root, base)
+        if not os.path.isdir(top):
+            continue
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def iter_cpp_files(root: str) -> Iterable[str]:
+    native = os.path.join(root, "kungfu_tpu", "native")
+    if not os.path.isdir(native):
+        return
+    for fn in sorted(os.listdir(native)):
+        if fn.endswith((".cpp", ".cc", ".h", ".hpp")):
+            yield os.path.join(native, fn)
+
+
+def read_lines(path: str) -> List[str]:
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        return f.read().splitlines()
+
+
+def suppressions(lines: List[str]) -> Dict[int, Set[str]]:
+    """``{1-based line: {rule, ...}}`` for every kflint allow comment."""
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(lines, 1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return out
+
+
+def suppressed(supp: Dict[int, Set[str]], line: int, rule: str) -> bool:
+    rules = supp.get(line)
+    return bool(rules) and (rule in rules or "all" in rules)
+
+
+def relpath(root: str, path: str) -> str:
+    return os.path.relpath(path, root).replace(os.sep, "/")
+
+
+def terminal_name(node: ast.AST) -> Optional[str]:
+    """The rightmost identifier of a Name/Attribute chain:
+    ``jax.lax.psum`` -> "psum", ``shard_map`` -> "shard_map", else None."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None

@@ -1,0 +1,217 @@
+"""jit-purity checker: no host syncs or side effects inside jitted code.
+
+Inside a function that is jit-compiled — decorated with (or passed to)
+``jax.jit`` / ``pmap`` / ``shard_map``, including the
+``functools.partial(jax.jit, ...)`` form — and inside module-local
+functions it calls (one level deep), flag the classic host-round-trip
+and side-effect calls:
+
+* ``.item()`` / ``.tolist()`` / ``.block_until_ready()``
+* ``float(x)`` / ``int(x)`` on non-static values (shape/len/ndim/size
+  arithmetic is static under trace and stays legal)
+* ``np.asarray`` / ``np.array`` (device→host copy mid-trace)
+* ``print`` (tracer leak; use ``jax.debug.print``)
+* ``time.time()`` / ``time.monotonic()`` / ``time.perf_counter()``
+  (traces to a constant — a silent correctness bug)
+
+Host round-trips in jitted code are exactly the cost the cross-replica
+weight-update sharding work (arXiv:2004.13336) shows dominating update
+time at pod scale; a checker keeps them out structurally.  Suppress a
+deliberate sync with ``# kflint: allow(jit-sync)`` on the line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from kungfu_tpu.analysis.core import (
+    Violation,
+    iter_py_files,
+    read_lines,
+    relpath,
+    suppressed,
+    suppressions,
+    terminal_name as _terminal_name,
+)
+
+CHECKER = "jit-sync"
+
+_JIT_NAMES = {"jit", "pmap", "shard_map"}
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_STATIC_MARKERS = {"shape", "ndim", "size", "len", "dtype", "itemsize", "nbytes"}
+
+
+def _jit_wrapper_name(call_or_deco: ast.AST) -> Optional[str]:
+    """The jit-family name if this decorator/callee is one, unwrapping
+    ``functools.partial(jax.jit, ...)``."""
+    node = call_or_deco
+    if isinstance(node, ast.Call):
+        fname = _terminal_name(node.func)
+        if fname == "partial" and node.args:
+            inner = _terminal_name(node.args[0])
+            if inner in _JIT_NAMES:
+                return inner
+        if fname in _JIT_NAMES:
+            return fname
+        return None
+    name = _terminal_name(node)
+    return name if name in _JIT_NAMES else None
+
+
+class _ModuleIndex(ast.NodeVisitor):
+    """All function defs in a module + which ones enter jit scope."""
+
+    def __init__(self) -> None:
+        # name -> ALL defs carrying it: names repeat across scopes in
+        # this tree (every trainer has a `body`/`step`), and scanning
+        # only the first def would silently pass a sync in the others
+        self.funcs: Dict[str, List[ast.AST]] = {}
+        self.jitted: Set[str] = set()
+        self.np_aliases: Set[str] = set()
+        self.time_aliases: Set[str] = set()
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            if a.name == "numpy":
+                self.np_aliases.add(a.asname or "numpy")
+            if a.name == "time":
+                self.time_aliases.add(a.asname or "time")
+        self.generic_visit(node)
+
+    def _visit_func(self, node) -> None:
+        self.funcs.setdefault(node.name, []).append(node)
+        for deco in node.decorator_list:
+            if _jit_wrapper_name(deco):
+                self.jitted.add(node.name)
+        self.generic_visit(node)
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # call form: jax.jit(fn) / shard_map(body, mesh=...) — possibly
+        # nested, jit(shard_map(fn, ...)); mark every local function
+        # threaded through a jit-family wrapper
+        if _jit_wrapper_name(node):
+            queue = list(node.args[:1])
+            while queue:
+                arg = queue.pop()
+                if isinstance(arg, ast.Call) and _jit_wrapper_name(arg):
+                    queue.extend(arg.args[:1])
+                else:
+                    name = _terminal_name(arg)
+                    if name:
+                        self.jitted.add(name)
+        self.generic_visit(node)
+
+
+def _is_static_expr(node: ast.AST) -> bool:
+    """Shape arithmetic and other trace-time constants: legal under jit."""
+    if isinstance(node, ast.Constant):
+        return True
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in _STATIC_MARKERS:
+            return True
+        if isinstance(sub, ast.Call) and _terminal_name(sub.func) == "len":
+            return True
+    return False
+
+
+class _BodyScan(ast.NodeVisitor):
+    def __init__(self, index: _ModuleIndex, depth: int) -> None:
+        self.index = index
+        self.depth = depth  # 0 = the jitted function, 1 = direct callee
+        self.hits: List[tuple] = []  # (line, message)
+        self.callees: Set[str] = set()
+
+    def _flag(self, node: ast.AST, what: str) -> None:
+        self.hits.append((node.lineno, what))
+
+    def visit_FunctionDef(self, node) -> None:
+        # nested defs share the trace; keep scanning
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        name = _terminal_name(fn)
+        if isinstance(fn, ast.Attribute):
+            if fn.attr in _SYNC_METHODS:
+                self._flag(node, f".{fn.attr}() forces a host sync")
+            base = _terminal_name(fn.value)
+            if base in self.index.np_aliases and fn.attr in ("asarray", "array"):
+                self._flag(node, f"{base}.{fn.attr}() copies device→host mid-trace")
+            if base in self.index.time_aliases and fn.attr in (
+                "time", "monotonic", "perf_counter",
+            ):
+                self._flag(
+                    node,
+                    f"{base}.{fn.attr}() traces to a constant (stale clock)",
+                )
+        elif isinstance(fn, ast.Name):
+            if name == "print":
+                self._flag(node, "print() in jitted code (use jax.debug.print)")
+            elif name in ("float", "int") and node.args:
+                if not _is_static_expr(node.args[0]):
+                    self._flag(
+                        node,
+                        f"{name}() on a traced value forces a host sync",
+                    )
+            elif (
+                self.depth == 0
+                and name in self.index.funcs
+                and name not in self.index.jitted
+            ):
+                self.callees.add(name)
+        self.generic_visit(node)
+
+
+def _scan_file(root: str, path: str) -> List[Violation]:
+    src = open(path, encoding="utf-8", errors="replace").read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Violation(CHECKER, relpath(root, path), e.lineno or 1,
+                          f"syntax error prevents analysis: {e.msg}")]
+    index = _ModuleIndex()
+    index.visit(tree)
+    if not index.jitted:
+        return []
+    lines = read_lines(path)
+    supp = suppressions(lines)
+    out: List[Violation] = []
+    seen: Set[tuple] = set()
+
+    def run(fn_name: str, depth: int, via: Optional[str]) -> None:
+        # scan EVERY def of the name: which one the jit wrapper binds is
+        # scope-dependent, and a gate must over- rather than under-report
+        for node in index.funcs.get(fn_name, ()):
+            scan = _BodyScan(index, depth)
+            for stmt in node.body:
+                scan.visit(stmt)
+            for line, what in scan.hits:
+                key = (fn_name, line, what)
+                if key in seen or suppressed(supp, line, CHECKER):
+                    continue
+                seen.add(key)
+                ctx = f" (called from jitted {via})" if via else ""
+                out.append(Violation(
+                    CHECKER, relpath(root, path), line,
+                    f"in jit scope `{fn_name}`{ctx}: {what}",
+                ))
+            if depth == 0:
+                for callee in sorted(scan.callees):
+                    run(callee, 1, fn_name)
+
+    for fn_name in sorted(index.jitted):
+        run(fn_name, 0, None)
+    return out
+
+
+def check(root: str) -> List[Violation]:
+    out: List[Violation] = []
+    for path in iter_py_files(root):
+        out.extend(_scan_file(root, path))
+    return out

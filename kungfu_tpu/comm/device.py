@@ -48,7 +48,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from kungfu_tpu.utils.jaxcompat import axis_size, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kungfu_tpu.plan.cluster import Cluster
@@ -728,4 +728,4 @@ def _flat_index():
     """Global peer index inside shard_map over the 2-D mesh."""
     h = jax.lax.axis_index(HOST_AXIS)
     l = jax.lax.axis_index(LOCAL_AXIS)
-    return h * jax.lax.axis_size(LOCAL_AXIS) + l
+    return h * axis_size(LOCAL_AXIS) + l

@@ -124,7 +124,10 @@ class _Msg:
 def _read_exact(sock: socket.socket, n: int) -> bytes:
     buf = b""
     while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
+        # connection-lifetime reader: each stream thread blocks here for
+        # as long as its peer keeps the connection; close() shutdown()s
+        # the socket, which unblocks this recv with b""
+        chunk = sock.recv(n - len(buf))  # kflint: allow(blocking-io)
         if not chunk:
             raise ConnectionError("peer closed mid-message")
         buf += chunk

@@ -78,7 +78,10 @@ def prefetch_to_device(it: Iterable, size: int = 2,
     def gen():
         try:
             while True:
-                item = q.get()
+                # consumer-side wait: the worker always terminates the
+                # stream (sentinel or exception object), so an unbounded
+                # block here ends exactly when the producer does
+                item = q.get()  # kflint: allow(blocking-io)
                 if item is _SENTINEL:
                     return
                 if isinstance(item, BaseException):

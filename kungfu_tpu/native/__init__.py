@@ -21,7 +21,27 @@ from typing import Optional
 import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_LIB_PATH = os.path.join(_HERE, "libkfnative.so")
+
+
+def _variant() -> str:
+    """Sanitizer build variant from ``KF_NATIVE_SANITIZE`` ("" = plain).
+
+    ``tsan``/``asan`` select the instrumented .so (separate output name
+    + flag stamp, so variants never mix).  The sanitizer RUNTIME must be
+    present at process start — run python under
+    ``LD_PRELOAD=libtsan.so.0`` (resp. ``libasan.so``) or use the
+    standalone ``kfstress-tsan`` binary; a bare dlopen of an
+    instrumented .so into an uninstrumented python aborts."""
+    v = os.environ.get("KF_NATIVE_SANITIZE", "").strip().lower()
+    return v if v in ("tsan", "asan") else ""
+
+
+def _lib_path() -> str:
+    v = _variant()
+    name = f"libkfnative-{v}.so" if v else "libkfnative.so"
+    return os.path.join(_HERE, name)
+
+
 
 _DTYPE_CODES = {
     np.dtype(np.uint8): 0,
@@ -54,6 +74,8 @@ _tried = False
 def _build() -> bool:
     march = os.environ.get("KF_NATIVE_MARCH")
     make_args = ["make", "-C", _HERE, "-s"]
+    if _variant():
+        make_args.append(_variant())
     if march:
         make_args.append(f"ARCHFLAGS=-march={march}")
     # cross-process build lock: N local workers race on first use; losers
@@ -70,7 +92,7 @@ def _build() -> bool:
                 )
             finally:
                 fcntl.flock(lockf, fcntl.LOCK_UN)
-        return os.path.exists(_LIB_PATH)
+        return os.path.exists(_lib_path())
     except (ImportError, OSError, subprocess.SubprocessError):
         return False
 
@@ -88,10 +110,10 @@ def load() -> Optional[ctypes.CDLL]:
             return None
         # make is dependency-aware, so always run it: a stale .so after a
         # reduce.cpp edit must be rebuilt, not silently loaded
-        if not _build() and not os.path.exists(_LIB_PATH):
+        if not _build() and not os.path.exists(_lib_path()):
             return None
         try:
-            lib = ctypes.CDLL(_LIB_PATH)
+            lib = ctypes.CDLL(_lib_path())
         except OSError:
             return None
         lib.kf_transform2.restype = ctypes.c_int

@@ -192,7 +192,13 @@ int run_16(uint16_t* dst, const uint16_t* src, size_t n, int32_t op) {
 // linker picks the widest one this CPU supports — no -march opt-in, no
 // SIGILL risk on heterogeneous shared-filesystem fleets (the Makefile
 // ARCHFLAGS concern).
-#if defined(__x86_64__) && defined(__GNUC__) && !defined(__clang__)
+// ... except under TSan/ASan: target_clones dispatches through IFUNC
+// resolvers, which the dynamic linker runs during relocation — BEFORE
+// the sanitizer runtime initializes — and that segfaults at startup.
+// Sanitizer builds take the portable loop; they exist to find races,
+// not to win benchmarks.
+#if defined(__x86_64__) && defined(__GNUC__) && !defined(__clang__) && \
+    !defined(__SANITIZE_THREAD__) && !defined(__SANITIZE_ADDRESS__)
 #define KF_SIMD_CLONES \
   __attribute__((target_clones("default", "avx2", "avx512f")))
 #else

@@ -18,6 +18,7 @@
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
 #include <sys/types.h>
@@ -40,6 +41,19 @@
 #include <vector>
 
 namespace {
+
+// Timed condition waits pick their clock per build: libstdc++ lowers
+// steady_clock waits to pthread_cond_clockwait, which GCC 10's TSan does
+// NOT intercept — the hidden unlock/relock inside the wait corrupts
+// TSan's lock-ownership model and floods the run with bogus "double
+// lock" / missing happens-before reports.  Sanitizer builds therefore
+// wait on the (intercepted) system clock; production keeps the
+// jump-proof steady clock.
+#if defined(__SANITIZE_THREAD__)
+using wait_clock = std::chrono::system_clock;
+#else
+using wait_clock = std::chrono::steady_clock;
+#endif
 
 constexpr uint32_t kMagic = 0x4B465450;  // "KFTP"
 constexpr int kConnPing = 1;
@@ -328,29 +342,30 @@ struct QueueKey {
 struct PoolEntry {
     std::mutex mu;      // serializes senders; held across connect retries
     std::mutex fd_mu;   // guards fd open/close handoff; never held long
-    int fd = -1;
+    int fd_ = -1;       // guarded_by(fd_mu)
     // ::close happens only under fd_mu (or in the destructor, when the
     // last shared_ptr holder is by construction the only thread left);
     // reset_connections only ever shutdown()s under fd_mu, so it can
     // neither race a sender's close nor hit a kernel-recycled fd number
     ~PoolEntry() {
-        if (fd >= 0) { ::close(fd); }
+        if (fd_ >= 0) { ::close(fd_); }
     }
     void retire_fd() {
         std::lock_guard<std::mutex> lk(fd_mu);
-        if (fd >= 0) {
-            ::close(fd);
-            fd = -1;
+        if (fd_ >= 0) {
+            ::close(fd_);
+            fd_ = -1;
         }
     }
     void install_fd(int new_fd) {
         std::lock_guard<std::mutex> lk(fd_mu);
-        fd = new_fd;
+        fd_ = new_fd;
     }
 };
 
 struct ConnSlot {
-    int fd = -1;
+    int stream_fd_ = -1;  // guarded_by(conns_mu_)  (writes; the stream
+                          // loop reads its own fd lock-free by design)
     std::thread thread;
     std::atomic<bool> done{false};
 };
@@ -364,8 +379,11 @@ struct RegBuf {
     uint32_t cap;
     uint32_t got = 0;
     // 0 waiting, 1 filled, 2 failed (conn dropped mid-read), 3 claimed
-    // (stream thread is writing into buf — the owner must not return)
-    int state = 0;
+    // (stream thread is writing into buf — the owner must not return).
+    // While the RegBuf is REACHABLE through the regbufs_ map, state
+    // transitions happen under q_mu_; once deregistered it is owned by
+    // a single frame again.
+    int state = 0;  // guarded_by(q_mu_)
 };
 
 class Channel {
@@ -404,6 +422,13 @@ class Channel {
             unix_path_ = unix_sock_path(self_host_, port);
             if (unix_path_.empty()) { use_unix_ = false; }
         }
+        // close_all() wakes blocked accept()s through this pipe: the
+        // shutdown(listen_fd) trick only works for TCP listeners — a
+        // blocked accept on an AF_UNIX listener is NOT woken by
+        // shutdown on Linux, which left close_all() hanging forever
+        // whenever the unix listener was idle (found by the TSan churn
+        // stress).  accept loops poll {listener, wake_pipe} instead.
+        if (::pipe(wake_pipe_) != 0) { wake_pipe_[0] = wake_pipe_[1] = -1; }
         if (use_unix_) {
             ::unlink(unix_path_.c_str());
             unix_listen_fd_ = ::socket(AF_UNIX, SOCK_STREAM, 0);
@@ -475,9 +500,15 @@ class Channel {
             }
             cv_.notify_all();
         }
-        // shutdown wakes the blocked accept(); the close waits until the
-        // accept thread has exited so the loop can never accept() on an
-        // fd number the kernel recycled for another socket
+        // wake the accept loops (pipe write covers the AF_UNIX listener,
+        // which shutdown() does not wake; shutdown stays as belt and
+        // braces for the TCP one), then wait until both threads have
+        // exited so the loop can never accept() on an fd number the
+        // kernel recycled for another socket
+        if (wake_pipe_[1] >= 0) {
+            char one = 1;
+            (void)!::write(wake_pipe_[1], &one, 1);
+        }
         ::shutdown(listen_fd_, SHUT_RDWR);
         if (unix_listen_fd_ >= 0) { ::shutdown(unix_listen_fd_, SHUT_RDWR); }
         if (accept_thread_.joinable()) { accept_thread_.join(); }
@@ -491,23 +522,33 @@ class Channel {
         {
             std::lock_guard<std::mutex> lk(conns_mu_);
             for (auto &slot : conns_) {
-                if (slot->fd >= 0) { ::shutdown(slot->fd, SHUT_RDWR); }
+                if (slot->stream_fd_ >= 0) { ::shutdown(slot->stream_fd_, SHUT_RDWR); }
             }
         }
-        // stream loops close their own fds on exit; join them all
+        // stream loops close their own fds on exit; join them all.
+        // After the joins every thread that could touch conns_ (the
+        // accept loops and the stream loops themselves) has exited, so
+        // the clear is provably single-threaded — lock-free by design:
         for (auto &slot : conns_) {
             if (slot->thread.joinable()) { slot->thread.join(); }
         }
-        conns_.clear();
+        conns_.clear();  // kflint: allow(lock-discipline)
         reset_connections_impl();  // running_ is false; the gated public
         // entry would refuse, but the pool must still be torn down
         listen_fd_ = -1;
+        for (int i = 0; i < 2; ++i) {
+            if (wake_pipe_[i] >= 0) {
+                ::close(wake_pipe_[i]);
+                wake_pipe_[i] = -1;
+            }
+        }
         // a blocked receiver woke with rc=2 (closed); wait until every
         // recv call AND every other in-flight API entry has actually
         // left before the caller may delete us
         std::unique_lock<std::mutex> lk(q_mu_);
         while (recv_inflight_ != 0 || api_inflight_ != 0) {
-            if (cv_.wait_for(lk, std::chrono::milliseconds(200)) ==
+            if (cv_.wait_until(lk, wait_clock::now() +
+                                       std::chrono::milliseconds(200)) ==
                 std::cv_status::timeout) {
                 // re-sweep: shut down any pool fd a racing send managed
                 // to install anyway, so its blocked writev unblocks and
@@ -571,18 +612,18 @@ class Channel {
             entry->install_fd(fd);
             return true;
         };
-        if (entry->fd < 0) {
+        if (entry->fd_ < 0) {
             int fd = connect_retry(host, port, retries);
             if (fd < 0 || !install_open(fd)) { return -1; }
         }
-        if (!writev_all(entry->fd, head.data(), head.size(), payload, len)) {
+        if (!writev_all(entry->fd_, head.data(), head.size(), payload, len)) {
             // stale pooled socket (peer restarted): reconnect once.
             // retire before the (potentially long) reconnect so a
             // concurrent reset_connections sees fd=-1, not a dead number
             entry->retire_fd();
             int fd = connect_retry(host, port, retries);
             if (fd < 0 || !install_open(fd)) { return -1; }
-            if (!writev_all(entry->fd, head.data(), head.size(), payload, len)) {
+            if (!writev_all(entry->fd_, head.data(), head.size(), payload, len)) {
                 entry->retire_fd();
                 return -1;
             }
@@ -608,9 +649,9 @@ class Channel {
             }
         } guard{this};
         auto deadline =
-            std::chrono::steady_clock::now() +
-            (forever ? std::chrono::steady_clock::duration::zero()
-                     : std::chrono::duration_cast<std::chrono::steady_clock::duration>(
+            wait_clock::now() +
+            (forever ? wait_clock::duration::zero()
+                     : std::chrono::duration_cast<wait_clock::duration>(
                            std::chrono::duration<double>(timeout_s)));
         for (;;) {
             auto it = queues_.find(key);
@@ -660,11 +701,12 @@ class Channel {
             it->second.pop_front();
             // copy outside q_mu_ (an MB-scale memcpy under the global
             // queue lock would stall every stream thread); rb is not in
-            // the map, so no other thread can touch it
+            // the map, so no other thread can touch it — single-owner
+            // writes, deliberately outside the lock:
             lk.unlock();
             std::memcpy(rb->buf, payload.data(), payload.size());
             rb->got = rb->cap;
-            rb->state = 1;
+            rb->state = 1;  // kflint: allow(lock-discipline)
             return 0;
         }
         if (!regbufs_.emplace(key, rb).second) { return -3; }
@@ -706,9 +748,9 @@ class Channel {
             }
         } guard{this};
         auto deadline =
-            std::chrono::steady_clock::now() +
-            (forever ? std::chrono::steady_clock::duration::zero()
-                     : std::chrono::duration_cast<std::chrono::steady_clock::duration>(
+            wait_clock::now() +
+            (forever ? wait_clock::duration::zero()
+                     : std::chrono::duration_cast<wait_clock::duration>(
                            std::chrono::duration<double>(timeout_s)));
         auto deregister = [&] {
             auto it = regbufs_.find(key);
@@ -777,9 +819,9 @@ class Channel {
             }
         } guard{this};
         auto deadline =
-            std::chrono::steady_clock::now() +
-            (forever ? std::chrono::steady_clock::duration::zero()
-                     : std::chrono::duration_cast<std::chrono::steady_clock::duration>(
+            wait_clock::now() +
+            (forever ? wait_clock::duration::zero()
+                     : std::chrono::duration_cast<wait_clock::duration>(
                            std::chrono::duration<double>(timeout_s)));
         RegBuf rb{buf, cap};
         bool registered = false;
@@ -878,7 +920,7 @@ class Channel {
         // the last shared_ptr holder (PoolEntry destructor)
         for (auto &e : entries) {
             std::lock_guard<std::mutex> lk(e->fd_mu);
-            if (e->fd >= 0) { ::shutdown(e->fd, SHUT_RDWR); }
+            if (e->fd_ >= 0) { ::shutdown(e->fd_, SHUT_RDWR); }
         }
     }
 
@@ -933,6 +975,19 @@ class Channel {
 
     void accept_loop(int lfd, bool is_tcp) {
         while (running_.load()) {
+            // poll before accept: the wake pipe is the portable shutdown
+            // signal (a blocked accept on an AF_UNIX listener survives
+            // shutdown()); the byte is never drained, so one write wakes
+            // both accept loops
+            struct pollfd pfds[2];
+            pfds[0].fd = lfd;
+            pfds[0].events = POLLIN;
+            pfds[1].fd = wake_pipe_[0];
+            pfds[1].events = POLLIN;
+            int nfds = wake_pipe_[0] >= 0 ? 2 : 1;
+            int pr = ::poll(pfds, nfds, wake_pipe_[0] >= 0 ? -1 : 200);
+            if (!running_.load()) { return; }
+            if (pr <= 0 || (pfds[0].revents & POLLIN) == 0) { continue; }
             int fd = ::accept(lfd, nullptr, nullptr);
             if (fd < 0) {
                 if (!running_.load()) { return; }
@@ -957,7 +1012,7 @@ class Channel {
                     }
                 }
                 auto slot = std::make_shared<ConnSlot>();
-                slot->fd = fd;
+                slot->stream_fd_ = fd;
                 slot->thread = std::thread([this, slot] { stream_loop(slot.get()); });
                 conns_.push_back(std::move(slot));
             }
@@ -975,17 +1030,17 @@ class Channel {
         try {
             Msg m;
             uint32_t plen = 0;
-            while (running_.load() && decode_head(slot->fd, m, plen)) {
+            while (running_.load() && decode_head(slot->stream_fd_, m, plen)) {
                 bool consumed = false;
-                if (!read_payload(slot->fd, m, plen, consumed)) { break; }
-                if (!consumed) { dispatch(m, slot->fd); }
+                if (!read_payload(slot->stream_fd_, m, plen, consumed)) { break; }
+                if (!consumed) { dispatch(m, slot->stream_fd_); }
             }
         } catch (...) {
         }
         {
             std::lock_guard<std::mutex> lk(conns_mu_);
-            ::close(slot->fd);
-            slot->fd = -1;
+            ::close(slot->stream_fd_);
+            slot->stream_fd_ = -1;
         }
         // done flips only after the fd is retired; the accept loop joins
         // (reaps) exclusively done slots, so it never blocks on a thread
@@ -1080,32 +1135,33 @@ class Channel {
     bool use_unix_ = false;
     int listen_fd_ = -1;
     int unix_listen_fd_ = -1;
+    int wake_pipe_[2] = {-1, -1};  // close_all -> accept_loop wakeup
     std::string unix_path_;
     std::thread accept_thread_;
     std::thread unix_accept_thread_;
 
     std::mutex conns_mu_;
-    std::vector<std::shared_ptr<ConnSlot>> conns_;
+    std::vector<std::shared_ptr<ConnSlot>> conns_;  // guarded_by(conns_mu_)
 
     std::mutex q_mu_;
     std::condition_variable cv_;
-    std::map<QueueKey, std::deque<std::string>> queues_;
-    std::map<QueueKey, RegBuf *> regbufs_;  // guarded by q_mu_; borrowed ptrs
-    int recv_inflight_ = 0;  // guarded by q_mu_
+    std::map<QueueKey, std::deque<std::string>> queues_;  // guarded_by(q_mu_)
+    std::map<QueueKey, RegBuf *> regbufs_;  // guarded_by(q_mu_)  borrowed ptrs
+    int recv_inflight_ = 0;  // guarded_by(q_mu_)
     // in-flight count for API entries NOT covered by recv_inflight_
     // (send / recv_register / recv_cancel / ping / ...): close_all()
     // drains BOTH before the caller may delete the channel — a thread
     // still inside send() while another thread closed the channel was
     // a use-after-free (gossip puller vs. peer teardown).  Guarded by
     // q_mu_ (see ApiGuard for why atomicity alone is not enough).
-    int api_inflight_ = 0;
+    int api_inflight_ = 0;  // guarded_by(q_mu_)
 
     std::mutex pool_mu_;
-    std::map<std::string, std::shared_ptr<PoolEntry>> pool_;
+    std::map<std::string, std::shared_ptr<PoolEntry>> pool_;  // guarded_by(pool_mu_)
 
     std::mutex stats_mu_;
-    std::map<std::string, uint64_t> ingress_;
-    std::map<std::string, uint64_t> egress_;
+    std::map<std::string, uint64_t> ingress_;  // guarded_by(stats_mu_)
+    std::map<std::string, uint64_t> egress_;   // guarded_by(stats_mu_)
 
     msg_cb control_cb_ = nullptr;
     msg_cb p2p_cb_ = nullptr;

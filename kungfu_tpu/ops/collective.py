@@ -14,6 +14,8 @@ from typing import Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from kungfu_tpu.utils.jaxcompat import axis_size
+
 Axis = Union[str, Tuple[str, ...]]
 
 
@@ -23,16 +25,16 @@ def peer_rank(axis: Axis):
         return jax.lax.axis_index(axis)
     idx = jnp.int32(0)
     for a in axis:
-        idx = idx * jax.lax.axis_size(a) + jax.lax.axis_index(a)
+        idx = idx * axis_size(a) + jax.lax.axis_index(a)
     return idx
 
 
 def peer_size(axis: Axis) -> int:
     if isinstance(axis, str):
-        return jax.lax.axis_size(axis)
+        return axis_size(axis)
     n = 1
     for a in axis:
-        n *= jax.lax.axis_size(a)
+        n *= axis_size(a)
     return n
 
 

@@ -9,21 +9,38 @@ evolves.
 
 from __future__ import annotations
 
+import inspect
+
 import jax
+
+from kungfu_tpu.utils.jaxcompat import pcast_varying, typeof
+
+#: whether this jax's ShapeDtypeStruct takes the ``vma`` kwarg (0.4.x
+#: predates vma typing entirely)
+_SDS_HAS_VMA = "vma" in inspect.signature(jax.ShapeDtypeStruct.__init__).parameters
+
+
+def sds(shape, dtype, vma=frozenset()):
+    """``jax.ShapeDtypeStruct`` declaring varying manual axes where the
+    running jax supports them; the plain struct otherwise (pre-vma jax
+    has no varying types for the out_shape to disagree with)."""
+    if _SDS_HAS_VMA and vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def vma_of(*operands) -> frozenset:
     """Union of the operands' varying manual axes (empty outside
-    ``shard_map``)."""
+    ``shard_map``, and always empty on pre-vma jax)."""
     vs = set()
     for o in operands:
-        vs |= set(getattr(jax.typeof(o), "vma", ()) or ())
+        vs |= set(getattr(typeof(o), "vma", ()) or ())
     return frozenset(vs)
 
 
 def match_vma(t, vma: frozenset):
     """Mark ``t`` varying over any axes in ``vma`` it doesn't carry yet
     (no-op for axes already varying — pcast rejects varying→varying)."""
-    cur = set(getattr(jax.typeof(t), "vma", ()) or ())
+    cur = set(getattr(typeof(t), "vma", ()) or ())
     missing = tuple(a for a in vma if a not in cur)
-    return jax.lax.pcast(t, missing, to="varying") if missing else t
+    return pcast_varying(t, missing)

@@ -65,6 +65,8 @@ _LANES = 128
 
 from kungfu_tpu.ops.pallas._sharding import match_vma as _match_vma
 from kungfu_tpu.ops.pallas._sharding import vma_of as _vma
+from kungfu_tpu.ops.pallas._sharding import sds as _sds
+from kungfu_tpu.utils.jaxcompat import tpu_compiler_params
 
 
 def _causal_hi(qi, block_q, block_k):
@@ -173,15 +175,15 @@ def _fwd_call(q, k, v, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s_pad, d), q.dtype, vma=_vma(q, k, v)),
-            jax.ShapeDtypeStruct((bh, s_pad, _LANES), jnp.float32, vma=_vma(q, k, v)),
+            _sds((bh, s_pad, d), q.dtype, vma=_vma(q, k, v)),
+            _sds((bh, s_pad, _LANES), jnp.float32, vma=_vma(q, k, v)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),   # running max m
             pltpu.VMEM((block_q, 1), jnp.float32),   # running sum l
             pltpu.VMEM((block_q, d), jnp.float32),   # output accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -385,10 +387,10 @@ def _bwd_pallas(q, k, v, out, lse, dout, causal, block_q, block_k, interpret,
         grid=(bh, n_q, n_k),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
         out_specs=[q_spec],
-        out_shape=[jax.ShapeDtypeStruct((bh, s_pad, d), q.dtype,
+        out_shape=[_sds((bh, s_pad, d), q.dtype,
                                         vma=_vma(q, k, v, dout))],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -424,14 +426,14 @@ def _bwd_pallas(q, k, v, out, lse, dout, causal, block_q, block_k, interpret,
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s_pad, d), q.dtype, vma=_vma(q, k, v, dout)),
-            jax.ShapeDtypeStruct((bh, s_pad, d), q.dtype, vma=_vma(q, k, v, dout)),
+            _sds((bh, s_pad, d), q.dtype, vma=_vma(q, k, v, dout)),
+            _sds((bh, s_pad, d), q.dtype, vma=_vma(q, k, v, dout)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

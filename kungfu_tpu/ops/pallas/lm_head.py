@@ -42,6 +42,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from kungfu_tpu.ops.pallas._sharding import vma_of as _vma
+from kungfu_tpu.ops.pallas._sharding import sds as _sds
+from kungfu_tpu.utils.jaxcompat import tpu_compiler_params
 
 DEFAULT_BLOCK_N = 256
 DEFAULT_BLOCK_V = 1024
@@ -194,9 +196,9 @@ def _fwd_call(h, w, targets, block_n, block_v, interpret):
         ],
         out_specs=[row, row],
         out_shape=[
-            jax.ShapeDtypeStruct((n_pad, _LANES), jnp.float32,
+            _sds((n_pad, _LANES), jnp.float32,
                                  vma=_vma(h, w, targets)),
-            jax.ShapeDtypeStruct((n_pad, _LANES), jnp.float32,
+            _sds((n_pad, _LANES), jnp.float32,
                                  vma=_vma(h, w, targets)),
         ],
         scratch_shapes=[
@@ -204,7 +206,7 @@ def _fwd_call(h, w, targets, block_n, block_v, interpret):
             pltpu.VMEM((block_n, 1), jnp.float32),
             pltpu.VMEM((block_n, 1), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -235,10 +237,10 @@ def _bwd_call(h, w, targets, lse, g, block_n, block_v, interpret):
             row, row, row,
         ],
         out_specs=pl.BlockSpec((block_n, d_pad), lambda i, j: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((n_pad, d_pad), h.dtype,
+        out_shape=_sds((n_pad, d_pad), h.dtype,
                                        vma=_vma(h, w, targets, lse, g)),
         scratch_shapes=[pltpu.VMEM((block_n, d_pad), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -255,10 +257,10 @@ def _bwd_call(h, w, targets, lse, g, block_n, block_v, interpret):
             row_dw, row_dw, row_dw,
         ],
         out_specs=pl.BlockSpec((d_pad, block_v), lambda j, i: (0, j)),
-        out_shape=jax.ShapeDtypeStruct((d_pad, v_pad), w.dtype,
+        out_shape=_sds((d_pad, v_pad), w.dtype,
                                        vma=_vma(h, w, targets, lse, g)),
         scratch_shapes=[pltpu.VMEM((d_pad, block_v), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,

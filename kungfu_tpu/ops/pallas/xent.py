@@ -29,6 +29,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from kungfu_tpu.ops.pallas._sharding import vma_of as _vma
+from kungfu_tpu.ops.pallas._sharding import sds as _sds
+from kungfu_tpu.utils.jaxcompat import tpu_compiler_params
 
 #: measured on TPU v5e (docs/perf.md): (256, 2048) tiles run the fwd+bwd
 #: sweep ~1.5x faster than the round-3 (128, 512) defaults — big enough
@@ -113,15 +115,15 @@ def _fwd_call(logits, targets, block_n, block_v, interpret):
         ],
         out_specs=[row, row],
         out_shape=[
-            jax.ShapeDtypeStruct((n_pad, _LANES), jnp.float32, vma=_vma(logits, targets)),
-            jax.ShapeDtypeStruct((n_pad, _LANES), jnp.float32, vma=_vma(logits, targets)),
+            _sds((n_pad, _LANES), jnp.float32, vma=_vma(logits, targets)),
+            _sds((n_pad, _LANES), jnp.float32, vma=_vma(logits, targets)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_n, 1), jnp.float32),
             pltpu.VMEM((block_n, 1), jnp.float32),
             pltpu.VMEM((block_n, 1), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -196,9 +198,9 @@ def _bwd_pallas(logits, targets, lse, g, block_n, block_v, interpret):
             row, row, row,
         ],
         out_specs=pl.BlockSpec((block_n, block_v), lambda i, j: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((n_pad, v_pad), logits.dtype,
+        out_shape=_sds((n_pad, v_pad), logits.dtype,
                                        vma=_vma(logits, targets, lse, g)),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             # stateless per tile: both grid dims are parallel
             dimension_semantics=("parallel", "parallel"),
         ),

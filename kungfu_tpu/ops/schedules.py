@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from kungfu_tpu.utils.jaxcompat import axis_size
+
 Axis = Union[str, Tuple[str, ...]]
 
 #: selectable device-plane allreduce schedules
@@ -82,7 +84,7 @@ def _ring_all_reduce_leaf(a, axis_name: str, op: str):
     rank r owns the fully reduced chunk (r+1) mod n, which then travels
     the ring unreduced for n-1 more steps.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if n == 1:
         return a
     idx = lax.axis_index(axis_name)
@@ -117,7 +119,7 @@ def _two_stage_all_reduce_leaf(a, axis_name: str, op: str):
     """Explicit reduce-scatter + all-gather.  ``psum_scatter`` is
     sum-only; min/max fall back to the ring schedule (same explicit
     two-phase shape, correct op)."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if n == 1:
         return a
     if op in ("min", "max"):
@@ -161,7 +163,7 @@ def all_reduce_scheduled(x, axis: Axis, op: str = "sum",
     base = "sum" if op == "mean" else op
 
     def leaf(a):
-        sizes = [lax.axis_size(ax) for ax in axes]
+        sizes = [axis_size(ax) for ax in axes]
         real = [ax for ax, s in zip(axes, sizes) if s > 1] or [axes[0]]
         for ax in real[1:]:  # inner (intra-host) stages: one-hop psum
             a = _PSUM_FOLD[base](a, ax)

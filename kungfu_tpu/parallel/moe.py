@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from kungfu_tpu.models import nn
+from kungfu_tpu.utils.jaxcompat import axis_size
 
 
 def moe_init(key, n_experts_local: int, d_model: int, d_ff: int, n_experts_global: int):
@@ -54,7 +55,7 @@ def moe_apply(
     xt = x.reshape(-1, D)
     T = xt.shape[0]
     E = n_experts_global
-    ep = 1 if axis is None else jax.lax.axis_size(axis)
+    ep = 1 if axis is None else axis_size(axis)
 
     logits = (xt.astype(jnp.float32) @ params["gate"]["w"].astype(jnp.float32))
     probs = jax.nn.softmax(logits, axis=-1)  # [T, E]

@@ -28,6 +28,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from kungfu_tpu.utils.jaxcompat import axis_size
+
 
 def ring_attention(q, k, v, causal: bool = True, axis: str = "sp",
                    block_impl: str = "auto"):
@@ -64,7 +66,7 @@ def _ring_flash(q, k, v, causal: bool, axis: str):
     from kungfu_tpu.ops.pallas._sharding import match_vma
     from kungfu_tpu.ops.pallas.attention import flash_attention_with_lse
 
-    n_sp = jax.lax.axis_size(axis)
+    n_sp = axis_size(axis)
     my_blk = jax.lax.axis_index(axis)
     B, H, S, D = q.shape
     q3 = q.reshape(B * H, S, D)
@@ -125,7 +127,7 @@ def _ring_flash(q, k, v, causal: bool, axis: str):
 
 def _ring_einsum(q, k, v, causal: bool, axis: str):
     """jnp online-softmax ring fold (the original implementation)."""
-    n_sp = jax.lax.axis_size(axis)
+    n_sp = axis_size(axis)
     my_blk = jax.lax.axis_index(axis)
     B, H, S, D = q.shape
     scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
@@ -161,8 +163,11 @@ def _ring_einsum(q, k, v, causal: bool, axis: str):
 
     def vary(x):
         # mark the accumulators as varying over the ring axis so the scan
-        # carry type matches (jax>=0.9 varying-manual-axes typing)
-        return jax.lax.pcast(x, (axis,), to="varying")
+        # carry type matches (jax>=0.9 varying-manual-axes typing;
+        # identity on 0.4.x, which has no vma types to match)
+        from kungfu_tpu.utils.jaxcompat import pcast_varying
+
+        return pcast_varying(x, (axis,))
 
     m0 = vary(jnp.full((B, H, S), -jnp.inf, jnp.float32))
     l0 = vary(jnp.zeros((B, H, S), jnp.float32))

@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax import shard_map
+from kungfu_tpu.utils.jaxcompat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from kungfu_tpu.models import nn

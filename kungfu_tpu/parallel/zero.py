@@ -42,7 +42,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax import lax, shard_map
+from jax import lax
+
+from kungfu_tpu.utils.jaxcompat import axis_size, shard_map
 from jax.sharding import PartitionSpec as P
 
 from kungfu_tpu.ops.fuse import defuse, fuse
@@ -99,7 +101,7 @@ def zero1_train_step(loss_fn, inner: optax.GradientTransformation, comm,
         def my_offset():
             off, seg = jnp.int32(0), padded
             for ax in scatter_axes:
-                seg = seg // lax.axis_size(ax)
+                seg = seg // axis_size(ax)
                 off = off + lax.axis_index(ax) * seg
             return off
 

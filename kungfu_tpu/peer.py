@@ -564,9 +564,10 @@ class Peer:
         targets = (new_cluster.runners
                    if self.cluster.workers.rank(self.config.self_id) == 0
                    else [self.config.parent])
+        wait_s = envs.parse_float_env(envs.WAIT_RUNNER_TIMEOUT, 10.0)
         for runner in targets:
             try:
-                self._channel.wait(runner, timeout=10)
+                self._channel.wait(runner, timeout=wait_s)
                 self._channel.send(runner, "update", stage, ConnType.CONTROL)
             except (TimeoutError, ConnectionError) as e:
                 _log.warning("cannot notify runner %s: %s", runner, e)

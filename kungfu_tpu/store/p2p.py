@@ -84,7 +84,9 @@ def install_p2p_handler(channel: HostChannel, store=None,
 
     def responder():
         while True:
-            item = serve_q.get()
+            # sentinel-terminated worker loop: stop() enqueues one None
+            # per thread, so the forever-block is the shutdown protocol
+            item = serve_q.get()  # kflint: allow(blocking-io)
             if item is None:
                 return
             try:

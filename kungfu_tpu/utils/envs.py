@@ -39,7 +39,8 @@ Tuning envs (read anywhere, any time):
 ``KF_CONFIG_ENABLE_STALL_DETECTION`` "true"/"false"
 ``KF_CONFIG_LOG_LEVEL``            DEBUG/INFO/WARN/ERROR
 ``KF_CONFIG_STRATEGY_HASH_METHOD`` chunk→strategy hash: "simple"|"name"
-``KF_CONFIG_WAIT_RUNNER_TIMEOUT``  seconds, default 30
+``KF_CONFIG_WAIT_RUNNER_TIMEOUT``  s to wait for a runner before a resize
+                                   notification is dropped, default 10
 ``KF_CONFIG_CHUNK_SIZE``           engine chunk bytes; default 1 MiB,
                                    or 256 KiB when all peers share one
                                    host (measured, engine.py).  Must be
@@ -48,7 +49,67 @@ Tuning envs (read anywhere, any time):
 ``KF_CONFIG_ENGINE_THREADS``       native executor threads, default
                                    min(8, cores)
 ``KF_CONFIG_ENGINE_TIMEOUT``       per-collective timeout s, default 60
+``KF_CONFIG_ENABLE_TRACE``         truthy: log scope entry depth +
+                                   duration (utils/trace.py)
+``KF_CONFIG_P2P_RESPONDERS``       p2p blob responder pool size,
+                                   default 2 (store/p2p.py)
+``KF_CONFIG_USE_AFFINITY``         truthy: partition host cores between
+                                   colocated workers (utils/affinity.py)
+``KF_CONFIG_WATCH_GRACE``          runner natural-end grace window s,
+                                   default 10 (runner/watch.py)
 =================================  ============================================
+
+Transport / native-runtime envs:
+
+=============================  ================================================
+``KF_TPU_HOST_TRANSPORT``      host channel backend: "auto"|"native"|"python"
+                               (comm/host.py)
+``KF_TPU_USE_UNIXSOCK``        "0" disables the colocated-peer unix sockets;
+                               default on (comm/host.py)
+``KF_SOCK_DIR``                unix sockfile directory override; default
+                               /tmp/kf-tpu-<uid> (comm/host.py AND
+                               native/transport.cpp — keep in lockstep)
+``KF_TPU_NO_NATIVE``           "1" skips the native .so entirely (numpy +
+                               python-transport fallbacks, native/__init__.py)
+``KF_NATIVE_ENGINE``           "0"/"false"/"no" disables the fully-native
+                               collective executor; default on (comm/engine.py)
+``KF_NATIVE_MARCH``            build the native .so with -march=<value>
+                               (homogeneous clusters only; native/__init__.py)
+``KF_NATIVE_SANITIZE``         "tsan"|"asan": load the sanitizer-instrumented
+                               native build variant (libkfnative-<v>.so) for
+                               race/memory debugging (native/__init__.py)
+``KF_MONITOR_ADDR``            failure-detector endpoint workers report to
+                               (monitor/signals.py; set by the runner)
+=============================  ================================================
+
+Kernel / model / data selection envs:
+
+=============================  ================================================
+``KF_JAX_PLATFORM``            jax platform for workers ("cpu"|"tpu"|...);
+                               runner sets "cpu" for local clusters (peer.py)
+``KF_DATA_DIR``                dataset cache root, default ~/.cache/kungfu_tpu
+                               (datasets/cache.py)
+``KF_TPU_CKPT_BACKEND``        checkpoint backend: "auto"|"orbax"|"npz"
+                               (checkpoint.py)
+``KF_TPU_ATTN``                attention impl: "auto"|"flash"|"plain"
+                               (models/transformer.py)
+``KF_TPU_LM_HEAD``             lm-head impl: "auto"|"fused"|"plain"
+                               (models/transformer.py)
+``KF_TPU_XENT``                cross-entropy impl: "auto"|"fused"|"plain"|
+                               "xla" (ops/pallas/xent.py)
+``KF_TPU_BN_COMPUTE``          "f32" restores legacy f32 batch-norm compute
+                               (models/nn.py)
+``KF_PALLAS_BWD``              "pallas" forces the pallas backward kernels
+                               even under interpret mode (ops/pallas)
+``KF_XENT_FWD_MIN_ELEMENTS``   min logits elements before the fused xent
+                               forward engages (ops/pallas/xent.py)
+``KF_XENT_XLA_BUDGET_MB``      logits-bytes budget under which plain XLA
+                               xent is preferred (ops/pallas/xent.py)
+=============================  ================================================
+
+Not an env var (registered so the ``KF_*`` contract scan covers C++):
+``KF_SIMD_CLONES`` is a compile-time macro in native/reduce.cpp selecting
+per-ISA function cloning.
 """
 
 from __future__ import annotations

@@ -1,0 +1,82 @@
+"""Version shims for the jax API surface this tree targets.
+
+The codebase is written against the modern jax surface (``jax.shard_map``
+with ``check_vma``, ``jax.typeof`` with ``.vma``, ``jax.lax.pcast``),
+but deployment environments pin older releases (0.4.x) where the same
+functionality lives under ``jax.experimental.shard_map`` with
+``check_rep`` and values carry no varying-manual-axes type at all.
+Every module imports the symbols from here so the skew is absorbed in
+one place; when the minimum jax is raised this file shrinks to
+re-exports.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:  # modern surface (jax >= 0.6 exports it at top level)
+    from jax import shard_map as _shard_map
+
+    _MODERN_SHARD_MAP = True
+except ImportError:  # 0.4.x: experimental home, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _MODERN_SHARD_MAP = False
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+    """``jax.shard_map`` with the ``check_vma`` kwarg accepted on every
+    jax version (mapped to 0.4.x's ``check_rep``, which gates the same
+    replication/varying analysis under its old name).
+
+    On 0.4.x the check defaults OFF: this tree satisfies the modern
+    checker via ``vma=`` declarations on pallas ``out_shape``s, but
+    0.4.x's ``check_rep`` has no replication rule for ``pallas_call``
+    at all and rejects any kernel-bearing body outright.  Modern jax
+    keeps its default (fully checked)."""
+    if check_vma is not None:
+        kw["check_vma" if _MODERN_SHARD_MAP else "check_rep"] = check_vma
+    elif not _MODERN_SHARD_MAP:
+        kw["check_rep"] = False
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kw)
+
+
+def typeof(x):
+    """``jax.typeof`` where it exists; the abstract value otherwise.
+    0.4.x avals carry no ``vma`` attribute — callers read it with
+    ``getattr(..., "vma", ())`` so the absence means "varies over
+    nothing", which is exactly 0.4.x semantics (no vma typing)."""
+    t = getattr(jax, "typeof", None)
+    if t is not None:
+        return t(x)
+    return jax.core.get_aval(x)
+
+
+def tpu_compiler_params(**kw):
+    """``pallas.tpu.CompilerParams`` under its current name (0.4.x calls
+    the same dataclass ``TPUCompilerParams``)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kw)
+
+
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` where it exists; on 0.4.x the classic
+    ``psum(1, axis)`` idiom, which jax folds to a concrete int for
+    non-tracer operands (so ``range(axis_size(ax))`` stays legal)."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def pcast_varying(x, axes):
+    """``lax.pcast(x, axes, to="varying")`` on jax versions with vma
+    typing; identity on 0.4.x, where no value carries a varying type and
+    the cast has nothing to record."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is None or not axes:
+        return x
+    return pcast(x, tuple(axes), to="varying")

@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# Pre-merge gate: the cheap, hermetic checks that must pass before any
+# test run is worth starting.  Used locally and as the first CI stage.
+#
+#   scripts/check.sh
+#
+# 1. kflint        — the four project-invariant checkers (docs/lint.md)
+# 2. compileall    — every .py parses/compiles on this interpreter
+# 3. flag stamps   — no sanitizer flags leaked into the production
+#                    .buildflags stamp (variants must never mix)
+set -euo pipefail
+
+ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$ROOT"
+fail=0
+
+echo "== kflint"
+if ! python3 scripts/kflint; then
+    fail=1
+fi
+
+echo "== compileall"
+if ! python3 -m compileall -q kungfu_tpu scripts benchmarks examples tests; then
+    fail=1
+fi
+
+echo "== native build-stamp check"
+# the production stamp must never carry sanitizer flags — that would
+# mean a tsan/asan .so is about to be (re)used as the production lib
+for stamp in kungfu_tpu/native/.buildflags; do
+    if [ -f "$stamp" ] && grep -q "fsanitize" "$stamp"; then
+        echo "ERROR: $stamp contains sanitizer flags: $(cat "$stamp")"
+        fail=1
+    fi
+done
+# and the variant stamps, when present, must carry exactly their own
+if [ -f kungfu_tpu/native/.buildflags-tsan ] \
+    && ! grep -q "fsanitize=thread" kungfu_tpu/native/.buildflags-tsan; then
+    echo "ERROR: .buildflags-tsan lost -fsanitize=thread"
+    fail=1
+fi
+if [ -f kungfu_tpu/native/.buildflags-asan ] \
+    && ! grep -q "fsanitize=address" kungfu_tpu/native/.buildflags-asan; then
+    echo "ERROR: .buildflags-asan lost -fsanitize=address"
+    fail=1
+fi
+
+if [ "$fail" -ne 0 ]; then
+    echo "check.sh: FAILED"
+    exit 1
+fi
+echo "check.sh: all gates green"

@@ -1,0 +1,45 @@
+"""Fixture: seeded blocking-io violations (never imported by the app)."""
+
+import queue
+import socket
+import threading
+import urllib.request
+
+work_q: "queue.Queue" = queue.Queue(maxsize=4)
+free_q: "queue.Queue" = queue.Queue()  # unbounded: put() never blocks
+
+
+def worker():
+    while True:
+        item = work_q.get()               # VIOLATION: no timeout
+        ok = work_q.get(timeout=1.0)      # ok
+        allowed = work_q.get()  # kflint: allow(blocking-io)
+        free_q.put(item)                  # ok: unbounded queue
+        work_q.put(ok)                    # VIOLATION: bounded, no timeout
+        del allowed
+
+
+def fetch(url):
+    return urllib.request.urlopen(url)    # VIOLATION: no timeout
+
+
+def fetch_bounded(url):
+    return urllib.request.urlopen(url, timeout=3.0)  # ok
+
+
+def serve(listen_sock: socket.socket):
+    conn, _ = listen_sock.accept()        # VIOLATION: no deadline
+    data = conn.recv(4096)                # VIOLATION: no settimeout
+    return data
+
+
+def positional_forms():
+    a = work_q.get(False)                 # ok: non-blocking positional
+    b = work_q.get(True, 5.0)             # ok: positional timeout
+    c = work_q.get(True)                  # VIOLATION: blocks forever
+    work_q.put(a, False)                  # ok: non-blocking positional
+    work_q.put(b, True, 2.0)              # ok: positional timeout
+    return c
+
+
+threading.Thread(target=worker, daemon=True)

@@ -1,0 +1,7 @@
+"""Fixture: seeded env-contract violations (never imported by the app)."""
+
+import os
+
+registered = os.environ.get("KF_SELF_SPEC")            # ok: in registry
+rogue = os.environ.get("KF_TOTALLY_UNREGISTERED_KNOB")  # VIOLATION
+allowed = os.environ.get("KF_WAIVED_KNOB")  # kflint: allow(env-contract)

@@ -1,0 +1,44 @@
+"""Fixture: seeded jit-purity violations (never imported by the app)."""
+
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def bad_step(x):
+    v = float(x)                      # VIOLATION: host sync
+    print("loss", v)                  # VIOLATION: side effect
+    t = time.time()                   # VIOLATION: traces to a constant
+    y = np.asarray(x)                 # VIOLATION: device->host copy
+    z = x.item()                      # VIOLATION: host sync
+    n = int(x.shape[0])               # ok: static under trace
+    allowed = x.item()  # kflint: allow(jit-sync)
+    return y + z + t + n + v + helper(x) + allowed
+
+
+def helper(x):
+    return x.tolist()                 # VIOLATION: one level deep
+
+
+def make_step():
+    # call-form wrapping must be tracked too
+    return jax.jit(_body)
+
+
+def _body(x):
+    x.block_until_ready()             # VIOLATION: call-form jit
+    return x
+
+
+def outer_clean():
+    def shared_name(x):
+        return x + 1
+    return shared_name
+
+
+def outer_dirty():
+    def shared_name(x):
+        return float(x.sum())         # VIOLATION: same-named nested def
+    return jax.jit(shared_name)

@@ -1,0 +1,53 @@
+// Fixture: seeded lock-discipline violations (never compiled).
+#include <mutex>
+#include <vector>
+
+class Counter {
+  public:
+    void good() {
+        std::lock_guard<std::mutex> lk(mu_);
+        count_ += 1;  // ok: mu_ held
+        items_.push_back(count_);
+    }
+
+    void good_nested() {
+        std::lock_guard<std::mutex> lk(mu_);
+        if (count_ > 0) {
+            count_ -= 1;  // ok: mu_ held in enclosing scope
+        }
+    }
+
+    void bad_unlocked() {
+        count_ = 0;  // VIOLATION: no lock
+        items_.clear();  // VIOLATION: no lock
+    }
+
+    void bad_wrong_lock() {
+        std::lock_guard<std::mutex> lk(other_mu_);
+        ++count_;  // VIOLATION: holds other_mu_, not mu_
+    }
+
+    void allowed_single_threaded() {
+        count_ = -1;  // kflint: allow(lock-discipline)
+    }
+
+    void bad_unlock_window() {
+        std::unique_lock<std::mutex> lk(mu_);
+        lk.unlock();
+        count_ = 7;  // VIOLATION: written in the unlock window
+        lk.lock();
+        count_ = 8;  // ok: relocked
+    }
+
+    void ok_unlock_and_return() {
+        std::unique_lock<std::mutex> lk(mu_);
+        if (count_ > 0) { lk.unlock(); return; }
+        count_ = 9;  // ok: the unlocking branch returned
+    }
+
+  private:
+    std::mutex mu_;
+    std::mutex other_mu_;
+    int count_ = 0;                 // guarded_by(mu_)
+    std::vector<int> items_;        // guarded_by(mu_)
+};

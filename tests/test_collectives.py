@@ -12,6 +12,7 @@ import pytest
 
 from kungfu_tpu.comm import Communicator
 from kungfu_tpu.plan import Cluster, HostList
+from kungfu_tpu.utils.jaxcompat import shard_map
 
 
 def make_comm(local_size=None):
@@ -308,7 +309,7 @@ class TestInJitOps:
             return s + 0 * r  # rank used to prove it traces
 
         f = jax.jit(
-            jax.shard_map(
+            shard_map(
                 step, mesh=comm.mesh, in_specs=P(comm.axis), out_specs=P(comm.axis)
             )
         )
@@ -322,7 +323,7 @@ class TestInJitOps:
 
         x = stacked((4,))
         f = jax.jit(
-            jax.shard_map(
+            shard_map(
                 lambda v: ops.broadcast(v, axis=comm.axis, root=2),
                 mesh=comm.mesh, in_specs=P(comm.axis), out_specs=P(comm.axis),
             )

@@ -1,0 +1,135 @@
+"""kf-lint in tier-1: the tree must be clean, and the checkers must
+actually catch what they claim to catch (fixtures under
+tests/lint_fixtures/ seed known violations).
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+from kungfu_tpu.analysis import blockingio, envcheck, jitpurity, lockcheck
+from kungfu_tpu.analysis.cli import run_checkers
+from kungfu_tpu.analysis.core import repo_root
+
+ROOT = repo_root(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(ROOT, "tests", "lint_fixtures")
+
+MINI_REGISTRY = '''"""Mini env registry for lint fixtures.
+
+=================  ===========================
+``KF_SELF_SPEC``   this worker's ``host:port``
+=================  ===========================
+"""
+'''
+
+
+def _tmp_tree(tmp_path, files):
+    """Build a minimal repo layout: {relpath: source or fixture name}."""
+    for rel, content in files.items():
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        if os.path.exists(os.path.join(FIXTURES, str(content))):
+            shutil.copy(os.path.join(FIXTURES, str(content)), dst)
+        else:
+            dst.write_text(content)
+    return str(tmp_path)
+
+
+class TestTreeIsClean:
+    def test_all_checkers_clean_on_tree(self):
+        """THE tier-1 gate: every project invariant holds on every run."""
+        violations = run_checkers(ROOT)
+        assert violations == [], "\n".join(v.render() for v in violations)
+
+    def test_cli_exit_zero_on_tree(self):
+        rc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "scripts", "kflint")],
+            capture_output=True, timeout=120,
+        )
+        assert rc.returncode == 0, rc.stdout.decode() + rc.stderr.decode()
+
+
+class TestJitPurity:
+    def test_fixture_violations_caught(self, tmp_path):
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": "jit_sync_bad.py"})
+        got = {(v.line, v.message.split(": ", 1)[1]) for v in jitpurity.check(root)}
+        lines = {line for line, _ in got}
+        assert lines == {11, 12, 13, 14, 15, 22, 31, 43}, sorted(got)
+        # the suppressed .item() (line 17) must NOT appear
+        assert all("allow" not in m for _, m in got)
+
+    def test_one_level_deep_attribution(self, tmp_path):
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": "jit_sync_bad.py"})
+        deep = [v for v in jitpurity.check(root) if v.line == 22]
+        assert len(deep) == 1
+        assert "called from jitted bad_step" in deep[0].message
+
+
+class TestBlockingIO:
+    def test_fixture_violations_caught(self, tmp_path):
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": "blocking_io_bad.py"})
+        lines = sorted(v.line for v in blockingio.check(root))
+        assert lines == [14, 18, 23, 31, 32, 39], lines
+
+    def test_non_threaded_module_out_of_scope(self, tmp_path):
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/mod.py":
+                "import urllib.request\n"
+                "data = urllib.request.urlopen('http://x')\n",
+        })
+        assert blockingio.check(root) == []
+
+
+class TestLockDiscipline:
+    def test_fixture_violations_caught(self, tmp_path):
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/native/bad.cpp": "lock_bad.cpp"})
+        got = sorted((v.line, v.message.split(" ")[2].strip("`"))
+                     for v in lockcheck.check(root))
+        assert [line for line, _ in got] == [21, 22, 27, 37], got
+
+    def test_wrong_mutex_is_reported(self, tmp_path):
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/native/bad.cpp": "lock_bad.cpp"})
+        wrong = [v for v in lockcheck.check(root) if v.line == 27]
+        assert wrong and "other_mu_" in wrong[0].message
+
+
+class TestEnvContract:
+    def test_unregistered_read_and_suppression(self, tmp_path):
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/utils/envs.py": MINI_REGISTRY,
+            "kungfu_tpu/mod.py": "env_bad.py",
+        })
+        got = envcheck.check(root)
+        assert len(got) == 1, [v.render() for v in got]
+        assert "KF_TOTALLY_UNREGISTERED_KNOB" in got[0].message
+
+    def test_dead_registry_entry(self, tmp_path):
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/utils/envs.py":
+                MINI_REGISTRY.replace(
+                    "=================  ===========================\n\"\"\"",
+                    "``KF_NEVER_READ``  orphaned entry\n"
+                    "=================  ===========================\n\"\"\"",
+                ),
+            "kungfu_tpu/mod.py":
+                "import os\nx = os.environ.get('KF_SELF_SPEC')\n",
+        })
+        got = envcheck.check(root)
+        assert len(got) == 1, [v.render() for v in got]
+        assert "KF_NEVER_READ" in got[0].message
+        assert "nothing in the tree reads it" in got[0].message
+
+    def test_seeding_a_real_module_fails_the_gate(self, tmp_path):
+        """Acceptance: a drifted KF_* read in a real module flips the
+        suite red (simulated on a copied slice of the real tree)."""
+        real = open(os.path.join(ROOT, "kungfu_tpu", "utils", "trace.py")).read()
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/utils/envs.py":
+                open(os.path.join(ROOT, "kungfu_tpu", "utils", "envs.py")).read(),
+            "kungfu_tpu/utils/trace.py":
+                real + "\n_drift = __import__('os').environ.get('KF_SEEDED_DRIFT')\n",
+        })
+        got = envcheck.check(root)
+        assert any("KF_SEEDED_DRIFT" in v.message for v in got), \
+            [v.render() for v in got]

@@ -1,0 +1,98 @@
+"""Sanitizer build variants + the TSan transport churn stress.
+
+The fast tests verify the Makefile variant plumbing (separate outputs,
+separate flag stamps, loader selection).  The slow test builds the
+fully-instrumented ``kfstress-tsan`` binary and runs channel
+open/send/close churn under ThreadSanitizer, asserting a clean report —
+this is the gate that caught the AF_UNIX accept-loop close hang and the
+clockwait/TSan interception pitfall (see native/transport.cpp).
+"""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "kungfu_tpu", "native",
+)
+
+_toolchain = pytest.mark.skipif(
+    shutil.which("make") is None or shutil.which("g++") is None,
+    reason="no C++ toolchain",
+)
+
+
+def _tsan_supported() -> bool:
+    """Probe once whether -fsanitize=thread links on this host."""
+    probe = subprocess.run(
+        ["g++", "-fsanitize=thread", "-x", "c++", "-", "-o", "/dev/null"],
+        input=b"int main(){return 0;}", capture_output=True, timeout=60,
+    )
+    return probe.returncode == 0
+
+
+@_toolchain
+class TestSanitizerBuilds:
+    def test_variant_names_and_stamps(self, tmp_path):
+        rc = subprocess.run(
+            ["make", "-C", NATIVE_DIR, "-s", "tsan"],
+            capture_output=True, timeout=300,
+        )
+        if rc.returncode != 0:
+            pytest.skip(f"tsan build unsupported: {rc.stderr[-200:]!r}")
+        assert os.path.exists(os.path.join(NATIVE_DIR, "libkfnative-tsan.so"))
+        stamp = os.path.join(NATIVE_DIR, ".buildflags-tsan")
+        assert os.path.exists(stamp)
+        flags = open(stamp).read()
+        assert "-fsanitize=thread" in flags
+        # the production stamp must NOT mention sanitizers: variants are
+        # flag-stamped independently so they can never mix
+        plain = os.path.join(NATIVE_DIR, ".buildflags")
+        if os.path.exists(plain):
+            assert "-fsanitize" not in open(plain).read()
+
+    def test_loader_selects_variant_path(self):
+        from kungfu_tpu import native
+
+        old = os.environ.get("KF_NATIVE_SANITIZE")
+        try:
+            os.environ["KF_NATIVE_SANITIZE"] = "tsan"
+            assert native._lib_path().endswith("libkfnative-tsan.so")
+            os.environ["KF_NATIVE_SANITIZE"] = "asan"
+            assert native._lib_path().endswith("libkfnative-asan.so")
+            os.environ["KF_NATIVE_SANITIZE"] = "nonsense"
+            assert native._lib_path().endswith("libkfnative.so")
+            os.environ.pop("KF_NATIVE_SANITIZE")
+            assert native._lib_path().endswith("libkfnative.so")
+        finally:
+            if old is None:
+                os.environ.pop("KF_NATIVE_SANITIZE", None)
+            else:
+                os.environ["KF_NATIVE_SANITIZE"] = old
+
+
+@pytest.mark.slow
+@_toolchain
+class TestTSanStress:
+    def test_channel_churn_clean_under_tsan(self):
+        if not _tsan_supported():
+            pytest.skip("-fsanitize=thread not supported here")
+        rc = subprocess.run(
+            ["make", "-C", NATIVE_DIR, "-s", "stress"],
+            capture_output=True, timeout=300,
+        )
+        assert rc.returncode == 0, rc.stderr.decode()[-500:]
+        binary = os.path.join(NATIVE_DIR, "kfstress-tsan")
+        env = dict(os.environ,
+                   TSAN_OPTIONS="halt_on_error=0 exitcode=66",
+                   KF_SOCK_DIR="")
+        run = subprocess.run(
+            [binary, "4"], capture_output=True, timeout=480, env=env,
+        )
+        err = run.stderr.decode(errors="replace")
+        assert run.returncode == 0, f"stress rc={run.returncode}\n{err[-2000:]}"
+        assert "WARNING: ThreadSanitizer" not in err, err[-2000:]
+        assert "all rounds clean" in err

@@ -22,6 +22,7 @@ from kungfu_tpu.optimizers import (
     synchronous_averaging,
     synchronous_sgd,
 )
+from kungfu_tpu.utils.jaxcompat import shard_map
 
 N = 8
 
@@ -34,7 +35,7 @@ def comm():
 def per_peer(comm, fn):
     """shard_map a per-peer function over stacked inputs."""
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             fn,
             mesh=comm.mesh,
             in_specs=P(comm.axis),

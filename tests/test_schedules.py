@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from kungfu_tpu.utils.jaxcompat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from kungfu_tpu.ops.schedules import ALLREDUCE_SCHEDULES, all_reduce_scheduled
