@@ -1,6 +1,6 @@
 """kf-lint: project-invariant static analysis for the kungfu-tpu tree.
 
-Four AST/structural checkers enforce invariants that code review kept
+Five AST/structural checkers enforce invariants that code review kept
 missing (see docs/lint.md for the catalog and suppression syntax):
 
 * ``env-contract``  — every ``KF_*`` env read (Python and C++) appears in
@@ -14,6 +14,8 @@ missing (see docs/lint.md for the catalog and suppression syntax):
 * ``lock-discipline`` — every write to a ``// guarded_by(<mutex>)``
   C++ field happens in a scope holding that mutex
   (:mod:`kungfu_tpu.analysis.lockcheck`).
+* ``retry-discipline`` — network retry loops bound their attempts and
+  back off with jitter (:mod:`kungfu_tpu.analysis.retrydiscipline`).
 
 This package is intentionally stdlib-only (no jax/numpy import) so
 ``scripts/kflint`` runs in any environment, including bare CI images.

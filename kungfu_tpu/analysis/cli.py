@@ -16,7 +16,13 @@ import argparse
 import sys
 from typing import Dict, List, Optional, Sequence
 
-from kungfu_tpu.analysis import blockingio, envcheck, jitpurity, lockcheck
+from kungfu_tpu.analysis import (
+    blockingio,
+    envcheck,
+    jitpurity,
+    lockcheck,
+    retrydiscipline,
+)
 from kungfu_tpu.analysis.core import Violation, repo_root
 
 CHECKERS: Dict[str, object] = {
@@ -24,12 +30,13 @@ CHECKERS: Dict[str, object] = {
     jitpurity.CHECKER: jitpurity.check,
     blockingio.CHECKER: blockingio.check,
     lockcheck.CHECKER: lockcheck.check,
+    retrydiscipline.CHECKER: retrydiscipline.check,
 }
 
 
 def run_checkers(root: Optional[str] = None,
                  names: Optional[Sequence[str]] = None) -> List[Violation]:
-    """All violations from the selected checkers (default: all four)."""
+    """All violations from the selected checkers (default: all five)."""
     root = root or repo_root()
     out: List[Violation] = []
     for name in names or CHECKERS:

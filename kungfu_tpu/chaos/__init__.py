@@ -1,0 +1,54 @@
+"""Deterministic, seedable fault injection for the kungfu-tpu runtime.
+
+The chaos layer turns "we think shrink-to-survivors works" into a
+tier-1 assertion: faults that normally need a flaky multi-host repro —
+a worker dying mid-allreduce, a connection reset halfway through a
+chunk, a straggler, a lost detector fan-out, a config-server brownout —
+are injected at exact, reproducible points (Nth collective, Nth send,
+Nth fetch) controlled entirely by two env vars:
+
+``KF_CHAOS_SPEC``
+    The fault clauses (grammar in :mod:`kungfu_tpu.chaos.spec`).
+    Unset ⇒ every hook is a ``None``-check no-op and the wire behavior
+    is byte-identical to an injection-free build.
+``KF_CHAOS_SEED``
+    Seeds the (only) randomized perturbation, delay jitter.
+
+Hook sites: the collective engine's send/recv
+(:mod:`kungfu_tpu.comm.engine`), the Python host channel's frame writer
+(:meth:`~kungfu_tpu.comm.host.PyHostChannel.chaos_partial_send`), the
+failure detector's fan-out (:mod:`kungfu_tpu.monitor.detector`), the
+elastic config fetch (:mod:`kungfu_tpu.elastic.resize`), and the train
+loop's step announcement (:func:`note_step`, called by
+:func:`kungfu_tpu.elastic.hooks.elastic_step`).
+
+See :doc:`docs/fault_tolerance` for the failure model and the fault
+matrix.
+"""
+
+from kungfu_tpu.chaos.inject import (
+    DIE_EXIT_CODE,
+    ChaosController,
+    InjectedDeath,
+    InjectedReset,
+    SEED_ENV,
+    SPEC_ENV,
+    controller_for,
+    note_step,
+    reset,
+)
+from kungfu_tpu.chaos.spec import Clause, parse_spec
+
+__all__ = [
+    "DIE_EXIT_CODE",
+    "ChaosController",
+    "Clause",
+    "InjectedDeath",
+    "InjectedReset",
+    "SEED_ENV",
+    "SPEC_ENV",
+    "controller_for",
+    "note_step",
+    "parse_spec",
+    "reset",
+]

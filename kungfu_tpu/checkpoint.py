@@ -304,6 +304,149 @@ def wait_pending_checkpoints(timeout: Optional[float] = None) -> None:
         raise first_err
 
 
+# -- in-memory last-committed-step snapshot --------------------------------
+class StepSnapshot:
+    """The replay point for in-flight shrink recovery.
+
+    Disk checkpoints are epoch-grained and exist for *process-death*
+    recovery; shrink-to-survivors keeps the process alive, so it only
+    needs the last **committed step boundary** — params/opt-state as of
+    the last step every peer finished — held in host memory.  The train
+    loop calls :meth:`commit` after each applied step (a host copy of
+    the leaves, no device sync beyond the transfer, no file IO); after a
+    peer failure the survivors restore from :meth:`last` and re-run the
+    interrupted step over the shrunk cluster instead of restoring a disk
+    checkpoint from possibly many epochs ago.
+
+    Leaves are snapshotted with ``np.array`` (a copy) on commit **and**
+    on restore, so neither a later donated-buffer reuse nor the caller
+    mutating a restored tree can corrupt the held boundary.
+
+    Survivors of a shrink may hold *different* committed steps (the dead
+    peer can have fed some survivors before dying, letting them finish
+    the step the others lost) — :meth:`serialize`/:meth:`adopt` let the
+    recovery protocol broadcast the leader's boundary so every survivor
+    replays from ONE agreed (step, state), instead of livelocking on
+    mismatched rendezvous names (see ``elastic/shrink.py``).
+
+    A module-level default instance (:data:`step_snapshot`) serves the
+    common one-trainer-per-process case.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._step: Optional[int] = None
+        self._tree = None
+        self._meta: Optional[dict] = None
+
+    def commit(self, step: int, tree, meta: Optional[dict] = None) -> None:
+        """Record ``tree`` as the committed state *after* step ``step``."""
+        host_tree = jax.tree_util.tree_map(lambda l: np.array(l), tree)
+        with self._lock:
+            self._step = step
+            self._tree = host_tree
+            self._meta = dict(meta) if meta else {}
+
+    def last(self) -> Optional[Tuple[int, Any, dict]]:
+        """``(step, tree, meta)`` of the newest committed boundary, or
+        ``None`` when nothing was committed yet (caller falls back to the
+        disk-checkpoint restart path)."""
+        with self._lock:
+            if self._step is None:
+                return None
+            tree = jax.tree_util.tree_map(lambda l: np.array(l), self._tree)
+            return self._step, tree, dict(self._meta)
+
+    def step(self) -> Optional[int]:
+        with self._lock:
+            return self._step
+
+    def clear(self) -> None:
+        with self._lock:
+            self._step = None
+            self._tree = None
+            self._meta = None
+
+    # -- wire form (shrink-recovery replay-point agreement) ---------------
+    def serialize(self) -> bytes:
+        """Self-describing wire form of the committed boundary (``b""``
+        when empty): a JSON header (step, meta, per-leaf dtype-name +
+        shape) followed by the raw leaf bytes — raw, not ``.npz``, so an
+        ml_dtypes leaf (bfloat16) round-trips bit-exactly."""
+        snap = self.last()
+        if snap is None:
+            return b""
+        step, tree, meta = snap
+        leaves, _ = jax.tree_util.tree_flatten(tree)
+        arrs = [np.ascontiguousarray(l) for l in leaves]
+        head = json.dumps({
+            "step": step,
+            "meta": meta,
+            "leaves": [{"dtype": a.dtype.name, "shape": list(a.shape)}
+                       for a in arrs],
+        }).encode()
+        import struct
+
+        return b"".join(
+            [struct.pack("<I", len(head)), head] + [a.tobytes() for a in arrs]
+        )
+
+    def adopt(self, blob: bytes) -> Optional[Tuple[int, Any, dict]]:
+        """Replace this snapshot's boundary with a serialized one (the
+        shrink leader's) and return it as ``(step, tree, meta)`` — the
+        tree is rebuilt in THIS snapshot's committed structure, so the
+        caller must have committed at least once (the train loops that
+        reach shrink recovery have; a never-committed snapshot raises
+        ``ValueError`` and the caller falls back to no-replay)."""
+        if not blob:
+            return None
+        import struct
+
+        (hlen,) = struct.unpack_from("<I", blob)
+        off = 4
+        head = json.loads(blob[off:off + hlen].decode())
+        off += hlen
+        leaves = []
+        for spec in head["leaves"]:
+            dt = _np_dtype(spec["dtype"])
+            n = int(np.prod(spec["shape"], dtype=np.int64)) * dt.itemsize
+            leaves.append(
+                np.frombuffer(blob[off:off + n], dtype=dt)
+                .reshape(spec["shape"]).copy()
+            )
+            off += n
+        with self._lock:
+            if self._tree is None:
+                raise ValueError(
+                    "cannot adopt a replay point without a local committed "
+                    "structure to rebuild it in"
+                )
+            _, treedef = jax.tree_util.tree_flatten(self._tree)
+        if treedef.num_leaves != len(leaves):
+            raise ValueError(
+                f"replay point has {len(leaves)} leaves, local structure "
+                f"has {treedef.num_leaves} — peers run different models?"
+            )
+        tree = jax.tree_util.tree_unflatten(treedef, leaves)
+        self.commit(int(head["step"]), tree, head.get("meta") or {})
+        return self.last()
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """dtype from its ``.name`` — including ml_dtypes extension types
+    (``bfloat16``) that ``np.dtype(str)`` alone cannot resolve."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+#: default snapshot for the one-trainer-per-process case
+step_snapshot = StepSnapshot()
+
+
 def prune_checkpoints(ckpt_dir: str, keep: int = 3) -> None:
     if not os.path.isdir(ckpt_dir):
         return

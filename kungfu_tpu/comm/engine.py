@@ -28,8 +28,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from kungfu_tpu import native
-from kungfu_tpu.comm.host import ConnType, HostChannel
+from kungfu_tpu.chaos import controller_for as _chaos_controller_for
+from kungfu_tpu.comm.faults import PeerFailureError
+from kungfu_tpu.comm.host import CONNECT_TIMEOUT_S, ConnType, HostChannel
 from kungfu_tpu.utils import envs
+from kungfu_tpu.utils.retry import sleep_backoff
 from kungfu_tpu.utils.trace import trace_scope
 from kungfu_tpu.plan import (
     Strategy,
@@ -95,6 +98,27 @@ def engine_timeout_s() -> float:
     tunable past the old hardcoded 60 s."""
     return envs.parse_float_env(envs.ENGINE_TIMEOUT, 60.0)
 
+
+def peer_deadline_s() -> float:
+    """Per-peer deadline for one collective primitive
+    (``KF_CONFIG_PEER_DEADLINE`` seconds; default = the engine timeout).
+    A send/recv that cannot complete toward one peer within this window
+    raises :class:`PeerFailureError` carrying the suspect rank instead of
+    hanging — the entry point of the shrink-to-survivors recovery path
+    (see ``elastic/shrink.py``)."""
+    return envs.parse_float_env(envs.PEER_DEADLINE, engine_timeout_s())
+
+
+#: ceiling on the connect-ladder length handed to ``channel.send`` per
+#: retry attempt; the actual ladder is derived from the remaining
+#: per-peer deadline (see ``_send``), this just bounds the fast case
+_SEND_CONNECT_RETRIES = 10
+
+#: "caller did not choose a chaos identity" — distinct from an explicit
+#: ``None`` (= a late joiner with no bootstrap rank, which must use the
+#: rank-less controller like every other chaos hook does for it)
+_CHAOS_RANK_UNSET = object()
+
 REDUCE_OPS = native.REDUCE_OPS  # single source of op names
 
 
@@ -155,6 +179,7 @@ class CollectiveEngine:
         channel: HostChannel,
         peers: PeerList,
         strategy: Strategy = Strategy.AUTO,
+        chaos_rank=_CHAOS_RANK_UNSET,
     ):
         self.channel = channel
         self.peers = peers
@@ -173,6 +198,22 @@ class CollectiveEngine:
         self._hash_name_based = (
             os.environ.get(envs.STRATEGY_HASH_METHOD, "").strip().upper() == "NAME"
         )
+        #: fault injection (None unless KF_CHAOS_SPEC is set — the hot
+        #: path pays one attribute load + branch when disabled).
+        #: ``chaos_rank`` is the process's STABLE identity (its bootstrap
+        #: rank, Peer.chaos_rank()): a shrink promotes survivor ranks, and
+        #: a rank-scoped fault clause must not re-target the promoted
+        #: survivor of the very failure it injected.  An explicit ``None``
+        #: (a late joiner with no bootstrap rank) selects the rank-less
+        #: controller, matching every other chaos hook for that process;
+        #: engines built directly (tests) default to the current rank.
+        self._chaos = _chaos_controller_for(
+            self.rank if chaos_rank is _CHAOS_RANK_UNSET else chaos_rank
+        )
+        #: resolved once — _send/_recv run per chunk per peer, and a
+        #: per-call env parse on that path is measurable noise (engines
+        #: are rebuilt each mesh epoch, so retuning still lands)
+        self._peer_deadline = peer_deadline_s()
         self._seq = 0
         self._lock = threading.Lock()
         self._stats_lock = threading.Lock()  # guards stats/_window swaps
@@ -208,6 +249,7 @@ class CollectiveEngine:
         raises instead of silently downgrading."""
         if op not in REDUCE_OPS and op != "mean":
             raise ValueError(f"op {op!r}")
+        self._chaos_collective(name or "all_reduce")
         eff_op = "sum" if op == "mean" else op
         if inplace and not x.flags["WRITEABLE"]:
             raise ValueError("inplace=True requires a writable array")
@@ -231,7 +273,16 @@ class CollectiveEngine:
             return orig
         return out
 
+    def _chaos_collective(self, tag: str) -> None:
+        """Every public collective advances the injector's ``coll``
+        counter — ``die:coll=N`` means the Nth engine collective of any
+        kind, so an experiment against a loop that opens with a
+        parameter broadcast still dies where the spec says."""
+        if self._chaos is not None:
+            self._chaos.on_collective(tag)
+
     def broadcast(self, x: np.ndarray, root: int = 0, name: str = "") -> np.ndarray:
+        self._chaos_collective(name or "broadcast")
         with self._lock:
             seq = self._seq
             self._seq += 1
@@ -244,6 +295,7 @@ class CollectiveEngine:
     def reduce(self, x: np.ndarray, root: int = 0, op: str = "sum", name: str = "") -> np.ndarray:
         """Reduce to ``root`` (reference ``session.go:157-161``): only the
         root returns the reduced value; other ranks get their input back."""
+        self._chaos_collective(name or "reduce")
         tag = (name or f"rd{self._next_seq()}") + ".r"
         flat = np.ascontiguousarray(x).reshape(-1)
         eff_op = "sum" if op == "mean" else op
@@ -262,6 +314,7 @@ class CollectiveEngine:
     def gather(self, x: np.ndarray, root: int = 0, name: str = "") -> Optional[np.ndarray]:
         """Root returns [n, ...] stacked in rank order; others None
         (reference gathers to rank 0, ``session.go:189-211``)."""
+        self._chaos_collective(name or "gather")
         tag = (name or f"ga{self._next_seq()}") + ".g"
         flat = np.ascontiguousarray(x).reshape(-1)
         if self.rank == root:
@@ -278,6 +331,7 @@ class CollectiveEngine:
     def all_gather(self, x: np.ndarray, name: str = "") -> np.ndarray:
         """Direct full-exchange (reference ``allgather.go:17-45``): every
         peer sends to every other; returns [n, ...] in rank order."""
+        self._chaos_collective(name or "all_gather")
         tag = (name or f"ag{self._next_seq()}") + ".ag"
         flat = np.ascontiguousarray(x).reshape(-1)
         me = self.rank
@@ -330,6 +384,7 @@ class CollectiveEngine:
     def local_reduce(self, x: np.ndarray, op: str = "sum", name: str = "") -> np.ndarray:
         """Reduce among same-host peers; result on the local root
         (reference ``LocalReduce``).  Non-roots get their input back."""
+        self._chaos_collective(name or "local_reduce")
         tag = (name or f"lr{self._next_seq()}") + ".lr"
         flat = np.ascontiguousarray(x).reshape(-1)
         ranks = self._local_ranks()
@@ -343,6 +398,7 @@ class CollectiveEngine:
 
     def local_broadcast(self, x: np.ndarray, name: str = "") -> np.ndarray:
         """Broadcast from the local root to same-host peers."""
+        self._chaos_collective(name or "local_broadcast")
         tag = (name or f"lb{self._next_seq()}") + ".lb"
         flat = np.ascontiguousarray(x).reshape(-1)
         ranks = self._local_ranks()
@@ -353,6 +409,7 @@ class CollectiveEngine:
         """Hierarchical allreduce (reference ``allreduce.go:38``
         CrossAllReduce + the ScheduledHierarchical pattern): local reduce
         to the host roots, allreduce among roots, local broadcast."""
+        self._chaos_collective(name or "cross_all_reduce")
         base = name or f"xa{self._next_seq()}"
         eff_op = "sum" if op == "mean" else op
         flat = np.ascontiguousarray(x).reshape(-1)
@@ -446,6 +503,11 @@ class CollectiveEngine:
 
         if os.environ.get("KF_NATIVE_ENGINE", "1").lower() in ("0", "false", "no"):
             return None
+        if self._chaos is not None:
+            # fault injection lives in the Python send/recv wrappers; the
+            # C++ executor would bypass every hook, so a chaos run pins
+            # the reference Python path (and stays deterministic)
+            return None
         t = getattr(self.channel, "_t", None)  # NativeHostChannel only
         if t is None or not hasattr(t, "engine_all_reduce"):
             return None
@@ -467,12 +529,25 @@ class CollectiveEngine:
             data, offsets, len(graphs), tag,
             1 if self._hash_name_based else 0,
             engine_chunk_size(self._colocated),
-            engine_timeout_s(), engine_threads(), stats,
+            # honor a tightened per-peer deadline on the native path too
+            # (default: both are the engine timeout — no behavior change)
+            min(engine_timeout_s(), peer_deadline_s()), engine_threads(), stats,
         )
+        # the C++ executor reports collective-level failure without a
+        # per-peer attribution — rank=None tells the recovery driver to
+        # find the dead set by probing (elastic/shrink.find_dead_ranks)
         if rc == 1:
-            raise TimeoutError(f"native collective {tag!r} timed out")
+            raise PeerFailureError(
+                None, op=tag, phase="native-collective",
+                cause=TimeoutError(f"native collective {tag!r} timed out"),
+            )
         if rc == 2:
-            raise ConnectionError(f"native collective {tag!r}: peer unreachable/closed")
+            raise PeerFailureError(
+                None, op=tag, phase="native-collective",
+                cause=ConnectionError(
+                    f"native collective {tag!r}: peer unreachable/closed"
+                ),
+            )
         if rc != 0:
             raise RuntimeError(f"native collective {tag!r} failed (rc={rc})")
         if record and graphs is self._graphs:
@@ -525,10 +600,53 @@ class CollectiveEngine:
         return chunk_idx % n
 
     def _send(self, rank: int, name: str, payload: bytes):
-        self.channel.send(self.peers[rank], name, payload, ConnType.COLLECTIVE)
+        """Send under a per-peer deadline: transient wire faults (a reset
+        mid-chunk, a peer restarting its listener) are retried with
+        jittered exponential backoff; deadline exhaustion raises
+        :class:`PeerFailureError` naming the suspect instead of riding
+        the channel's full 100 s connect ladder."""
+        peer = self.peers[rank]
+        deadline = time.monotonic() + self._peer_deadline
+        attempt = 0
+        while True:
+            # size the channel's connect ladder by the remaining budget:
+            # against a SYN-dropping dead host each rung can burn the
+            # full CONNECT_TIMEOUT_S, so a fixed-length ladder would
+            # blow through a tight deadline 10x over before this loop
+            # ever saw the clock again (one rung of overshoot is the
+            # floor — a single TCP connect cannot be subdivided)
+            remaining = deadline - time.monotonic()
+            retries = max(1, min(_SEND_CONNECT_RETRIES,
+                                 int(remaining / CONNECT_TIMEOUT_S)))
+            try:
+                if self._chaos is not None:
+                    self._chaos.on_send(
+                        rank, name, payload, channel=self.channel, peer=peer
+                    )
+                self.channel.send(
+                    peer, name, payload, ConnType.COLLECTIVE, retries=retries,
+                )
+                return
+            except (ConnectionError, TimeoutError, OSError) as e:
+                if time.monotonic() >= deadline:
+                    raise PeerFailureError(
+                        rank, peer, op=name, phase="send", cause=e
+                    ) from e
+                sleep_backoff(attempt, base=0.05, cap=1.0)
+                attempt += 1
 
     def _recv(self, rank: int, name: str) -> bytes:
-        return self.channel.recv(self.peers[rank], name, ConnType.COLLECTIVE)
+        peer = self.peers[rank]
+        if self._chaos is not None:
+            self._chaos.on_recv(rank, name)
+        try:
+            return self.channel.recv(
+                peer, name, ConnType.COLLECTIVE, timeout=self._peer_deadline
+            )
+        except (TimeoutError, ConnectionError) as e:
+            raise PeerFailureError(
+                rank, peer, op=name, phase="recv", cause=e
+            ) from e
 
     def _recv_into(self, rank: int, name: str, arr: np.ndarray) -> None:
         """Receive a same-shaped payload into ``arr`` via the registered
@@ -536,9 +654,20 @@ class CollectiveEngine:
         Graph collectives exchange deterministically-sized chunks, so a
         size mismatch is a protocol violation — diagnosed loudly, not
         papered over."""
-        if self.channel.recv_into(self.peers[rank], name, arr):
+        peer = self.peers[rank]
+        if self._chaos is not None:
+            self._chaos.on_recv(rank, name)
+        try:
+            filled = self.channel.recv_into(
+                peer, name, arr, ConnType.COLLECTIVE, timeout=self._peer_deadline
+            )
+        except (TimeoutError, ConnectionError) as e:
+            raise PeerFailureError(
+                rank, peer, op=name, phase="recv", cause=e
+            ) from e
+        if filled:
             return
-        data = self._recv(rank, name)
+        data = self.channel.recv(peer, name, ConnType.COLLECTIVE)
         raise ValueError(
             f"collective {name!r} from rank {rank}: expected {arr.nbytes} "
             f"bytes, got {len(data)} — peers disagree on the chunk layout "

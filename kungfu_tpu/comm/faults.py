@@ -1,0 +1,66 @@
+"""Typed data-path failure vocabulary.
+
+Before this module, a dead peer surfaced as whichever low-level error
+happened to fire first — a ``TimeoutError`` from a rendezvous queue, a
+``ConnectionError`` from a pooled socket, or nothing at all (a hang) —
+and the only recovery was the detector-driven whole-job relaunch.  The
+in-flight fault-tolerance path needs the failure *attributed*: every
+engine collective primitive now runs under a per-peer deadline and, on
+exhaustion, raises :class:`PeerFailureError` carrying the suspect rank,
+which :func:`kungfu_tpu.elastic.shrink.recover_from_peer_failure` turns
+into an exclusion consensus among the survivors.
+
+``PeerFailureError`` subclasses ``ConnectionError`` deliberately: every
+existing ``except (OSError, ConnectionError, TimeoutError)`` site keeps
+working, while new code can catch the typed form and recover in-flight.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class PeerFailureError(ConnectionError):
+    """A collective primitive exhausted its per-peer deadline/retries.
+
+    ``rank`` is the *suspect* — the peer this primitive was talking to —
+    or ``None`` when the failing layer cannot attribute blame (the
+    native executor reports only collective-level failure); recovery
+    then probes liveness itself (``elastic.shrink.find_dead_ranks``).
+    A suspect is a hint, not a verdict: a peer blocked on the real
+    victim times out toward an innocent neighbor, so the shrink path
+    re-confirms every suspect by ping before proposing eviction.
+    """
+
+    def __init__(
+        self,
+        rank: Optional[int],
+        peer=None,
+        op: str = "",
+        phase: str = "",
+        cause: Optional[BaseException] = None,
+    ):
+        self.rank = rank
+        self.peer = peer
+        self.op = op
+        self.phase = phase
+        self.cause = cause
+        who = f"rank {rank} ({peer})" if rank is not None else "unattributed peer"
+        super().__init__(
+            f"collective {op!r} {phase or 'failed'} toward {who}: {cause}"
+        )
+
+
+class QuorumLostError(RuntimeError):
+    """Shrink-to-survivors cannot proceed: the surviving set is not a
+    strict majority of the current membership.  The caller's last resort
+    is the detector-driven full restart (signal via
+    :func:`kungfu_tpu.monitor.signals.monitor_report_down`)."""
+
+    def __init__(self, survivors: int, total: int):
+        self.survivors = survivors
+        self.total = total
+        super().__init__(
+            f"{survivors} survivor(s) of {total} is not a quorum; "
+            "falling back to detector-driven restart"
+        )

@@ -52,6 +52,7 @@ from typing import Dict, List, Optional, Tuple
 from kungfu_tpu.plan.peer import PeerID, parse_peer_id
 from kungfu_tpu.plan.peerlist import PeerList
 from kungfu_tpu.utils.log import get_logger
+from kungfu_tpu.utils.retry import jittered
 
 _log = get_logger("host-chan")
 
@@ -64,6 +65,10 @@ MAX_FRAME = 3 << 30
 MAX_META_LEN = 4096
 CONNECT_RETRIES = 500
 CONNECT_RETRY_PERIOD_S = 0.2  # reference: 500 x 200ms (config.go:16-18)
+#: per-attempt TCP connect timeout — exported because deadline-bounded
+#: callers (engine._send) size their retry ladders by the worst case a
+#: single rung can block (a SYN-dropping dead host burns this in full)
+CONNECT_TIMEOUT_S = 10.0
 
 USE_UNIXSOCK = "KF_TPU_USE_UNIXSOCK"
 
@@ -195,23 +200,29 @@ class _ChannelOps:
             raise RuntimeError(f"{self.self_id} not in {peers}")
         return r
 
-    def gather_bytes(self, data: bytes, peers: PeerList, name: str) -> Optional[List[bytes]]:
-        """Root (rank 0) returns all peers' payloads in rank order."""
+    def gather_bytes(self, data: bytes, peers: PeerList, name: str,
+                     send_retries: int = CONNECT_RETRIES) -> Optional[List[bytes]]:
+        """Root (rank 0) returns all peers' payloads in rank order.
+        ``send_retries`` bounds the connect ladder toward the root —
+        failure-recovery callers (the shrink consensus) run exactly when
+        peers are dying and must get their ``ConnectionError`` in
+        seconds, not after the full 500-rung bring-up window."""
         rank = self._rank(peers)
         if rank == 0:
             out = [data]
             for p in list(peers)[1:]:
                 out.append(self.recv(p, name))
             return out
-        self.send(peers[0], name, data)
+        self.send(peers[0], name, data, retries=send_retries)
         return None
 
-    def broadcast_bytes(self, data: Optional[bytes], peers: PeerList, name: str) -> bytes:
+    def broadcast_bytes(self, data: Optional[bytes], peers: PeerList, name: str,
+                        send_retries: int = CONNECT_RETRIES) -> bytes:
         rank = self._rank(peers)
         if rank == 0:
             assert data is not None
             for p in list(peers)[1:]:
-                self.send(p, name, data)
+                self.send(p, name, data, retries=send_retries)
             return data
         return self.recv(peers[0], name)
 
@@ -227,13 +238,16 @@ class _ChannelOps:
         self.gather_bytes(b"", peers, name + ".in")
         self.broadcast_bytes(b"" if self._rank(peers) == 0 else None, peers, name + ".out")
 
-    def consensus_bytes(self, data: bytes, peers: PeerList, name: str = "consensus") -> bool:
+    def consensus_bytes(self, data: bytes, peers: PeerList, name: str = "consensus",
+                        send_retries: int = CONNECT_RETRIES) -> bool:
         """True iff all peers supplied identical bytes
         (control-plane analog of ``session.go:124-155``)."""
-        gathered = self.gather_bytes(data, peers, name + ".g")
+        gathered = self.gather_bytes(data, peers, name + ".g",
+                                     send_retries=send_retries)
         if self._rank(peers) == 0:
             ok = all(g == gathered[0] for g in gathered)
-            self.broadcast_bytes(b"\x01" if ok else b"\x00", peers, name + ".b")
+            self.broadcast_bytes(b"\x01" if ok else b"\x00", peers, name + ".b",
+                                 send_retries=send_retries)
             return ok
         return self.broadcast_bytes(None, peers, name + ".b") == b"\x01"
 
@@ -396,16 +410,19 @@ class PyHostChannel(_ChannelOps):
             if colocated:
                 try:
                     s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-                    s.settimeout(10)
+                    s.settimeout(CONNECT_TIMEOUT_S)
                     s.connect(unix_sock_path(peer.host, peer.port))
                     return s
                 except OSError:
                     pass  # peer may be TCP-only; fall through
             try:
-                return socket.create_connection((peer.host, peer.port), timeout=10)
+                return socket.create_connection((peer.host, peer.port), timeout=CONNECT_TIMEOUT_S)
             except OSError as e:
                 last = e
-                time.sleep(CONNECT_RETRY_PERIOD_S)
+                # jittered, mean-preserving: the 500 x 200 ms reference
+                # window holds, but N workers retrying one cold peer
+                # decorrelate instead of re-colliding every 200 ms
+                time.sleep(jittered(CONNECT_RETRY_PERIOD_S))
         raise ConnectionError(f"cannot reach {peer} after {retries} retries: {last}")
 
     def _pooled(self, peer: PeerID):
@@ -452,8 +469,50 @@ class PyHostChannel(_ChannelOps):
                     pass
                 entry[0] = None
                 entry[0] = self._connect(peer, retries)
-                entry[0].sendall(head)
-                entry[0].sendall(payload)
+                try:
+                    entry[0].sendall(head)
+                    entry[0].sendall(payload)
+                except OSError:
+                    # a HALF-written frame must never stay pooled: a
+                    # caller-level retry would append a fresh frame onto
+                    # the desynced stream and the receiver would parse
+                    # payload bytes as headers (silent corruption risk,
+                    # not just a dropped connection)
+                    try:
+                        entry[0].close()
+                    except OSError:
+                        pass
+                    entry[0] = None
+                    raise
+
+    def chaos_partial_send(
+        self,
+        peer: PeerID,
+        name: str,
+        payload,
+        nbytes: int,
+        conn_type: ConnType = ConnType.COLLECTIVE,
+    ) -> None:
+        """Fault-injection primitive (``reset`` clauses, chaos-only —
+        never on a production code path): transmit a frame whose header
+        promises the full payload, deliver only the first ``nbytes``
+        bytes, then kill the socket.  The receiver's stream loop observes
+        peer-closed-mid-message — byte-for-byte what a worker dying
+        mid-chunk produces — on a throwaway connection, so the pooled
+        sender socket stays intact for the retry that follows."""
+        head = _encode_head(
+            self._token, conn_type, str(self.self_id), name,
+            _payload_nbytes(payload),
+        )
+        sock = self._connect(peer, retries=5)
+        try:
+            sock.sendall(head)
+            sock.sendall(memoryview(payload).cast("B")[:nbytes])
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def reset_connections(self) -> None:
         """Drop pooled connections (on membership change; reference

@@ -9,7 +9,11 @@ Parity with the reference's headline capability (``resize_cluster``):
 * :mod:`kungfu_tpu.elastic.schedule` — ``step_based_schedule`` config
   parsing (reference ``tensorflow/ops/cpu/elastic.cpp:16-82``);
 * :mod:`kungfu_tpu.elastic.hooks` — the elastic train loop driver
-  (reference ``hooks/elastic.py`` KungFuElasticTrainHook).
+  (reference ``hooks/elastic.py`` KungFuElasticTrainHook);
+* :mod:`kungfu_tpu.elastic.shrink` — in-flight peer-failure recovery:
+  exclusion consensus among the survivors, shrunk mesh epoch, replay
+  from the last committed step (no reference analog — the reference's
+  only recovery is the whole-job relaunch this makes the last resort).
 
 On TPU a resize is a **mesh-epoch swap**: membership changes on the host
 plane (consensus + runner notify), then the next ``communicator()`` /
@@ -20,6 +24,11 @@ plane (consensus + runner notify), then the next ``communicator()`` /
 from kungfu_tpu.elastic.configserver import ConfigServer
 from kungfu_tpu.elastic.schedule import step_based_schedule, parse_schedule
 from kungfu_tpu.elastic.hooks import ElasticState, elastic_step
+from kungfu_tpu.elastic.shrink import (
+    find_dead_ranks,
+    recover_from_peer_failure,
+    shrink_to_survivors,
+)
 
 __all__ = [
     "ConfigServer",
@@ -27,4 +36,7 @@ __all__ = [
     "parse_schedule",
     "ElasticState",
     "elastic_step",
+    "find_dead_ranks",
+    "recover_from_peer_failure",
+    "shrink_to_survivors",
 ]

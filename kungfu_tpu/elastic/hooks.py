@@ -20,6 +20,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from kungfu_tpu.chaos import note_step as _chaos_note_step
 from kungfu_tpu.elastic.schedule import step_based_schedule
 from kungfu_tpu.initializer import broadcast_parameters
 from kungfu_tpu.monitor.signals import monitor_compile_grace
@@ -63,6 +64,10 @@ def elastic_step(
     newly-joined worker (local step 0) jumps to the global step before the
     schedule is consulted — otherwise it would propose the schedule's
     step-0 size and shrink the cluster it just joined."""
+    # fault injection rendezvous: `die:step=N` clauses fire here, at the
+    # same step boundary on every rank (no-op unless KF_CHAOS_SPEC).
+    # chaos_rank, not rank(): clause targeting survives rank reshuffles
+    _chaos_note_step(peer.chaos_rank(), state.step)
     step = sync_step(peer, state.step)
     target = step_based_schedule(schedule, step) if schedule else peer.size()
     changed = False

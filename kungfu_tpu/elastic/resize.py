@@ -4,6 +4,13 @@ Parity with reference ``peer/peer.go:236-276``: loop — GET the cluster
 JSON from the config server, run a bytes-consensus over its digest among
 the *current* workers until every peer observed the same config, then hand
 the agreed (cluster, version) to ``Peer._propose``.
+
+Retry discipline: every worker runs this loop at once, so a constant
+retry period turns a config-server hiccup into a synchronized thundering
+herd the instant it comes back — fetch failures back off exponentially
+(jittered, capped) instead.  The *consensus* retry keeps a short mean
+delay (peers genuinely racing one PUT converge within a round or two)
+but jitters it so N workers don't re-gather in lockstep.
 """
 
 from __future__ import annotations
@@ -14,16 +21,21 @@ import urllib.error
 import urllib.request
 from typing import Tuple
 
+from kungfu_tpu.chaos import controller_for as _chaos_controller_for
 from kungfu_tpu.plan.cluster import Cluster
 from kungfu_tpu.utils.log import get_logger
+from kungfu_tpu.utils.retry import jittered, sleep_backoff
 
 _log = get_logger("resize")
 
 FETCH_RETRY_PERIOD_S = 0.2
+FETCH_RETRY_CAP_S = 2.0
 DEFAULT_TIMEOUT_S = 120.0
 
 
-def fetch_cluster(url: str) -> Tuple[Cluster, int]:
+def fetch_cluster(url: str, chaos=None) -> Tuple[Cluster, int]:
+    if chaos is not None and chaos.config_unavailable():
+        raise urllib.error.URLError("chaos: config-server unavailability window")
     with urllib.request.urlopen(url, timeout=10) as resp:
         doc = json.loads(resp.read().decode())
     cluster = Cluster.from_json(json.dumps(doc["cluster"]))
@@ -33,19 +45,30 @@ def fetch_cluster(url: str) -> Tuple[Cluster, int]:
 def fetch_cluster_with_consensus(peer, timeout: float = DEFAULT_TIMEOUT_S) -> Tuple[Cluster, int]:
     """All current workers converge on one (cluster, version) snapshot."""
     url = peer.config.config_server
+    # chaos_rank (the stable bootstrap identity), NOT the current rank:
+    # a shrink promotes survivor ranks, and a rank-scoped config_down
+    # clause must not re-fire on the promoted survivor
+    chaos = _chaos_controller_for(peer.chaos_rank())
     deadline = time.time() + timeout
     attempt = 0
+    failures = 0
     while True:
         if time.time() > deadline:
             raise TimeoutError(f"no consensus on cluster config after {timeout}s")
         try:
-            cluster, version = fetch_cluster(url)
+            cluster, version = fetch_cluster(url, chaos)
         except (urllib.error.URLError, OSError, KeyError, ValueError) as e:
             _log.debug("config fetch failed: %s", e)
-            time.sleep(FETCH_RETRY_PERIOD_S)
+            sleep_backoff(failures, base=FETCH_RETRY_PERIOD_S,
+                          cap=FETCH_RETRY_CAP_S)
+            failures += 1
             continue
+        failures = 0
         payload = cluster.digest() + version.to_bytes(8, "little")
+        # the consensus round index is part of the rendezvous name, so it
+        # MUST advance identically on every peer — only the local sleep
+        # between rounds is jittered, never the attempt counter
         if peer.consensus_bytes(payload, name=f"resize.{attempt}"):
             return cluster, version
         attempt += 1
-        time.sleep(FETCH_RETRY_PERIOD_S)
+        time.sleep(jittered(FETCH_RETRY_PERIOD_S))

@@ -1,0 +1,264 @@
+"""Shrink-to-survivors: in-flight peer-failure recovery.
+
+The detector-driven relaunch (``runner/monitored.py``) recovers from any
+failure, but a whole-job restart throws away every surviving worker's
+warm XLA caches and in-memory state — on TPU that means re-paying the
+multi-ten-second compile that ``monitor/detector.py`` has to special-case
+with ``DEFAULT_COMPILE_GRACE_S``.  This module makes the restart the
+*last resort* instead of the only mechanism:
+
+1. a collective primitive exhausts its per-peer deadline and raises
+   :class:`~kungfu_tpu.comm.faults.PeerFailureError` (``comm/engine.py``);
+2. each survivor **confirms** the dead set by pinging every current
+   worker (the exception's rank is only a suspect — a peer blocked on
+   the true victim times out toward an innocent neighbor);
+3. the survivors run an **exclusion consensus** over the survivor peer
+   list (the same ``consensus_bytes`` collective the resize protocol
+   uses): everyone must propose the identical shrunk cluster + version;
+4. quorum check — the survivors must be a strict majority of the
+   current membership, otherwise :class:`QuorumLostError` (the caller
+   escalates to the detector restart via
+   :func:`~kungfu_tpu.monitor.signals.monitor_report_down`);
+5. the agreed cluster is applied through the **existing elastic propose
+   path** (``Peer._propose``: runner notify, token fence, connection
+   reset, mesh-epoch retirement), published to the config server so
+   standby peers and watch runners observe it, and the caller replays
+   from the last committed step boundary
+   (:class:`kungfu_tpu.checkpoint.StepSnapshot`).
+
+Survivors that were blocked on the victim converge here within one
+per-peer deadline of each other, so the consensus collective rendezvouses
+without extra coordination.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+from kungfu_tpu.comm.faults import PeerFailureError, QuorumLostError
+from kungfu_tpu.plan.cluster import Cluster
+from kungfu_tpu.utils.log import get_logger, log_event
+
+_log = get_logger("shrink")
+
+#: ping-confirm budget per peer when probing the dead set
+PROBE_TIMEOUT_S = 3.0
+
+#: connect-ladder length for recovery-path sends (consensus / replay
+#: broadcast): short, because these run exactly when peers are dying
+_RECOVERY_SEND_RETRIES = 5
+
+
+def find_dead_ranks(peer, suspects: Iterable[int] = (),
+                    timeout: float = PROBE_TIMEOUT_S) -> List[int]:
+    """Ranks of current workers whose endpoint no longer answers a ping.
+    ``suspects`` (the blame carried by a ``PeerFailureError``) get a
+    second confirming ping if the sweep found them alive — a victim can
+    die between the collective failure and the sweep reaching it.
+
+    One ping thread per peer: dead SYN-dropping hosts burn the full
+    ``timeout``, and at pod scale a sequential sweep would serialize
+    recovery latency behind each of them — the sweep is bounded at
+    ~``timeout`` total, not ``timeout * n_dead`` (same head-of-line
+    reasoning as the detector's parallel fan-out)."""
+    import threading
+
+    workers = peer.cluster.workers
+    me = workers.rank(peer.config.self_id)
+
+    def sweep(ranks: List[int]) -> List[int]:
+        alive = [False] * len(ranks)
+
+        def one(i, r):
+            alive[i] = peer.channel.ping(workers[r], timeout=timeout)
+
+        ts = [threading.Thread(target=one, args=(i, r), daemon=True)
+              for i, r in enumerate(ranks)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout + 2.0)
+        return [r for i, r in enumerate(ranks) if not alive[i]]
+
+    dead = sweep([r for r in range(len(workers)) if r != me])
+    recheck = [
+        s for s in suspects
+        if s is not None and s != me and s not in dead
+        and 0 <= s < len(workers)
+    ]
+    dead += sweep(recheck)
+    return sorted(set(dead))
+
+
+def shrink_to_survivors(peer, dead_ranks: Sequence[int]) -> bool:
+    """Evict ``dead_ranks`` by exclusion consensus among the survivors
+    and apply the shrunk membership through the elastic propose path.
+
+    Returns ``True`` on success (the peer's next ``engine()`` /
+    ``communicator()`` call builds the shrunk epoch).  Returns ``False``
+    when the survivors could not agree (divergent dead sets — e.g. a
+    partition where each side sees the other down); the caller should
+    escalate.  Raises :class:`QuorumLostError` when the survivors are
+    not a strict majority of the current membership.
+    """
+    workers = peer.cluster.workers
+    dead = sorted({r for r in dead_ranks if 0 <= r < len(workers)})
+    if not dead:
+        return False
+    survivor_ranks = [r for r in range(len(workers)) if r not in dead]
+    me = workers.rank(peer.config.self_id)
+    if me is None or me in dead:
+        raise ValueError("shrink_to_survivors must run on a surviving member")
+    # strict majority: a minority partition must NOT shrink-and-continue
+    # (two half-clusters training independently is silent divergence,
+    # worse than a restart) — it falls back to the detector instead
+    if 2 * len(survivor_ranks) <= len(workers):
+        raise QuorumLostError(len(survivor_ranks), len(workers))
+
+    survivors = workers.select(survivor_ranks)
+    new_cluster = Cluster(peer.cluster.runners, survivors)
+    version = peer.cluster_version + 1
+    payload = new_cluster.digest() + version.to_bytes(8, "little")
+    # consensus over the SURVIVOR list: the gather root is the lowest
+    # surviving rank, so a dead rank 0 cannot wedge the vote.  Divergent
+    # dead sets mean divergent survivor lists — the vote then either
+    # disagrees on the payload or never rendezvouses at all (recv
+    # timeout); both are "no agreement", not a crash.
+    #
+    # The rendezvous name is keyed by the PAYLOAD DIGEST, not just the
+    # version: a failed round can leave its messages queued (the version
+    # only bumps on success), and a version-keyed retry would consume
+    # that stale round's bytes.  Digest-keying makes divergent proposals
+    # miss each other entirely (timeout → contained below) and makes any
+    # leftover same-name message byte-identical to the live one — stale
+    # equals fresh, so it cannot poison the vote.
+    import hashlib
+
+    digest = hashlib.blake2b(payload, digest_size=8).hexdigest()
+    try:
+        # send_retries is SHORT: this collective runs exactly when peers
+        # are dying, and a consensus root that died after the ping sweep
+        # must surface as ConnectionError in seconds, not after the
+        # channel's 500-rung bring-up ladder
+        ok = peer.channel.consensus_bytes(
+            payload, survivors, name=f"kf.shrink.{digest}",
+            send_retries=_RECOVERY_SEND_RETRIES,
+        )
+    except (TimeoutError, ConnectionError, OSError) as e:
+        _log.warning("exclusion consensus did not converge: %s", e)
+        ok = False
+    if not ok:
+        _log.warning(
+            "survivors disagree on the dead set (mine: %s) — not shrinking",
+            dead,
+        )
+        return False
+    _log.warning(
+        "excluding dead rank(s) %s: %d -> %d workers (v%d)",
+        dead, len(workers), len(survivors), version,
+    )
+    _publish_shrunk_cluster(peer, new_cluster, survivors)
+    peer._propose(new_cluster, version)
+    log_event(f"shrunk-to-survivors-v{version}-n{len(survivors)}")
+    return True
+
+
+def _publish_shrunk_cluster(peer, new_cluster: Cluster, survivors) -> None:
+    """Lowest surviving rank PUTs the shrunk cluster to the config server
+    (best effort): standby peers, watch runners, and late joiners must
+    observe the post-failure membership, and the next schedule-driven
+    resize must diff against it rather than the pre-failure list."""
+    if not peer.config.config_server:
+        return
+    if survivors.rank(peer.config.self_id) != 0:
+        return
+    import urllib.request
+
+    req = urllib.request.Request(
+        peer.config.config_server,
+        data=new_cluster.to_json().encode(),
+        method="PUT",
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            resp.read()
+    except OSError as e:
+        _log.warning("cannot publish shrunk cluster: %s", e)
+
+
+def recover_from_peer_failure(
+    peer,
+    failure: Optional[BaseException] = None,
+    snapshot=None,
+) -> Tuple[bool, Optional[Tuple[int, object, dict]]]:
+    """The full survivor-side driver: confirm the dead set, shrink, and
+    hand back the replay point.
+
+    Returns ``(shrunk, replay)`` where ``replay`` is the **agreed**
+    ``(step, tree, meta)`` boundary — the shrink leader's (new rank 0's)
+    snapshot, broadcast to every survivor — or ``None`` without one.
+    The agreement matters: the dead peer may have fed some survivors
+    before dying, so committed steps can diverge by one across
+    survivors, and replaying from per-peer snapshots would rendezvous
+    collectives under mismatched step names forever.  Pass ``snapshot``
+    on every surviving rank or on none (the broadcast must be
+    symmetric).
+
+    ``shrunk=False`` means nothing provably died (a transient — the
+    caller may simply retry the collective).  On quorum loss this
+    signals the failure detector (``otherdown`` → the MonitoredRun
+    relaunch, the pre-existing last resort) and re-raises
+    :class:`QuorumLostError`.
+    """
+    suspects = []
+    if isinstance(failure, PeerFailureError) and failure.rank is not None:
+        suspects.append(failure.rank)
+    dead = find_dead_ranks(peer, suspects)
+    if not dead:
+        _log.info(
+            "peer failure (%s) but every worker answers ping — transient, "
+            "not shrinking", failure,
+        )
+        return False, None
+    try:
+        shrunk = shrink_to_survivors(peer, dead)
+    except QuorumLostError:
+        from kungfu_tpu.monitor.signals import monitor_report_down
+
+        _log.error(
+            "quorum lost (%d dead of %d): escalating to detector-driven "
+            "restart", len(dead), peer.size(),
+        )
+        monitor_report_down()
+        raise
+    replay = None
+    if shrunk and snapshot is not None:
+        replay = _sync_replay_point(peer, snapshot)
+    return shrunk, replay
+
+
+def _sync_replay_point(peer, snapshot):
+    """All survivors adopt the leader's committed boundary: the lowest
+    surviving rank broadcasts its :class:`StepSnapshot` wire form over
+    the (already-shrunk) worker list; everyone else adopts it.  A
+    survivor one committed step ahead of the leader deliberately steps
+    back — consistency of the replayed step beats that one step of
+    progress (the alternative is a cluster-wide rendezvous livelock)."""
+    survivors = peer.cluster.workers
+    version = peer.cluster_version
+    name = f"kf.shrink.replay.v{version}"
+    try:
+        if survivors.rank(peer.config.self_id) == 0:
+            peer.channel.broadcast_bytes(
+                snapshot.serialize(), survivors, name,
+                send_retries=_RECOVERY_SEND_RETRIES,
+            )
+            return snapshot.last()
+        blob = peer.channel.broadcast_bytes(None, survivors, name)
+        return snapshot.adopt(blob)
+    except (TimeoutError, ConnectionError, OSError, ValueError) as e:
+        _log.warning(
+            "no agreed replay point (%s); continuing without replay", e
+        )
+        return None

@@ -59,6 +59,7 @@ class _RankState:
     epochs_done: int = 0
     finished: bool = False
     seen: bool = False
+    first_seen: float = 0.0  # wall time of this incarnation's first signal
     batches_done: int = 0  # completed begin/end pairs
     grace_pending: bool = False  # a grace signal awaits its batch
     in_grace_batch: bool = False  # the current open batch is compile-covered
@@ -144,15 +145,26 @@ class DetectorServer:
         now = time.time()
         with self._lock:
             if kind == "otherdown":
-                # another host's detector saw a failure; epoch < 0 means the
-                # sender had no rank state (non-main host) — fall back to
-                # what this host knows
+                # a failure report; epoch < 0 means the sender had no rank
+                # state (non-main host, or a worker-side quorum-loss
+                # escalation) — fall back to what this host knows
+                already_down = self.results.down_flag
                 self.results.down_flag = True
                 epoch = int(sig.get("epoch", -1))
                 if epoch < 0:
                     epoch = min((s.epochs_done for s in self._ranks.values()), default=0)
                 self.results.epoch_num = epoch
-                return None
+                if sig.get("relay") or already_down:
+                    # detector-to-detector relays stop here (one hop, no
+                    # cascade), and an already-down round was fanned out
+                    # when it started
+                    return None
+                # worker-originated report (monitor_report_down, the
+                # quorum-loss escalation): this detector is the only one
+                # that heard it, and once down_flag is set _check_once
+                # stops scanning — without a relay the other hosts'
+                # MonitoredRuns would never join the restart round
+                return {"kind": "otherdown", "epoch": epoch, "relay": True}
             if kind == "otherfinish":
                 self.results.finish_flag = True
                 return None
@@ -164,6 +176,8 @@ class DetectorServer:
                 st = self._ranks[int(sig["rank"])] = _RankState(
                     epochs_done=st.epochs_done
                 )
+            if not st.seen:
+                st.first_seen = now
             st.seen = True
             if kind == "begin":
                 st.last_begin, st.open_begin = now, True
@@ -224,20 +238,34 @@ class DetectorServer:
                     and last_seen > 0
                     and now - last_seen > max(3 * self.stall_timeout, allow)
                 )
-                if stalled_in_batch or silent:
+                # a rank that only ever signalled grace/epoch and then
+                # died has last_begin == last_end == 0, so the
+                # last_seen > 0 guard above never fires — "seen but never
+                # began a batch within the compile allowance" is a stall
+                # too (the compile window is exactly how long a healthy
+                # rank may legitimately take to reach its first begin)
+                never_began = (
+                    last_seen == 0
+                    and st.first_seen > 0
+                    and now - st.first_seen > self.compile_grace
+                )
+                if stalled_in_batch or silent or never_began:
                     min_epoch = min(
                         (s.epochs_done for s in self._ranks.values()), default=0
                     )
+                    why, since = (
+                        ("begin without end", st.last_begin) if stalled_in_batch
+                        else ("heartbeat silence", last_seen) if silent
+                        else ("signalled but never began a batch", st.first_seen)
+                    )
                     _log.warning(
                         "rank %d down (%s for %.0fs); restart epoch %d",
-                        r,
-                        "begin without end" if stalled_in_batch else "heartbeat silence",
-                        now - (st.last_begin if stalled_in_batch else last_seen),
-                        min_epoch,
+                        r, why, now - since, min_epoch,
                     )
                     self.results.down_flag = True
                     self.results.epoch_num = min_epoch
-                    fanout = {"kind": "otherdown", "epoch": min_epoch}
+                    fanout = {"kind": "otherdown", "epoch": min_epoch,
+                              "relay": True}
                     break
         if fanout is not None:
             self._fanout(fanout)
@@ -245,19 +273,40 @@ class DetectorServer:
     def _fanout(self, sig: dict, attempts: int = 3) -> None:
         """Post to every peer host's detector, outside any lock; a few
         retries with backoff — a lost fan-out strands the receiving host in
-        the old round forever, so it is worth insisting."""
+        the old round forever, so it is worth insisting.
+
+        One thread per host: the hosts most worth telling about a failure
+        are exactly the ones most likely to contain it, so a sequential
+        loop head-of-line-blocks every healthy host's restart behind the
+        dead host's full retry ladder (observed: ~10 s of added restart
+        skew per unreachable predecessor in the list)."""
+        from kungfu_tpu import chaos
+
+        ctl = chaos.controller_for(None)
+        threads = []
         for host in self.peer_hosts:
-            for i in range(attempts):
-                try:
-                    post_signal(host, self.port, sig, timeout=3)
-                    break
-                except OSError as e:
-                    if i == attempts - 1:
-                        _log.warning(
-                            "fanout to %s failed after %d attempts: %s", host, attempts, e
-                        )
-                    else:
-                        time.sleep(0.5 * (i + 1))
+            if ctl is not None and ctl.drop_fanout(host):
+                continue  # injected fan-out loss (drop_fanout clause)
+            t = threading.Thread(
+                target=self._fanout_one, args=(host, sig, attempts), daemon=True
+            )
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+
+    def _fanout_one(self, host: str, sig: dict, attempts: int) -> None:
+        for i in range(attempts):
+            try:
+                post_signal(host, self.port, sig, timeout=3)
+                return
+            except OSError as e:
+                if i == attempts - 1:
+                    _log.warning(
+                        "fanout to %s failed after %d attempts: %s", host, attempts, e
+                    )
+                else:
+                    time.sleep(0.5 * (i + 1))
 
     def _loop(self):
         while not self._stop.wait(CHECK_PERIOD_S):
@@ -293,7 +342,7 @@ class DetectorServer:
                 min_epoch = -1
             self.results.down_flag = True
             self.results.epoch_num = max(min_epoch, 0)
-        self._fanout({"kind": "otherdown", "epoch": min_epoch})
+        self._fanout({"kind": "otherdown", "epoch": min_epoch, "relay": True})
 
     def min_epoch(self) -> int:
         """Min completed epochs across ranks seen so far (restart point for

@@ -82,3 +82,13 @@ def monitor_compile_grace(rank: int) -> None:
 
 def monitor_train_end(rank: int) -> None:
     _send({"kind": "trainend", "rank": rank}, attempts=3)
+
+
+def monitor_report_down(epoch: int = -1) -> None:
+    """Worker-side escalation to the detector-driven full restart — the
+    last resort when in-flight shrink recovery loses quorum
+    (``elastic/shrink.py``).  ``epoch=-1`` = "sender has no epoch
+    accounting": the detector falls back to its own records instead of
+    restarting from epoch 0.  Retried: this IS the recovery path, a
+    dropped signal strands the job."""
+    _send({"kind": "otherdown", "epoch": epoch}, attempts=3)

@@ -297,6 +297,16 @@ class Peer:
     def channel(self) -> Optional[HostChannel]:
         return self._channel
 
+    def chaos_rank(self) -> Optional[int]:
+        """Stable fault-injection identity: this process's rank in its
+        BOOTSTRAP worker list.  Elastic reshuffles change :meth:`rank`
+        (a shrink promotes survivors), and a rank-scoped chaos
+        clause must keep pointing at the same process for the whole
+        experiment — the end-to-end repro of the alternative is a
+        ``die`` clause re-firing on the promoted survivor of the very
+        failure it injected."""
+        return self.config.cluster.workers.rank(self.config.self_id)
+
     # -- communicator (mesh epoch) ---------------------------------------
     def _retire_comm(self) -> None:
         """Drop the current communicator ahead of a new mesh epoch,
@@ -342,6 +352,7 @@ class Peer:
             )
             return
         deadline = time.monotonic() + 30.0
+        attempt = 0
         while time.monotonic() < deadline:
             try:
                 blob = self.request(0, self._STRATEGY_BLOB, version=ver,
@@ -351,7 +362,12 @@ class Peer:
             if blob:
                 self._comm_strategy = blob.decode().strip()
                 return
-            time.sleep(0.2)
+            from kungfu_tpu.utils.retry import sleep_backoff
+
+            # every non-zero rank polls rank 0 at once after a resize;
+            # jittered backoff keeps the pulls from re-synchronizing
+            sleep_backoff(attempt, base=0.2, cap=1.0)
+            attempt += 1
         _log.warning(
             "no device-strategy from rank 0 for v%d after 30s; keeping %r "
             "(mesh-wide schedule mismatch possible)",
@@ -404,7 +420,8 @@ class Peer:
                 if self._engine is not None:
                     self._engine.close()
                 self._engine = CollectiveEngine(
-                    self._channel, self.cluster.workers, self.config.strategy
+                    self._channel, self.cluster.workers, self.config.strategy,
+                    chaos_rank=self.chaos_rank(),
                 )
                 self._engine_version = self.cluster_version
             return self._engine
@@ -606,14 +623,21 @@ class Peer:
         survivors (device-plane collectives block until every participant
         arrives, the moral of the reference's post-update ``sess.Barrier()``,
         ``peer.go:144-166``)."""
+        from kungfu_tpu.utils.retry import sleep_backoff
+
         deadline = time.time() + timeout
+        failures = 0
         while time.time() < deadline:
             try:
                 cluster, version = self.observe_stage()
             except (OSError, ValueError, KeyError) as e:
                 _log.debug("stage fetch failed: %s", e)
-                time.sleep(poll_period)
+                # a DOWN config server + every standby peer polling it =
+                # a reconnect storm at recovery time; back off instead
+                sleep_backoff(failures, base=poll_period, cap=2.0)
+                failures += 1
                 continue
+            failures = 0
             if version > self.cluster_version:
                 if cluster.workers.rank(self.config.self_id) is not None:
                     with self._lock:
@@ -636,6 +660,22 @@ class Peer:
                         self._channel.set_token(version)
             time.sleep(poll_period)
         return False
+
+    # -- in-flight fault tolerance (elastic.shrink) ------------------------
+    def recover_from_failure(self, failure: Optional[BaseException] = None,
+                             snapshot=None):
+        """Survivor-side in-flight recovery after a collective raised
+        :class:`~kungfu_tpu.comm.faults.PeerFailureError`: confirm the
+        dead set by ping, run the exclusion consensus, apply the shrunk
+        membership through the propose path, and return ``(shrunk,
+        replay)`` — see :func:`kungfu_tpu.elastic.shrink.
+        recover_from_peer_failure`.  Raises ``QuorumLostError`` (after
+        signaling the failure detector) when the survivors are not a
+        strict majority — the detector-driven relaunch is the last
+        resort, no longer the only mechanism."""
+        from kungfu_tpu.elastic.shrink import recover_from_peer_failure
+
+        return recover_from_peer_failure(self, failure, snapshot)
 
     # -- monitoring / adaptation (reference peer.hpp GetPeerLatencies /
     # CheckInterference / GetEgressRates / SetTree) ----------------------

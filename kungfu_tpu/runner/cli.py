@@ -80,6 +80,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "'auto' uses it only when detected AND no -H given")
     p.add_argument("-n-epochs-flag", dest="n_epochs_flag", default="--n-epochs",
                    help="worker flag patched on auto-recovery restart")
+    p.add_argument("-tolerate-failures", dest="tolerate_failures",
+                   action="store_true",
+                   help="do not kill the worker group when one worker dies; "
+                        "survivors are expected to shrink-to-survivors "
+                        "in-flight (docs/fault_tolerance.md).  The run "
+                        "succeeds iff at least one worker exits 0")
+    p.add_argument("-chaos", dest="chaos", default="",
+                   help="deterministic fault-injection spec exported to "
+                        "workers as KF_CHAOS_SPEC (kungfu_tpu/chaos/spec.py; "
+                        "e.g. 'die:step=5,rank=1' kills rank 1 at step 5)")
+    p.add_argument("-chaos-seed", dest="chaos_seed", type=int, default=None,
+                   help="KF_CHAOS_SEED for the workers (delay jitter)")
     p.add_argument("prog", help="worker program")
     p.add_argument("args", nargs=argparse.REMAINDER, help="worker program args")
     return p
@@ -115,8 +127,16 @@ def simple_run(ns, cluster: Cluster, job: Job) -> int:
         "launching %d/%d workers on %s (strategy=%s)",
         len(procs), cluster.size(), ns.self_host, job.strategy,
     )
-    codes = run_all(procs, quiet=ns.quiet, timeout=ns.timeout or None)
+    codes = run_all(procs, quiet=ns.quiet, timeout=ns.timeout or None,
+                    fail_fast=not ns.tolerate_failures)
     bad = [c for c in codes if c != 0]
+    if bad and ns.tolerate_failures and len(bad) < len(codes):
+        # dead workers are survivable by design: the survivors shrank
+        # around them and finished — that IS the success criterion
+        _log.warning(
+            "%d worker(s) died (codes %s); survivors completed", len(bad), codes
+        )
+        return 0
     if bad:
         _log.error("workers failed: exit codes %s", codes)
         return 1
@@ -231,6 +251,30 @@ def main(argv: Optional[List[str]] = None) -> int:
         hl = build_hostlist(ns)
         world = hl.gen_peer_list(hl.cap(), parse_port_range(ns.port_range))
 
+    if ns.tolerate_failures and (ns.auto_recover or ns.watch):
+        # the monitored/watch runners have their own worker-death policy
+        # (relaunch / respawn); silently ignoring the flag would promise
+        # in-flight shrink and deliver a group kill instead
+        raise SystemExit(
+            "kfrun: -tolerate-failures applies to the simple runner only "
+            "(-auto-recover relaunches on worker death, -w respawns via "
+            "the config server)"
+        )
+    chaos_envs = {}
+    if ns.chaos:
+        # validate at the launcher so a typo'd spec dies here, not as a
+        # mysteriously fault-free experiment in N worker logs
+        from kungfu_tpu.chaos import SEED_ENV, SPEC_ENV, parse_spec
+
+        try:
+            parse_spec(ns.chaos)
+        except ValueError as e:
+            raise SystemExit(f"kfrun: bad -chaos spec: {e}") from None
+        chaos_envs[SPEC_ENV] = ns.chaos
+        if ns.chaos_seed is not None:
+            chaos_envs[SEED_ENV] = str(ns.chaos_seed)
+        _log.warning("fault injection armed: %s", ns.chaos)
+
     job = Job(
         prog=ns.prog,
         args=[a for a in ns.args if a != "--"],
@@ -241,6 +285,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         parent=PeerID(ns.self_host, DEFAULT_RUNNER_PORT),
         backend=ns.backend,
         world=world,
+        extra_envs=chaos_envs,
     )
     try:
         if ns.auto_recover:

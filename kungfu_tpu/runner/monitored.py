@@ -24,6 +24,7 @@ from kungfu_tpu.plan.cluster import Cluster
 from kungfu_tpu.runner.job import Job
 from kungfu_tpu.runner.proc import kill_group, start_proc
 from kungfu_tpu.utils.log import get_logger
+from kungfu_tpu.utils.retry import jittered
 
 _log = get_logger("monitored")
 
@@ -93,7 +94,9 @@ def _resolve_done_epochs(detector, self_host: str, main_host: str) -> int:
                 return int(res.get("epoch", 0))
         except OSError:
             pass
-        time.sleep(0.5)
+        # jittered: every non-main host polls the main detector at once
+        # during a restart round
+        time.sleep(jittered(0.5))
     _log.warning(
         "could not fetch authoritative epoch from %s; using fan-out value %d",
         main_host, detector.results.epoch_num,

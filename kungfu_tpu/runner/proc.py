@@ -92,9 +92,15 @@ def kill_group(running: _Running) -> None:
         pass
 
 
-def run_all(procs: Sequence[Proc], quiet: bool = False, timeout: Optional[float] = None) -> List[int]:
+def run_all(procs: Sequence[Proc], quiet: bool = False, timeout: Optional[float] = None,
+            fail_fast: bool = True) -> List[int]:
     """Run all procs; on any failure, kill the rest (fail-fast like the
-    reference runner).  Returns exit codes in proc order."""
+    reference runner).  Returns exit codes in proc order.
+
+    ``fail_fast=False`` (the shrink-to-survivors supervisor policy,
+    ``kfrun -tolerate-failures``): a worker's death does NOT take the
+    group down — the survivors are expected to exclude the dead peer
+    in-flight (elastic/shrink.py) and run to completion."""
     running = [start_proc(p, i, quiet=quiet) for i, p in enumerate(procs)]
     codes: List[Optional[int]] = [None] * len(running)
     try:
@@ -106,7 +112,7 @@ def run_all(procs: Sequence[Proc], quiet: bool = False, timeout: Optional[float]
                 try:
                     codes[i] = r.popen.wait(timeout=0.2)
                     pending.discard(i)
-                    if codes[i] != 0:
+                    if codes[i] != 0 and fail_fast:
                         for j in pending:
                             kill_group(running[j])
                 except subprocess.TimeoutExpired:

@@ -49,6 +49,13 @@ Tuning envs (read anywhere, any time):
 ``KF_CONFIG_ENGINE_THREADS``       native executor threads, default
                                    min(8, cores)
 ``KF_CONFIG_ENGINE_TIMEOUT``       per-collective timeout s, default 60
+``KF_CONFIG_PEER_DEADLINE``        per-peer send/recv deadline s for one
+                                   engine collective primitive; on
+                                   exhaustion a typed PeerFailureError
+                                   (suspect rank attached) replaces the
+                                   hang/raw error — the entry point of
+                                   shrink-to-survivors recovery.  Default
+                                   = the engine timeout (comm/engine.py)
 ``KF_CONFIG_ENABLE_TRACE``         truthy: log scope entry depth +
                                    duration (utils/trace.py)
 ``KF_CONFIG_P2P_RESPONDERS``       p2p blob responder pool size,
@@ -80,6 +87,20 @@ Transport / native-runtime envs:
                                race/memory debugging (native/__init__.py)
 ``KF_MONITOR_ADDR``            failure-detector endpoint workers report to
                                (monitor/signals.py; set by the runner)
+=============================  ================================================
+
+Fault-injection envs (the chaos layer, :mod:`kungfu_tpu.chaos`; see
+docs/fault_tolerance.md for the full matrix):
+
+=============================  ================================================
+``KF_CHAOS_SPEC``              deterministic fault clauses
+                               (``die``/``reset``/``delay``/``drop_fanout``/
+                               ``config_down``; grammar in chaos/spec.py).
+                               Unset = every injection hook is a zero-cost
+                               no-op and behavior is byte-identical to an
+                               injection-free build
+``KF_CHAOS_SEED``              integer seed for the only randomized
+                               perturbation (delay jitter), default 0
 =============================  ================================================
 
 Kernel / model / data selection envs:
@@ -151,6 +172,7 @@ WAIT_RUNNER_TIMEOUT = "KF_CONFIG_WAIT_RUNNER_TIMEOUT"
 CHUNK_SIZE = "KF_CONFIG_CHUNK_SIZE"
 ENGINE_THREADS = "KF_CONFIG_ENGINE_THREADS"
 ENGINE_TIMEOUT = "KF_CONFIG_ENGINE_TIMEOUT"
+PEER_DEADLINE = "KF_CONFIG_PEER_DEADLINE"
 
 ALL_BOOTSTRAP_ENVS = [
     SELF_SPEC, INIT_PEERS, INIT_RUNNERS, PARENT_ID, INIT_CLUSTER_VERSION,

@@ -1,0 +1,61 @@
+"""Bounded, jittered retry/backoff vocabulary.
+
+Every network retry loop in the tree must (a) bound its attempts — by a
+deadline or an attempt count — and (b) back off between attempts with
+jitter, so a whole cluster retrying the same dead endpoint does not
+re-synchronize into a thundering herd (the ``retry-discipline`` kf-lint
+rule enforces both; see :mod:`kungfu_tpu.analysis.retrydiscipline`).
+These helpers are the blessed way to satisfy (b): a ``time.sleep`` whose
+argument is computed — rather than a bare constant — is what the rule
+looks for.
+
+``backoff_delay`` implements capped exponential backoff with half-to-full
+jitter (the delay for attempt ``k`` is uniform in
+``[cap_k/2, cap_k)`` where ``cap_k = min(cap, base * 2**k)``): the mean
+grows exponentially while two peers that failed at the same instant
+still spread out.  ``jittered`` keeps a *fixed* mean period but
+desynchronizes callers — for poll loops whose total duration is part of
+a documented contract (e.g. the connect ladder's 500 x 200 ms window).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Optional
+
+#: exponent clamp: 2**16 * any sane base overflows every cap long before
+#: this, but a caller looping hundreds of times must not overflow float
+_MAX_EXP = 16
+
+
+def backoff_delay(
+    attempt: int,
+    base: float = 0.2,
+    cap: float = 2.0,
+    rng: Optional[random.Random] = None,
+) -> float:
+    """Delay in seconds for 0-based ``attempt``: capped exponential with
+    half-to-full jitter."""
+    r = (rng or random).random()
+    return min(cap, base * (2 ** min(max(attempt, 0), _MAX_EXP))) * (0.5 + 0.5 * r)
+
+
+def sleep_backoff(
+    attempt: int,
+    base: float = 0.2,
+    cap: float = 2.0,
+    rng: Optional[random.Random] = None,
+) -> float:
+    """Sleep :func:`backoff_delay`; returns the slept delay."""
+    d = backoff_delay(attempt, base, cap, rng)
+    time.sleep(d)
+    return d
+
+
+def jittered(period: float, rng: Optional[random.Random] = None) -> float:
+    """``period`` spread uniformly over ``[period/2, 3*period/2)`` — the
+    mean is preserved (total-duration contracts hold) but concurrent
+    retriers decorrelate."""
+    r = (rng or random).random()
+    return period * (0.5 + r)

@@ -4,7 +4,7 @@
 #
 #   scripts/check.sh
 #
-# 1. kflint        — the four project-invariant checkers (docs/lint.md)
+# 1. kflint        — the five project-invariant checkers (docs/lint.md)
 # 2. compileall    — every .py parses/compiles on this interpreter
 # 3. flag stamps   — no sanitizer flags leaked into the production
 #                    .buildflags stamp (variants must never mix)

@@ -21,6 +21,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import random
 import shutil
 import subprocess
 import sys
@@ -83,7 +84,7 @@ def main() -> int:
         except urllib.error.HTTPError:
             break  # 404 "no cluster" still means the server is up
         except OSError:
-            time.sleep(0.2)
+            time.sleep(0.2 * (0.5 + random.random()))  # jittered
     else:
         print("config server did not come up", file=sys.stderr)
         cleanup()

@@ -1,0 +1,83 @@
+"""retry-discipline fixture: the shipped bug shapes, plus compliant
+loops that must NOT be flagged.  Line numbers are asserted in
+tests/test_lint.py — edit with care."""
+
+import random
+import time
+import urllib.request
+
+from kungfu_tpu.utils.retry import sleep_backoff
+
+
+def unbounded_constant_hammer(url):
+    # the elastic-resize bug: every worker, forever, every 0.2s
+    while True:  # line 14: unbounded
+        try:
+            return urllib.request.urlopen(url, timeout=5)
+        except OSError:
+            time.sleep(0.2)  # line 18: constant backoff
+
+
+def bounded_but_constant(peer, sock):
+    for _ in range(500):
+        try:
+            return sock.connect(peer)
+        except OSError:
+            time.sleep(0.2)  # line 26: constant backoff
+
+
+def hot_hammer(url):
+    deadline = time.time() + 10
+    while True:  # line 31: bounded (deadline) but no sleep at all
+        if time.time() > deadline:
+            raise TimeoutError
+        try:
+            return urllib.request.urlopen(url, timeout=5)
+        except OSError:
+            continue
+
+
+def suppressed_constant(url):
+    while True:  # kflint: allow(retry-discipline)
+        try:
+            return urllib.request.urlopen(url, timeout=5)
+        except OSError:
+            # waived loop; the sleep still carries its own waiver
+            time.sleep(0.5)  # kflint: allow(retry-discipline)
+
+
+def good_deadline_backoff(url):
+    deadline = time.monotonic() + 30
+    attempt = 0
+    while True:  # bounded by the deadline compare; blessed backoff
+        try:
+            return urllib.request.urlopen(url, timeout=5)
+        except OSError:
+            if time.monotonic() > deadline:
+                raise
+            sleep_backoff(attempt)
+            attempt += 1
+
+
+def good_attempt_ladder(sock, peer):
+    for i in range(5):  # bounded; computed (growing) sleep
+        try:
+            return sock.connect(peer)
+        except OSError:
+            time.sleep(0.5 * (i + 1))
+
+
+def good_jittered_poll(url):
+    while time.time() < 99:  # real while-condition = bounded
+        try:
+            return urllib.request.urlopen(url, timeout=5)
+        except OSError:
+            time.sleep(0.2 * (0.5 + random.random()))
+
+
+def not_a_retry_iterating_targets(channel, runners, stage):
+    for runner in runners:  # per-TARGET try/except is not a retry loop
+        try:
+            channel.send(runner, "update", stage)
+        except (TimeoutError, ConnectionError):
+            pass

@@ -1,0 +1,478 @@
+"""Chaos-driven fault-tolerance tests (tier-1).
+
+The acceptance scenario of the fault-injection work: a worker killed
+mid-allreduce must NOT take the job down — the survivors detect it
+(typed ``PeerFailureError`` with a suspect rank instead of a hang), run
+the exclusion consensus, shrink the cluster to themselves, and produce
+bitwise-correct results over the shrunk membership, all without a
+process relaunch.  Quorum loss falls back to the pre-existing
+detector-driven restart.  And with ``KF_CHAOS_SPEC`` unset, every hook
+is a no-op and results are byte-identical to the chaos-free build.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kungfu_tpu import chaos
+from kungfu_tpu.checkpoint import StepSnapshot
+from kungfu_tpu.comm.engine import CollectiveEngine
+from kungfu_tpu.comm.faults import PeerFailureError, QuorumLostError
+from kungfu_tpu.comm.host import HostChannel
+from kungfu_tpu.plan import Cluster, PeerID, PeerList, Strategy
+
+from tests._util import run_all
+
+
+@pytest.fixture(autouse=True)
+def _fresh_chaos():
+    """Cached controllers carry trigger counters across tests that reuse
+    a spec string — every test starts from a clean registry."""
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def make_peers(n, base_port, monkeypatch, config_server=""):
+    """n real Peer objects on loopback (python transport: the wire-level
+    chaos faults are implemented there)."""
+    from kungfu_tpu.peer import Peer
+    from kungfu_tpu.utils.envs import Config
+
+    monkeypatch.setenv("KF_TPU_HOST_TRANSPORT", "python")
+    workers = PeerList.of(*(PeerID("127.0.0.1", base_port + i) for i in range(n)))
+    runners = PeerList.parse("127.0.0.1:38087")
+    cluster = Cluster(runners, workers)
+    peers = [
+        Peer(Config(self_id=workers[i], cluster=cluster,
+                    strategy=Strategy.STAR, config_server=config_server))
+        for i in range(n)
+    ]
+    for p in peers:
+        p.start()
+    return workers, peers
+
+
+class TestSpec:
+    def test_parse_roundtrip(self):
+        clauses = chaos.parse_spec(
+            "die:coll=3,rank=2,mode=raise;reset:send=2,peer=0;"
+            "delay:ms=200,jitter=50,every=2;drop_fanout:host=h,count=1;"
+            "config_down:after=2,count=3"
+        )
+        assert [c.kind for c in clauses] == [
+            "die", "reset", "delay", "drop_fanout", "config_down"
+        ]
+        assert clauses[0].get("coll") == 3 and clauses[0].rank == 2
+
+    @pytest.mark.parametrize("bad", [
+        "explode:now=1",          # unknown kind
+        "die:when=5",             # param not valid for kind
+        "delay:ms=fast",          # non-integer
+        "die:mode=sideways",      # bad mode
+        ";;",                     # no clauses
+    ])
+    def test_junk_fails_loudly(self, bad):
+        with pytest.raises(ValueError):
+            chaos.parse_spec(bad)
+
+    def test_rank_scoping(self):
+        clauses = chaos.parse_spec("die:coll=1,rank=2,mode=raise")
+        assert clauses[0].matches_rank(2)
+        assert not clauses[0].matches_rank(0)
+        assert chaos.parse_spec("delay:ms=1")[0].matches_rank(7)
+
+    def test_delay_every_strides_matching_events(self, monkeypatch):
+        """every=K is a stride over CLAUSE-MATCHING events, not the
+        global send counter — otherwise the outcome depends on how
+        unrelated traffic interleaves (not reproducible)."""
+        sleeps = []
+        monkeypatch.setattr("kungfu_tpu.chaos.inject.time.sleep",
+                            lambda s: sleeps.append(s))
+        ctl = chaos.ChaosController(
+            chaos.parse_spec("delay:ms=100,peer=1,every=2"), rank=0, seed=0)
+        for to in [1, 2, 1, 2, 1, 2, 1]:  # peer-1 sends land on odd turns
+            ctl.on_send(to, "x", b"")
+        # 4 matching sends to peer 1 -> every 2nd -> exactly 2 delays
+        assert len(sleeps) == 2
+
+    def test_seed_determinism(self):
+        spec = chaos.parse_spec("delay:ms=1,jitter=100")
+        a = chaos.ChaosController(spec, rank=0, seed=7)
+        b = chaos.ChaosController(spec, rank=0, seed=7)
+        c = chaos.ChaosController(spec, rank=0, seed=8)
+        seq = [a._rng.random() for _ in range(4)]
+        assert seq == [b._rng.random() for _ in range(4)]
+        assert seq != [c._rng.random() for _ in range(4)]
+
+
+class TestZeroCostWhenDisabled:
+    def test_no_controller_without_spec(self, monkeypatch):
+        monkeypatch.delenv("KF_CHAOS_SPEC", raising=False)
+        assert chaos.controller_for(0) is None
+        assert chaos.controller_for(None) is None
+        chaos.note_step(0, 5)  # no-op, no error
+
+    def test_allreduce_byte_identical(self, monkeypatch):
+        """The acceptance criterion's control arm: chaos disabled, the
+        engine takes the exact pre-chaos path (no controller installed)
+        and the reduction is bit-exact."""
+        monkeypatch.delenv("KF_CHAOS_SPEC", raising=False)
+        peers = PeerList.of(PeerID("127.0.0.1", 26520), PeerID("127.0.0.1", 26521))
+        chans = [HostChannel(p, bind_host="127.0.0.1") for p in peers]
+        try:
+            engines = [CollectiveEngine(c, peers, Strategy.STAR) for c in chans]
+            assert all(e._chaos is None for e in engines)
+            data = [np.arange(64, dtype=np.float32) * (i + 1) for i in range(2)]
+            outs = run_all([lambda e=e, d=d: e.all_reduce(d)
+                            for e, d in zip(engines, data)])
+            for o in outs:
+                assert np.array_equal(o, data[0] + data[1])
+        finally:
+            for c in chans:
+                c.close()
+
+
+class TestTypedPeerFailure:
+    """The in-flight FT substrate works without chaos: a genuinely dead
+    peer surfaces as PeerFailureError naming a suspect, not a hang."""
+
+    def test_recv_deadline_names_the_suspect(self, monkeypatch):
+        # python transport: the engine's recv wrapper does the per-peer
+        # attribution (the native executor reports rank=None and the
+        # recovery driver probes instead)
+        monkeypatch.setenv("KF_TPU_HOST_TRANSPORT", "python")
+        monkeypatch.setenv("KF_CONFIG_PEER_DEADLINE", "1.5")
+        peers = PeerList.of(PeerID("127.0.0.1", 26530), PeerID("127.0.0.1", 26531))
+        chans = [HostChannel(p, bind_host="127.0.0.1") for p in peers]
+        engines = [CollectiveEngine(c, peers, Strategy.STAR) for c in chans]
+        chans[1].close()  # rank 1 "dies" before the collective
+        try:
+            with pytest.raises(PeerFailureError) as ei:
+                engines[0].all_reduce(np.ones(4, np.float32))
+            assert ei.value.rank == 1
+            # the liveness sweep (shrink.find_dead_ranks' primitive)
+            # confirms the suspect
+            assert not chans[0].ping(peers[1], timeout=1.0)
+        finally:
+            chans[0].close()
+
+
+class TestKillOnePeerMidAllreduce:
+    """THE acceptance scenario: rank 2 of 3 dies on its 2nd allreduce;
+    the survivors shrink to a 2-worker cluster in-process and finish the
+    step with bitwise-correct results — no relaunch."""
+
+    def test_shrink_to_survivors(self, monkeypatch):
+        monkeypatch.setenv("KF_CHAOS_SPEC", "die:coll=2,rank=2,mode=raise")
+        monkeypatch.setenv("KF_CONFIG_PEER_DEADLINE", "2")
+        workers, peers = make_peers(3, 26540, monkeypatch)
+        data = [np.arange(32, dtype=np.float32) * (i + 1) for i in range(3)]
+        snaps = [StepSnapshot() for _ in range(3)]
+        try:
+            # step 1: healthy 3-way allreduce, then commit the boundary
+            outs = run_all([
+                lambda p=p, d=d: p.engine().all_reduce(d, name="s1")
+                for p, d in zip(peers, data)
+            ])
+            for i, o in enumerate(outs):
+                assert np.array_equal(o, data[0] + data[1] + data[2])
+                snaps[i].commit(1, {"w": o})
+
+            # step 2: rank 2 dies mid-allreduce
+            results = [None] * 3
+
+            def victim():
+                try:
+                    peers[2].engine().all_reduce(data[2], name="s2")
+                    results[2] = ("no-death", None)
+                except chaos.InjectedDeath:
+                    peers[2].close()  # the process is gone
+                    results[2] = ("died", None)
+
+            def survivor(i):
+                try:
+                    out = peers[i].engine().all_reduce(data[i], name="s2")
+                    results[i] = ("clean", out)
+                    return
+                except PeerFailureError as err:
+                    shrunk, replay = peers[i].recover_from_failure(
+                        err, snapshot=snaps[i]
+                    )
+                    assert shrunk, "survivors must agree to shrink"
+                    assert replay is not None and replay[0] == 1
+                    # replay the interrupted step over the shrunk cluster
+                    out = peers[i].engine().all_reduce(data[i], name="s2r")
+                    results[i] = ("recovered", out)
+
+            ts = [threading.Thread(target=victim, daemon=True)] + [
+                threading.Thread(target=survivor, args=(i,), daemon=True)
+                for i in (0, 1)
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(60)
+            assert not any(t.is_alive() for t in ts), "recovery hung"
+
+            assert results[2][0] == "died"
+            want = data[0] + data[1]  # bitwise: survivors-only sum
+            for i in (0, 1):
+                status, out = results[i]
+                assert status == "recovered", results[i]
+                assert np.array_equal(out, want)
+                assert peers[i].size() == 2
+                assert peers[i].cluster_version == 1
+                assert not peers[i].detached
+        finally:
+            for i in (0, 1):
+                peers[i].close()
+
+    def test_divergent_committed_steps_adopt_the_leader(self, monkeypatch):
+        """The dead peer can feed one survivor before dying, so committed
+        steps diverge by one across survivors — recovery must converge on
+        ONE agreed (step, state) (the leader's), or the replayed
+        collectives rendezvous under mismatched names forever."""
+        monkeypatch.setenv("KF_CHAOS_SPEC", "die:coll=1,rank=2,mode=raise")
+        monkeypatch.setenv("KF_CONFIG_PEER_DEADLINE", "2")
+        workers, peers = make_peers(3, 26630, monkeypatch)
+        snaps = [StepSnapshot() for _ in range(3)]
+        # survivor 0 (the future leader) committed step 4; survivor 1 got
+        # the victim's last feed and committed step 5 with different state
+        snaps[0].commit(4, {"w": np.full(8, 4.0, np.float32)}, {"epoch": 1})
+        snaps[1].commit(5, {"w": np.full(8, 5.0, np.float32)}, {"epoch": 1})
+        try:
+            results = [None] * 2
+
+            def victim():
+                try:
+                    peers[2].engine().all_reduce(np.ones(8, np.float32))
+                except chaos.InjectedDeath:
+                    peers[2].close()
+
+            def survivor(i):
+                try:
+                    peers[i].engine().all_reduce(np.ones(8, np.float32),
+                                                 name="x")
+                except PeerFailureError as err:
+                    results[i] = peers[i].recover_from_failure(
+                        err, snapshot=snaps[i]
+                    )
+
+            ts = [threading.Thread(target=victim, daemon=True)] + [
+                threading.Thread(target=survivor, args=(i,), daemon=True)
+                for i in (0, 1)
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(60)
+            assert not any(t.is_alive() for t in ts)
+            for i in (0, 1):
+                shrunk, replay = results[i]
+                assert shrunk
+                step, tree, meta = replay
+                # both adopted the LEADER's boundary — including the
+                # survivor that was one step ahead
+                assert step == 4 and meta == {"epoch": 1}
+                assert np.array_equal(tree["w"], np.full(8, 4.0, np.float32))
+            assert snaps[1].step() == 4  # stepped back, consistently
+        finally:
+            for i in (0, 1):
+                peers[i].close()
+
+    def test_quorum_loss_falls_back_to_detector(self, monkeypatch):
+        """1 survivor of 2 is not a strict majority: shrink must refuse
+        (two half-clusters training independently is divergence) and
+        escalate to the detector-driven restart path."""
+        from kungfu_tpu.monitor.detector import DetectorServer
+
+        detector = DetectorServer(expected_ranks=2, port=27801,
+                                  stall_timeout=1.0).start()
+        monkeypatch.setenv("KF_MONITOR_ADDR", "127.0.0.1:27801")
+        monkeypatch.setenv("KF_CHAOS_SPEC", "die:coll=1,rank=1,mode=raise")
+        monkeypatch.setenv("KF_CONFIG_PEER_DEADLINE", "1.5")
+        workers, peers = make_peers(2, 26560, monkeypatch)
+        try:
+            def victim():
+                try:
+                    peers[1].engine().all_reduce(np.ones(4, np.float32))
+                except chaos.InjectedDeath:
+                    peers[1].close()
+
+            t = threading.Thread(target=victim, daemon=True)
+            t.start()
+            with pytest.raises(PeerFailureError):
+                peers[0].engine().all_reduce(np.ones(4, np.float32))
+            t.join(10)
+            with pytest.raises(QuorumLostError):
+                peers[0].recover_from_failure(
+                    PeerFailureError(1, workers[1], phase="recv")
+                )
+            # the escalation signalled the detector (the restart driver)
+            deadline = time.time() + 5
+            while not detector.results.down_flag and time.time() < deadline:
+                time.sleep(0.1)
+            assert detector.results.down_flag
+        finally:
+            peers[0].close()
+            detector.stop()
+
+    def test_transient_failure_does_not_shrink(self, monkeypatch):
+        """Every worker answers ping => nothing provably died => the
+        recovery driver declines to shrink (callers just retry)."""
+        workers, peers = make_peers(2, 26580, monkeypatch)
+        try:
+            shrunk, replay = peers[0].recover_from_failure(
+                PeerFailureError(1, workers[1], phase="recv")
+            )
+            assert not shrunk and replay is None
+            assert peers[0].size() == 2  # membership untouched
+        finally:
+            for p in peers:
+                p.close()
+
+
+class TestWireFaults:
+    def test_reset_mid_chunk_recovered_by_retry(self, monkeypatch):
+        """A connection reset halfway through a chunk is a transient: the
+        sender's bounded-backoff retry re-sends and the collective
+        completes correctly."""
+        monkeypatch.setenv("KF_TPU_HOST_TRANSPORT", "python")
+        monkeypatch.setenv("KF_CHAOS_SPEC", "reset:send=1,rank=0")
+        peers = PeerList.of(PeerID("127.0.0.1", 26600), PeerID("127.0.0.1", 26601))
+        chans = [HostChannel(p, bind_host="127.0.0.1") for p in peers]
+        try:
+            engines = [CollectiveEngine(c, peers, Strategy.STAR) for c in chans]
+            data = [np.arange(1024, dtype=np.float32) * (i + 1) for i in range(2)]
+            outs = run_all([lambda e=e, d=d: e.all_reduce(d)
+                            for e, d in zip(engines, data)])
+            for o in outs:
+                assert np.array_equal(o, data[0] + data[1])
+        finally:
+            for c in chans:
+                c.close()
+
+    def test_delay_straggler(self, monkeypatch):
+        monkeypatch.setenv("KF_TPU_HOST_TRANSPORT", "python")
+        monkeypatch.setenv("KF_CHAOS_SPEC", "delay:ms=300,rank=1")
+        peers = PeerList.of(PeerID("127.0.0.1", 26610), PeerID("127.0.0.1", 26611))
+        chans = [HostChannel(p, bind_host="127.0.0.1") for p in peers]
+        try:
+            engines = [CollectiveEngine(c, peers, Strategy.STAR) for c in chans]
+            data = [np.full(8, i + 1.0, np.float32) for i in range(2)]
+            t0 = time.monotonic()
+            outs = run_all([lambda e=e, d=d: e.all_reduce(d)
+                            for e, d in zip(engines, data)])
+            elapsed = time.monotonic() - t0
+            assert elapsed >= 0.25, f"straggler not injected ({elapsed:.3f}s)"
+            for o in outs:
+                assert np.array_equal(o, data[0] + data[1])
+        finally:
+            for c in chans:
+                c.close()
+
+
+class TestControlPlaneFaults:
+    def test_config_down_window_then_recovery(self, monkeypatch):
+        """fetch_cluster fails for exactly the windowed attempts, then
+        the (backed-off) loop converges."""
+        from kungfu_tpu.elastic import ConfigServer
+        from kungfu_tpu.elastic.resize import fetch_cluster_with_consensus
+
+        cluster = Cluster(PeerList.parse("127.0.0.1:38088"),
+                          PeerList.parse("127.0.0.1:26620"))
+        srv = ConfigServer(port=0, cluster=cluster).start()
+        monkeypatch.setenv("KF_CHAOS_SPEC", "config_down:after=0,count=2")
+        _, peers = make_peers(1, 26620, monkeypatch, config_server=srv.url)
+        try:
+            got, version = fetch_cluster_with_consensus(peers[0], timeout=30)
+            assert version == 0 and got.workers == cluster.workers
+            ctl = chaos.controller_for(0)
+            assert ctl is not None and ctl._fetches == 3  # 2 dark + 1 ok
+        finally:
+            peers[0].close()
+            srv.stop()
+
+    def test_drop_fanout(self, monkeypatch):
+        """An injected fan-out loss: the peer detector never hears about
+        the failure (the fault the monitored runner must tolerate)."""
+        from kungfu_tpu.monitor.detector import DetectorServer
+
+        receiver = DetectorServer(expected_ranks=1, port=27802,
+                                  host="127.0.0.2").start()
+        sender = DetectorServer(expected_ranks=1, port=27802,
+                                host="127.0.0.1",
+                                peer_hosts=["127.0.0.2"]).start()
+        try:
+            monkeypatch.setenv("KF_CHAOS_SPEC", "drop_fanout:host=127.0.0.2")
+            sender._fanout({"kind": "otherdown", "epoch": 3})
+            time.sleep(0.5)
+            assert not receiver.results.down_flag
+            # with the fault cleared the same fan-out lands
+            monkeypatch.delenv("KF_CHAOS_SPEC")
+            chaos.reset()
+            sender._fanout({"kind": "otherdown", "epoch": 3})
+            deadline = time.time() + 5
+            while not receiver.results.down_flag and time.time() < deadline:
+                time.sleep(0.1)
+            assert receiver.results.down_flag
+        finally:
+            sender.stop()
+            receiver.stop()
+
+
+class TestTolerantSupervisor:
+    """`kfrun -tolerate-failures`: one worker dying must not take the
+    group down — the survivors' in-flight shrink needs them alive."""
+
+    def _procs(self):
+        import sys
+
+        from kungfu_tpu.runner.proc import Proc
+
+        return [
+            Proc(name="dies", prog=sys.executable,
+                 args=["-c", "import sys; sys.exit(43)"]),
+            Proc(name="survives", prog=sys.executable,
+                 args=["-c", "import time; time.sleep(1.5)"]),
+        ]
+
+    def test_fail_fast_kills_the_group(self):
+        from kungfu_tpu.runner.proc import run_all as proc_run_all
+
+        codes = proc_run_all(self._procs(), quiet=True, timeout=30)
+        assert codes[0] == 43
+        assert codes[1] != 0  # killed before its natural exit
+
+    def test_tolerant_lets_survivors_finish(self):
+        from kungfu_tpu.runner.proc import run_all as proc_run_all
+
+        codes = proc_run_all(self._procs(), quiet=True, timeout=30,
+                             fail_fast=False)
+        assert codes == [43, 0]
+
+
+class TestStepSnapshot:
+    def test_commit_last_isolation(self):
+        snap = StepSnapshot()
+        assert snap.last() is None and snap.step() is None
+        w = np.arange(4, dtype=np.float32)
+        snap.commit(7, {"w": w}, meta={"epoch": 2})
+        w[:] = -1  # caller clobbers its buffer post-commit (donation)
+        step, tree, meta = snap.last()
+        assert step == 7 and meta == {"epoch": 2}
+        assert np.array_equal(tree["w"], [0, 1, 2, 3])
+        tree["w"][:] = -2  # caller clobbers the restored copy
+        _, tree2, _ = snap.last()
+        assert np.array_equal(tree2["w"], [0, 1, 2, 3])
+
+    def test_recommit_and_clear(self):
+        snap = StepSnapshot()
+        snap.commit(1, {"x": np.zeros(2)})
+        snap.commit(2, {"x": np.ones(2)})
+        assert snap.step() == 2
+        snap.clear()
+        assert snap.last() is None

@@ -4,6 +4,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 import urllib.request
 
@@ -213,6 +214,118 @@ class TestCompileGrace:
             assert d.results.down_flag
         finally:
             d.stop()
+
+
+class TestSilentRankDetection:
+    """A rank that signals only grace/epoch and then dies has
+    last_begin == last_end == 0, which the heartbeat-silence guard
+    (last_seen > 0) never matches — 'seen but never began within the
+    compile allowance' must be flagged as a stall."""
+
+    @pytest.fixture
+    def detector(self):
+        d = DetectorServer(expected_ranks=2, port=27760, stall_timeout=0.5,
+                           compile_grace=1.5).start()
+        yield d
+        d.stop()
+
+    def _wait_down(self, d, deadline_s=10):
+        deadline = time.time() + deadline_s
+        while not d.results.down_flag and time.time() < deadline:
+            time.sleep(0.1)
+        return d.results.down_flag
+
+    def test_grace_only_rank_death_detected(self, detector):
+        post_signal("127.0.0.1", 27760, {"kind": "grace", "rank": 0})
+        # ...and the rank dies before its first begin ever arrives
+        assert self._wait_down(detector)
+
+    def test_epoch_only_rank_death_detected(self, detector):
+        post_signal("127.0.0.1", 27760, {"kind": "epoch", "rank": 0, "epoch": 2})
+        assert self._wait_down(detector)
+        # the restart point still honors the completed epochs it reported
+        assert detector.results.epoch_num == 3
+
+    def test_grace_only_rank_within_allowance_not_flagged(self, detector):
+        post_signal("127.0.0.1", 27760, {"kind": "grace", "rank": 0})
+        time.sleep(0.8)  # > stall_timeout, < compile_grace
+        assert not detector.results.down_flag
+
+    def test_begin_cancels_never_began_clock(self, detector):
+        post_signal("127.0.0.1", 27760, {"kind": "grace", "rank": 0})
+        post_signal("127.0.0.1", 27760, {"kind": "begin", "rank": 0})
+        time.sleep(1.0)  # inside the (grace-covered) first-batch window
+        assert not detector.results.down_flag
+
+
+class TestFanoutParallel:
+    """One unreachable host must not head-of-line-block every other
+    host's restart notification: fan-out runs one thread per host."""
+
+    def test_slow_host_does_not_delay_healthy_host(self):
+        # staller: accepts on 127.0.0.3:<port> and never responds — each
+        # sequential attempt would burn the full 3 s client timeout
+        import socket
+
+        port = 27761
+        staller = socket.socket()
+        staller.bind(("127.0.0.3", port))
+        staller.listen(4)
+        receiver = DetectorServer(expected_ranks=1, port=port,
+                                  host="127.0.0.2").start()
+        sender = DetectorServer(expected_ranks=1, port=port, host="127.0.0.1",
+                                peer_hosts=["127.0.0.3", "127.0.0.2"]).start()
+        try:
+            t = threading.Thread(
+                target=sender._fanout,
+                args=({"kind": "otherdown", "epoch": 1},), daemon=True,
+            )
+            t0 = time.time()
+            t.start()
+            deadline = time.time() + 5
+            while not receiver.results.down_flag and time.time() < deadline:
+                time.sleep(0.05)
+            elapsed = time.time() - t0
+            assert receiver.results.down_flag, "healthy host never notified"
+            # sequential delivery sits behind the staller's full retry
+            # ladder (3 attempts x 3s timeouts + backoff ≈ 10s)
+            assert elapsed < 5, f"fan-out serialized ({elapsed:.1f}s)"
+        finally:
+            sender.stop()
+            receiver.stop()
+            staller.close()
+
+
+class TestWorkerOriginDownRelay:
+    """A worker-side quorum-loss escalation (monitor_report_down) lands
+    only on the main host's detector — it must be relayed to the peer
+    hosts (one hop: relayed copies must not cascade back)."""
+
+    def test_worker_otherdown_is_relayed_once(self):
+        port = 27763
+        receiver = DetectorServer(expected_ranks=1, port=port,
+                                  host="127.0.0.2",
+                                  peer_hosts=["127.0.0.1"]).start()
+        sender = DetectorServer(expected_ranks=1, port=port, host="127.0.0.1",
+                                peer_hosts=["127.0.0.2"]).start()
+        try:
+            # worker-originated: no relay flag
+            post_signal("127.0.0.1", port, {"kind": "otherdown", "epoch": 2})
+            deadline = time.time() + 5
+            while not receiver.results.down_flag and time.time() < deadline:
+                time.sleep(0.05)
+            assert sender.results.down_flag
+            # the peer host joined the restart round...
+            assert receiver.results.down_flag
+            assert receiver.results.epoch_num == 2
+            # ...via a relay-flagged copy that did NOT cascade back and
+            # re-resolve the sender's epoch (a cascade would loop the
+            # two detectors against each other)
+            time.sleep(0.5)
+            assert sender.results.epoch_num == 2
+        finally:
+            sender.stop()
+            receiver.stop()
 
 
 class TestCheckpoint:

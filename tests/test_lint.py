@@ -8,7 +8,13 @@ import shutil
 import subprocess
 import sys
 
-from kungfu_tpu.analysis import blockingio, envcheck, jitpurity, lockcheck
+from kungfu_tpu.analysis import (
+    blockingio,
+    envcheck,
+    jitpurity,
+    lockcheck,
+    retrydiscipline,
+)
 from kungfu_tpu.analysis.cli import run_checkers
 from kungfu_tpu.analysis.core import repo_root
 
@@ -92,6 +98,36 @@ class TestLockDiscipline:
         root = _tmp_tree(tmp_path, {"kungfu_tpu/native/bad.cpp": "lock_bad.cpp"})
         wrong = [v for v in lockcheck.check(root) if v.line == 27]
         assert wrong and "other_mu_" in wrong[0].message
+
+
+class TestRetryDiscipline:
+    """The shipped bug shapes — the constant-period config-server hammer
+    and hot retry loops — must be flagged; bounded, jittered,
+    exponentially-backed-off loops must not."""
+
+    def _violations(self, tmp_path):
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": "retry_bad.py"})
+        return retrydiscipline.check(root)
+
+    def test_fixture_violations_caught(self, tmp_path):
+        got = sorted((v.line, v.message) for v in self._violations(tmp_path))
+        assert [line for line, _ in got] == [14, 18, 26, 31], got
+        assert "unbounded" in got[0][1]
+        assert "constant period" in got[1][1]
+        assert "constant period" in got[2][1]
+        assert "no backoff" in got[3][1]
+
+    def test_compliant_loops_not_flagged(self, tmp_path):
+        flagged = {v.line for v in self._violations(tmp_path)}
+        # good_deadline_backoff / good_attempt_ladder / good_jittered_poll
+        # / per-target iteration start past the suppressed block
+        assert not any(line > 45 for line in flagged), flagged
+
+    def test_suppression_honored(self, tmp_path):
+        # the allow() lines (39-45) carry a waived unbounded loop and a
+        # waived constant sleep — neither may surface
+        flagged = {v.line for v in self._violations(tmp_path)}
+        assert not any(38 <= line <= 46 for line in flagged), flagged
 
 
 class TestEnvContract:
