@@ -1,0 +1,38 @@
+# Top-level developer entry points.  The native transport has its own
+# Makefile (kungfu_tpu/native/Makefile) for the .so variants; this one
+# wraps the repo-wide gates so "the linters" is one command.
+
+PY ?= python3
+BASELINE := tests/lint_baseline.json
+
+.PHONY: lint verify check test native help
+
+## lint: all eight kf-lint rules — the Python suite (env-contract,
+## jit-sync, blocking-io, retry-discipline, collective-consistency,
+## wire-contract, lock-order) AND the transport.cpp lockcheck
+## (lock-discipline) in one command, honoring the suppression baseline.
+lint:
+	$(PY) scripts/kflint $(if $(wildcard $(BASELINE)),--baseline $(BASELINE))
+
+## verify: just the interprocedural kf-verify rules (fast iteration on
+## protocol changes).
+verify:
+	$(PY) scripts/kflint --checker collective-consistency \
+	    --checker wire-contract --checker lock-order \
+	    $(if $(wildcard $(BASELINE)),--baseline $(BASELINE))
+
+## check: the full pre-merge gate (lint + compileall + build stamps).
+check:
+	bash scripts/check.sh
+
+## test: tier-1 (CPU backend, slow tests excluded).
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
+	    -p no:cacheprovider
+
+## native: production build of the native transport.
+native:
+	$(MAKE) -C kungfu_tpu/native
+
+help:
+	@grep -E '^## ' Makefile | sed 's/^## //'

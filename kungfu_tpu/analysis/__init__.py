@@ -1,7 +1,9 @@
 """kf-lint: project-invariant static analysis for the kungfu-tpu tree.
 
-Five AST/structural checkers enforce invariants that code review kept
-missing (see docs/lint.md for the catalog and suppression syntax):
+Eight AST/structural checkers enforce invariants that code review kept
+missing (see docs/lint.md for the catalog and suppression syntax).
+
+The single-function rules:
 
 * ``env-contract``  — every ``KF_*`` env read (Python and C++) appears in
   the :mod:`kungfu_tpu.utils.envs` registry, and every registry entry has
@@ -17,11 +19,26 @@ missing (see docs/lint.md for the catalog and suppression syntax):
 * ``retry-discipline`` — network retry loops bound their attempts and
   back off with jitter (:mod:`kungfu_tpu.analysis.retrydiscipline`).
 
+The interprocedural (kf-verify) rules, built on the shared project call
+graph (:mod:`kungfu_tpu.analysis.callgraph`):
+
+* ``collective-consistency`` — every peer issues the same collectives
+  under the same rendezvous names; rank-conditional collectives,
+  constant-name reuse, and peer-divergent name expressions are flagged
+  (:mod:`kungfu_tpu.analysis.collectives`).
+* ``wire-contract`` — the Python framing (:class:`HeaderCodec` in
+  ``comm/host.py``) and the C++ decoder (``native/transport.cpp``) parse
+  into one schema IR and must diff clean
+  (:mod:`kungfu_tpu.analysis.wirecontract`).
+* ``lock-order`` — the cross-module Python lock-acquisition graph must
+  be acyclic (:mod:`kungfu_tpu.analysis.pylockorder`).
+
 This package is intentionally stdlib-only (no jax/numpy import) so
 ``scripts/kflint`` runs in any environment, including bare CI images.
 """
 
 from kungfu_tpu.analysis.core import Violation, repo_root
-from kungfu_tpu.analysis.cli import CHECKERS, run_checkers
+from kungfu_tpu.analysis.cli import CHECKERS, VERIFY_CHECKERS, run_checkers
 
-__all__ = ["Violation", "repo_root", "CHECKERS", "run_checkers"]
+__all__ = ["Violation", "repo_root", "CHECKERS", "VERIFY_CHECKERS",
+           "run_checkers"]

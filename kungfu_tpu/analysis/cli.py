@@ -6,22 +6,38 @@ Usage (via ``scripts/kflint``)::
     kflint --checker jit-sync --checker env-contract
     kflint --root /path/to/tree
     kflint --list
+    kflint --json                          # machine-readable findings
+    kflint --baseline tests/lint_baseline.json
+    kflint --write-baseline tests/lint_baseline.json
 
-Exit code 0 = clean, 1 = violations, 2 = usage/internal error.
+A **baseline** is a JSON list of ``{"checker", "path", "message"}``
+fingerprints (line numbers deliberately excluded — they drift with every
+edit above a finding).  Findings matching a baseline entry are reported
+as suppressed instead of failing the run, so a new rule can land
+tree-wide on day one and ratchet the legacy findings down over time
+instead of blocking on them.  ``--write-baseline`` snapshots the current
+findings into that format.
+
+Exit code 0 = clean (or fully baselined), 1 = violations, 2 =
+usage/internal error.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from kungfu_tpu.analysis import (
     blockingio,
+    collectives,
     envcheck,
     jitpurity,
     lockcheck,
+    pylockorder,
     retrydiscipline,
+    wirecontract,
 )
 from kungfu_tpu.analysis.core import Violation, repo_root
 
@@ -31,17 +47,50 @@ CHECKERS: Dict[str, object] = {
     blockingio.CHECKER: blockingio.check,
     lockcheck.CHECKER: lockcheck.check,
     retrydiscipline.CHECKER: retrydiscipline.check,
+    collectives.CHECKER: collectives.check,
+    wirecontract.CHECKER: wirecontract.check,
+    pylockorder.CHECKER: pylockorder.check,
 }
+
+#: the kf-verify subset: the interprocedural rules built on the shared
+#: call graph (scripts/check.sh names them; the set also documents which
+#: rules a baseline most plausibly covers while a tree is brought clean)
+VERIFY_CHECKERS = (collectives.CHECKER, wirecontract.CHECKER,
+                   pylockorder.CHECKER)
 
 
 def run_checkers(root: Optional[str] = None,
                  names: Optional[Sequence[str]] = None) -> List[Violation]:
-    """All violations from the selected checkers (default: all five)."""
+    """All violations from the selected checkers (default: all)."""
     root = root or repo_root()
     out: List[Violation] = []
     for name in names or CHECKERS:
         out.extend(CHECKERS[name](root))
     return sorted(out, key=lambda v: (v.path, v.line, v.checker))
+
+
+def _fingerprint(v: Violation) -> Tuple[str, str, str]:
+    return (v.checker, v.path, v.message)
+
+
+def load_baseline(path: str) -> List[dict]:
+    with open(path, encoding="utf-8") as f:
+        entries = json.load(f)
+    if not isinstance(entries, list) or not all(
+            isinstance(e, dict) and {"checker", "path", "message"} <= set(e)
+            for e in entries):
+        raise ValueError(
+            f"{path}: baseline must be a JSON list of "
+            f'{{"checker", "path", "message"}} entries')
+    return entries
+
+
+def apply_baseline(violations: List[Violation],
+                   entries: List[dict]) -> Tuple[List[Violation], int]:
+    """(unbaselined violations, suppressed count)."""
+    allowed = {(e["checker"], e["path"], e["message"]) for e in entries}
+    fresh = [v for v in violations if _fingerprint(v) not in allowed]
+    return fresh, len(violations) - len(fresh)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -53,6 +102,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    help="run only this checker (repeatable)")
     p.add_argument("--list", action="store_true",
                    help="list available checkers and exit")
+    p.add_argument("--json", action="store_true",
+                   help="emit findings as a JSON list on stdout")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="suppress findings whose (checker, path, message) "
+                        "fingerprint appears in this JSON baseline")
+    p.add_argument("--write-baseline", default=None, metavar="FILE",
+                   help="write the current findings as a baseline and exit 0")
     args = p.parse_args(argv)
     if args.list:
         for name in sorted(CHECKERS):
@@ -60,14 +116,40 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 0
     try:
         violations = run_checkers(args.root, args.checker)
+        suppressed = 0
+        if args.baseline:
+            violations, suppressed = apply_baseline(
+                violations, load_baseline(args.baseline))
     except Exception as e:  # noqa: BLE001 - CLI surface
         print(f"kflint: internal error: {e}", file=sys.stderr)
         return 2
-    for v in violations:
-        print(v.render())
+
+    if args.write_baseline:
+        entries = [
+            {"checker": v.checker, "path": v.path, "message": v.message}
+            for v in violations
+        ]
+        with open(args.write_baseline, "w", encoding="utf-8") as f:
+            json.dump(entries, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"kflint: wrote {len(entries)} baseline entr"
+              f"{'y' if len(entries) == 1 else 'ies'} to "
+              f"{args.write_baseline}", file=sys.stderr)
+        return 0
+
+    if args.json:
+        print(json.dumps([
+            {"checker": v.checker, "path": v.path, "line": v.line,
+             "message": v.message}
+            for v in violations
+        ], indent=2))
+    else:
+        for v in violations:
+            print(v.render())
     n = len(violations)
     checkers = args.checker or sorted(CHECKERS)
-    print(f"kflint: {n} violation(s) [{', '.join(checkers)}]",
+    note = f" ({suppressed} baselined)" if suppressed else ""
+    print(f"kflint: {n} violation(s){note} [{', '.join(checkers)}]",
           file=sys.stderr)
     return 1 if n else 0
 

@@ -23,12 +23,15 @@ import time
 from typing import List, Optional
 
 from kungfu_tpu.chaos.spec import Clause, parse_spec
+from kungfu_tpu.utils import envs
 from kungfu_tpu.utils.log import get_logger
 
 _log = get_logger("chaos")
 
-SPEC_ENV = "KF_CHAOS_SPEC"
-SEED_ENV = "KF_CHAOS_SEED"
+# the registry (utils/envs.py) is the single authority for KF_* names;
+# chaos was the one subsystem naming its envs locally — drift bait
+SPEC_ENV = envs.CHAOS_SPEC
+SEED_ENV = envs.CHAOS_SEED
 
 #: worker exit status for ``die`` faults in ``exit`` mode — distinct from
 #: real crash codes so the runner's logs attribute the death to chaos

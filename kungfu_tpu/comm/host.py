@@ -126,6 +126,56 @@ class _Msg:
         self.payload = payload
 
 
+class HeaderCodec:
+    """Single authority for the fixed fields of the wire header.
+
+    Every frame starts with ``magic u32 | token u32 | conn_type u8 |
+    src_len u16``, and the two later length prefixes (``name_len u16``,
+    ``payload_len u32``) complete the framing.  The C++ decoder
+    (:file:`kungfu_tpu/native/transport.cpp` ``encode_head`` /
+    ``decode_head``) reads the same bytes at the same offsets; kf-verify's
+    ``wire-contract`` rule diffs the two sides and anchors on this class,
+    so the format string exists in exactly one place per language —
+    a drifted copy can no longer hide at a second pack/unpack site.
+    """
+
+    #: magic u32 | token u32 | conn_type u8 | src_len u16
+    HEAD_FMT = "<IIBH"
+    HEAD_SIZE = struct.calcsize(HEAD_FMT)  # 11 — mirrors C++ `head[11]`
+    #: name_len u16
+    NAME_LEN_FMT = "<H"
+    NAME_LEN_SIZE = struct.calcsize(NAME_LEN_FMT)
+    #: payload_len u32
+    PAYLOAD_LEN_FMT = "<I"
+    PAYLOAD_LEN_SIZE = struct.calcsize(PAYLOAD_LEN_FMT)
+
+    @staticmethod
+    def pack_head(token: int, conn_type: int, src: bytes, name: bytes,
+                  payload_len: int) -> bytes:
+        return (
+            struct.pack(HeaderCodec.HEAD_FMT, MAGIC, token, conn_type, len(src))
+            + src
+            + struct.pack(HeaderCodec.NAME_LEN_FMT, len(name))
+            + name
+            + struct.pack(HeaderCodec.PAYLOAD_LEN_FMT, payload_len)
+        )
+
+    @staticmethod
+    def unpack_head(head: bytes) -> Tuple[int, int, int, int]:
+        """``(magic, token, conn_type, src_len)`` from the fixed prefix."""
+        return struct.unpack(HeaderCodec.HEAD_FMT, head)
+
+    @staticmethod
+    def unpack_name_len(raw: bytes) -> int:
+        (name_len,) = struct.unpack(HeaderCodec.NAME_LEN_FMT, raw)
+        return name_len
+
+    @staticmethod
+    def unpack_payload_len(raw: bytes) -> int:
+        (payload_len,) = struct.unpack(HeaderCodec.PAYLOAD_LEN_FMT, raw)
+        return payload_len
+
+
 def _read_exact(sock: socket.socket, n: int) -> bytes:
     buf = b""
     while len(buf) < n:
@@ -149,13 +199,7 @@ def _encode_head(token: int, conn_type: int, src: str, name: str, nbytes: int) -
         raise ValueError(
             f"payload of {nbytes} bytes exceeds the 3 GiB frame limit"
         )
-    return (
-        struct.pack("<IIBH", MAGIC, token, conn_type, len(sb))
-        + sb
-        + struct.pack("<H", len(nb))
-        + nb
-        + struct.pack("<I", nbytes)
-    )
+    return HeaderCodec.pack_head(token, conn_type, sb, nb, nbytes)
 
 
 def _encode(token: int, conn_type: int, src: str, name: str, payload: bytes) -> bytes:
@@ -163,17 +207,23 @@ def _encode(token: int, conn_type: int, src: str, name: str, payload: bytes) -> 
 
 
 def _decode(sock: socket.socket) -> _Msg:
-    magic, token, conn_type, src_len = struct.unpack("<IIBH", _read_exact(sock, 11))
+    magic, token, conn_type, src_len = HeaderCodec.unpack_head(
+        _read_exact(sock, HeaderCodec.HEAD_SIZE)
+    )
     if magic != MAGIC:
         raise ValueError(f"bad magic {magic:#x}")
     if src_len > MAX_META_LEN:
         raise ValueError(f"src field of {src_len} bytes over limit")
     src = _read_exact(sock, src_len).decode()
-    (name_len,) = struct.unpack("<H", _read_exact(sock, 2))
+    name_len = HeaderCodec.unpack_name_len(
+        _read_exact(sock, HeaderCodec.NAME_LEN_SIZE)
+    )
     if name_len > MAX_META_LEN:
         raise ValueError(f"name field of {name_len} bytes over limit")
     name = _read_exact(sock, name_len).decode()
-    (payload_len,) = struct.unpack("<I", _read_exact(sock, 4))
+    payload_len = HeaderCodec.unpack_payload_len(
+        _read_exact(sock, HeaderCodec.PAYLOAD_LEN_SIZE)
+    )
     if payload_len > MAX_FRAME:
         raise ValueError(f"payload of {payload_len} bytes over the frame limit")
     payload = _read_exact(sock, payload_len)

@@ -174,6 +174,12 @@ ENGINE_THREADS = "KF_CONFIG_ENGINE_THREADS"
 ENGINE_TIMEOUT = "KF_CONFIG_ENGINE_TIMEOUT"
 PEER_DEADLINE = "KF_CONFIG_PEER_DEADLINE"
 
+# fault-injection envs (read by kungfu_tpu/chaos/inject.py at controller
+# creation; registered here so the env-contract scan anchors them to the
+# same registry as every other KF_* knob)
+CHAOS_SPEC = "KF_CHAOS_SPEC"
+CHAOS_SEED = "KF_CHAOS_SEED"
+
 ALL_BOOTSTRAP_ENVS = [
     SELF_SPEC, INIT_PEERS, INIT_RUNNERS, PARENT_ID, INIT_CLUSTER_VERSION,
     ALLREDUCE_STRATEGY, CONFIG_SERVER, JOB_START_TIMESTAMP,

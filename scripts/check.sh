@@ -4,7 +4,11 @@
 #
 #   scripts/check.sh
 #
-# 1. kflint        — the five project-invariant checkers (docs/lint.md)
+# 1. kflint        — all eight project-invariant checkers, including the
+#                    kf-verify interprocedural rules (docs/lint.md).
+#                    Findings fingerprinted in tests/lint_baseline.json
+#                    are suppressed (legacy debt being ratcheted down);
+#                    anything NOT in the baseline fails the gate.
 # 2. compileall    — every .py parses/compiles on this interpreter
 # 3. flag stamps   — no sanitizer flags leaked into the production
 #                    .buildflags stamp (variants must never mix)
@@ -14,8 +18,12 @@ ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 cd "$ROOT"
 fail=0
 
-echo "== kflint"
-if ! python3 scripts/kflint; then
+echo "== kflint (incl. kf-verify: collective-consistency, wire-contract, lock-order)"
+KFLINT_ARGS=()
+if [ -f tests/lint_baseline.json ]; then
+    KFLINT_ARGS+=(--baseline tests/lint_baseline.json)
+fi
+if ! python3 scripts/kflint "${KFLINT_ARGS[@]}"; then
     fail=1
 fi
 

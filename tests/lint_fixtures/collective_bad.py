@@ -1,0 +1,48 @@
+"""Seeded collective-consistency violations (tests/test_lint.py pins the
+line numbers below — keep edits append-only)."""
+import time
+
+
+def asymmetric_broadcast(peer, blob):
+    # BAD: only rank 0 ever issues this collective -> every other rank
+    # waits on a rendezvous that never happens
+    if peer.rank() == 0:
+        peer.channel.broadcast_bytes(blob, peer.cluster.workers, name="boot")
+
+
+def _announce(peer):
+    peer.channel.barrier(peer.cluster.workers, name="announce")
+
+
+def leader_only_announce(peer):
+    # BAD (interprocedural): _announce issues a barrier but is reached
+    # only through this rank-conditional call site
+    if peer.rank() == 0:
+        _announce(peer)
+
+
+def first_sync(peer, digest):
+    return peer.channel.consensus_bytes(
+        digest, peer.cluster.workers, name="sync"
+    )
+
+
+def second_sync(peer, digest):
+    # BAD: constant rendezvous name reused from first_sync — concurrent
+    # paths alias each other's messages
+    return peer.channel.consensus_bytes(
+        digest, peer.cluster.workers, name="sync"
+    )
+
+
+def stamped_gather(peer, blob):
+    # BAD: time.time() diverges across peers, the name never rendezvouses
+    return peer.channel.gather_bytes(
+        blob, peer.cluster.workers, name=f"snap.{time.time()}"
+    )
+
+
+def waived_probe(peer, blob):
+    # suppressed: a deliberately rank-local debug path, documented here
+    if peer.rank() == 0:
+        peer.channel.gather_bytes(blob, peer.cluster.workers, name="probe")  # kflint: allow(collective-consistency)

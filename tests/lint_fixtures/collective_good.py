@@ -1,0 +1,42 @@
+"""Compliant collective usage: symmetric splits, versioned names, and
+names derived from cluster-agreed state.  Must lint clean."""
+
+
+def symmetric_broadcast(peer, blob):
+    # the root/leaf split issues the SAME (op, name) on both sides — the
+    # shrink replay-point broadcast idiom
+    name = f"boot.v{peer.cluster_version}"
+    if peer.rank() == 0:
+        peer.channel.broadcast_bytes(blob, peer.cluster.workers, name)
+        return blob
+    return peer.channel.broadcast_bytes(None, peer.cluster.workers, name)
+
+
+def versioned_sync(peer, digest):
+    return peer.channel.consensus_bytes(
+        digest, peer.cluster.workers, name=f"sync.v{peer.cluster_version}"
+    )
+
+
+def another_versioned_sync(peer, digest):
+    # same shape as versioned_sync but the names are f-strings, not
+    # constants — versioned names never collide as "reuse"
+    return peer.channel.consensus_bytes(
+        digest, peer.cluster.workers, name=f"sync.v{peer.cluster_version}"
+    )
+
+
+def agreed_gather(peer, blob, digest):
+    # a payload-digest name is cluster-agreed state, not local entropy
+    return peer.channel.gather_bytes(
+        blob, peer.cluster.workers, name=f"snap.{digest}"
+    )
+
+
+def _shared_phase(peer):
+    peer.channel.barrier(peer.cluster.workers, name="phase")
+
+
+def every_rank_announces(peer):
+    # the helper is reached unconditionally — fine
+    _shared_phase(peer)

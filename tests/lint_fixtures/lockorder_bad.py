@@ -1,0 +1,33 @@
+"""Seeded lock-order violations: an AB/BA inversion across methods and
+an interprocedural self-deadlock (tests/test_lint.py pins the lines)."""
+import threading
+
+
+class Pipeline:
+    def __init__(self):
+        self.mu = threading.Lock()
+        self.nu = threading.Lock()
+        self.items = []
+
+    def forward(self):
+        # takes mu then nu ...
+        with self.mu:
+            with self.nu:
+                return list(self.items)
+
+    def backward(self):
+        # BAD: ... while this path takes nu then mu (AB/BA inversion —
+        # two threads in forward()/backward() deadlock)
+        with self.nu:
+            with self.mu:
+                self.items.append(0)
+
+    def _locked_len(self):
+        with self.mu:
+            return len(self.items)
+
+    def report(self):
+        # BAD (interprocedural): calls a mu-taking helper while holding
+        # the non-reentrant mu — guaranteed self-deadlock
+        with self.mu:
+            return self._locked_len()

@@ -1,0 +1,30 @@
+"""Compliant locking: one global order (mu before nu) on every path,
+and reentrancy where a helper legitimately re-enters.  Must lint clean."""
+import threading
+
+
+class Pipeline:
+    def __init__(self):
+        self.mu = threading.RLock()
+        self.nu = threading.Lock()
+        self.items = []
+
+    def forward(self):
+        with self.mu:
+            with self.nu:
+                return list(self.items)
+
+    def backward(self):
+        # same order as forward — no inversion
+        with self.mu:
+            with self.nu:
+                self.items.append(0)
+
+    def _locked_len(self):
+        with self.mu:
+            return len(self.items)
+
+    def report(self):
+        # mu is an RLock: re-entry through a helper is legal
+        with self.mu:
+            return self._locked_len()

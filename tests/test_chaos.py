@@ -335,6 +335,123 @@ class TestKillOnePeerMidAllreduce:
                 p.close()
 
 
+class TestShrinkEdgeCases:
+    """The boundaries the acceptance scenario skips: survivor sets at
+    exactly quorum size, the leader dying during the replay-point
+    broadcast, and double-shrink reentry."""
+
+    def test_exact_half_is_not_quorum(self, monkeypatch):
+        """4 workers, 2 dead: the survivors are exactly HALF the
+        membership — not a strict majority, so the shrink must refuse
+        (two half-clusters continuing independently is divergence)."""
+        from kungfu_tpu.elastic import shrink
+
+        workers, peers = make_peers(4, 26650, monkeypatch)
+        try:
+            with pytest.raises(QuorumLostError):
+                shrink.shrink_to_survivors(peers[0], [2, 3])
+            # refused before any membership change
+            assert peers[0].size() == 4
+            assert peers[0].cluster_version == 0
+        finally:
+            for p in peers:
+                p.close()
+
+    def test_minimal_strict_majority_shrinks(self, monkeypatch):
+        """5 workers, 2 dead: 3 survivors is the smallest strict
+        majority — the consensus must run and the shrink must land."""
+        from kungfu_tpu.elastic import shrink
+
+        workers, peers = make_peers(5, 26660, monkeypatch)
+        try:
+            for i in (3, 4):
+                peers[i].close()
+            results = run_all([
+                lambda p=p: shrink.shrink_to_survivors(p, [3, 4])
+                for p in peers[:3]
+            ])
+            assert all(results)
+            for p in peers[:3]:
+                assert p.size() == 3
+                assert p.cluster_version == 1
+                assert not p.detached
+        finally:
+            for p in peers[:3]:
+                p.close()
+
+    def test_leader_death_during_replay_broadcast(self, monkeypatch):
+        """The shrink agreed but the leader (new rank 0) dies before its
+        StepSnapshot broadcast lands: the survivor must come out with
+        replay=None (no agreed boundary) and an intact local snapshot —
+        not a hang and not a half-adopted state."""
+        from kungfu_tpu.elastic import shrink
+
+        workers, peers = make_peers(2, 26670, monkeypatch)
+        snap = StepSnapshot()
+        snap.commit(7, {"w": np.full(4, 7.0, np.float32)}, {"epoch": 2})
+        try:
+            # non-leader view: the recv toward the dead leader times out
+            def dead_leader_broadcast(*a, **k):
+                raise TimeoutError("leader died mid-broadcast")
+
+            monkeypatch.setattr(peers[1].channel, "broadcast_bytes",
+                                dead_leader_broadcast)
+            assert shrink._sync_replay_point(peers[1], snap) is None
+            assert snap.step() == 7  # local boundary untouched
+        finally:
+            for p in peers:
+                p.close()
+
+    def test_leader_side_broadcast_failure_is_contained(self, monkeypatch):
+        """Mirror image: the LEADER's sends fail because the followers
+        died after voting.  The broadcast error must be contained to
+        replay=None, not raised out of the recovery driver."""
+        from kungfu_tpu.elastic import shrink
+
+        workers, peers = make_peers(2, 26680, monkeypatch)
+        snap = StepSnapshot()
+        snap.commit(3, {"w": np.zeros(2, np.float32)})
+        try:
+            peers[1].close()  # follower gone before the broadcast
+            assert shrink._sync_replay_point(peers[0], snap) is None
+        finally:
+            peers[0].close()
+
+    def test_double_shrink_reentry(self, monkeypatch):
+        """Recovery paths re-enter: a second shrink call naming the
+        already-evicted rank must be a no-op (stale dead ranks are out
+        of range for the shrunk membership), and a genuine second
+        failure must escalate through the quorum check."""
+        from kungfu_tpu.elastic import shrink
+
+        workers, peers = make_peers(3, 26690, monkeypatch)
+        try:
+            peers[2].close()
+            results = run_all([
+                lambda p=p: shrink.shrink_to_survivors(p, [2])
+                for p in peers[:2]
+            ])
+            assert all(results)
+            assert peers[0].size() == 2 and peers[0].cluster_version == 1
+
+            # reentry with the stale dead set: rank 2 no longer exists
+            assert shrink.shrink_to_survivors(peers[0], [2]) is False
+            assert peers[0].size() == 2 and peers[0].cluster_version == 1
+
+            # the driver agrees nothing is dead (ping sweep all-alive)
+            shrunk, replay = peers[0].recover_from_failure()
+            assert not shrunk and replay is None
+
+            # a genuine second failure: 1 of 2 survivors is no quorum
+            peers[1].close()
+            with pytest.raises(QuorumLostError):
+                peers[0].recover_from_failure(
+                    PeerFailureError(1, workers[1], phase="recv")
+                )
+        finally:
+            peers[0].close()
+
+
 class TestWireFaults:
     def test_reset_mid_chunk_recovered_by_retry(self, monkeypatch):
         """A connection reset halfway through a chunk is a transient: the

@@ -3,6 +3,7 @@ actually catch what they claim to catch (fixtures under
 tests/lint_fixtures/ seed known violations).
 """
 
+import json
 import os
 import shutil
 import subprocess
@@ -10,12 +11,15 @@ import sys
 
 from kungfu_tpu.analysis import (
     blockingio,
+    collectives,
     envcheck,
     jitpurity,
     lockcheck,
+    pylockorder,
     retrydiscipline,
+    wirecontract,
 )
-from kungfu_tpu.analysis.cli import run_checkers
+from kungfu_tpu.analysis.cli import main as cli_main, run_checkers
 from kungfu_tpu.analysis.core import repo_root
 
 ROOT = repo_root(os.path.dirname(os.path.abspath(__file__)))
@@ -128,6 +132,240 @@ class TestRetryDiscipline:
         # waived constant sleep — neither may surface
         flagged = {v.line for v in self._violations(tmp_path)}
         assert not any(38 <= line <= 46 for line in flagged), flagged
+
+
+class TestCollectiveConsistency:
+    """The kf-verify SPMD rule: rank-conditional collectives, constant
+    rendezvous-name reuse, and peer-divergent name expressions — including
+    the interprocedural helper-behind-a-rank-branch shape."""
+
+    def test_fixture_violations_caught(self, tmp_path):
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": "collective_bad.py"})
+        got = sorted((v.line, v.message) for v in collectives.check(root))
+        assert [line for line, _ in got] == [10, 21, 33, 40], got
+        assert "rank-conditional branch" in got[0][1]
+        assert "called only under rank-conditional branches" in got[1][1]
+        assert "reused from" in got[2][1]
+        assert "diverges across peers" in got[3][1]
+
+    def test_suppression_honored(self, tmp_path):
+        # waived_probe (the allow() line) must not surface
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": "collective_bad.py"})
+        assert all(v.line < 44 for v in collectives.check(root))
+
+    def test_good_fixture_clean(self, tmp_path):
+        """The symmetric root/leaf split, versioned names, and digest
+        names — the tree's idioms — must pass untouched."""
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": "collective_good.py"})
+        assert collectives.check(root) == [], \
+            [v.render() for v in collectives.check(root)]
+
+    def test_comm_layer_out_of_scope(self, tmp_path):
+        # the collective IMPLEMENTATION branches on rank by design
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/comm/mod.py": "collective_bad.py",
+        })
+        assert collectives.check(root) == []
+
+    def test_helper_called_on_both_sides_is_balanced(self, tmp_path):
+        """A helper invoked in BOTH branches of a rank split runs on
+        every rank — the interprocedural rule must not flag it."""
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/mod.py":
+                "def _announce(peer):\n"
+                "    peer.channel.barrier(peer.cluster.workers,"
+                " name='announce')\n\n\n"
+                "def sync(peer):\n"
+                "    if peer.rank() == 0:\n"
+                "        _announce(peer)\n"
+                "    else:\n"
+                "        _announce(peer)\n",
+        })
+        assert collectives.check(root) == [], \
+            [v.render() for v in collectives.check(root)]
+
+    def test_literal_symmetric_split_not_reuse(self, tmp_path):
+        """The compliant root/leaf split written with a literal name is
+        a balanced pair, not cross-path name reuse."""
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/mod.py":
+                "def bcast(peer, blob, workers):\n"
+                "    if peer.rank() == 0:\n"
+                "        peer.channel.broadcast_bytes(blob, workers,"
+                " name='boot')\n"
+                "        return blob\n"
+                "    return peer.channel.broadcast_bytes(None, workers,"
+                " name='boot')\n",
+        })
+        assert collectives.check(root) == [], \
+            [v.render() for v in collectives.check(root)]
+
+
+class TestWireContract:
+    """Python framing vs C++ decoder: the real pair diffs clean, and a
+    seeded one-byte mutation on EITHER side is caught (the acceptance
+    criterion)."""
+
+    def _tree(self, tmp_path, mutate_host=None, mutate_cpp=None):
+        host = open(os.path.join(ROOT, "kungfu_tpu", "comm", "host.py")).read()
+        cpp = open(os.path.join(ROOT, "kungfu_tpu", "native",
+                                "transport.cpp")).read()
+        if mutate_host:
+            mutated = mutate_host(host)
+            assert mutated != host, "mutation must change the file"
+            host = mutated
+        if mutate_cpp:
+            mutated = mutate_cpp(cpp)
+            assert mutated != cpp, "mutation must change the file"
+            cpp = mutated
+        return _tmp_tree(tmp_path, {
+            "kungfu_tpu/comm/host.py": host,
+            "kungfu_tpu/native/transport.cpp": cpp,
+        })
+
+    def test_real_pair_diffs_clean(self, tmp_path):
+        root = self._tree(tmp_path)
+        assert wirecontract.check(root) == [], \
+            [v.render() for v in wirecontract.check(root)]
+
+    def test_one_byte_python_format_mutation(self, tmp_path):
+        # "<IIBH" -> "<IIBI": src_len silently widens to u32
+        root = self._tree(tmp_path, mutate_host=lambda s: s.replace(
+            'HEAD_FMT = "<IIBH"', 'HEAD_FMT = "<IIBI"'))
+        msgs = [v.message for v in wirecontract.check(root)]
+        assert any("IIBIHI" in m and "IIBHHI" in m for m in msgs), msgs
+
+    def test_one_byte_cpp_prefix_mutation(self, tmp_path):
+        # head[11] -> head[12]: the C++ fixed prefix drifts off the wire
+        root = self._tree(tmp_path, mutate_cpp=lambda s: s.replace(
+            "uint8_t head[11]", "uint8_t head[12]"))
+        msgs = [v.message for v in wirecontract.check(root)]
+        assert any("head[12]" in m for m in msgs), msgs
+
+    def test_cpp_field_widening_caught(self, tmp_path):
+        root = self._tree(tmp_path, mutate_cpp=lambda s: s.replace(
+            "put_u16(out, static_cast<uint16_t>(src.size()));",
+            "put_u32(out, static_cast<uint32_t>(src.size()));"))
+        msgs = [v.message for v in wirecontract.check(root)]
+        assert any("decode_head field sequence" in m for m in msgs), msgs
+
+    def test_magic_drift_caught(self, tmp_path):
+        root = self._tree(tmp_path, mutate_host=lambda s: s.replace(
+            "0x4B465450", "0x4B465451"))
+        msgs = [v.message for v in wirecontract.check(root)]
+        assert any("kMagic" in m for m in msgs), msgs
+
+    def test_codec_bypass_caught(self, tmp_path):
+        """A second raw pack site inside the framing functions is exactly
+        how drift starts — flagged even while still byte-identical."""
+        root = self._tree(tmp_path, mutate_host=lambda s: s.replace(
+            "return HeaderCodec.pack_head(token, conn_type, sb, nb, nbytes)",
+            'return struct.pack("<IIBH", MAGIC, token, conn_type, len(sb))'
+            ' + sb + struct.pack("<H", len(nb)) + nb'
+            ' + struct.pack("<L", nbytes)'))
+        msgs = [v.message for v in wirecontract.check(root)]
+        assert any("bypasses HeaderCodec" in m for m in msgs), msgs
+
+    def test_partial_tree_is_silent(self, tmp_path):
+        # fixture layouts without the pair must not fail other checkers
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": "env_bad.py"})
+        assert wirecontract.check(root) == []
+
+    def test_byte_identical_letter_swap_not_drift(self, tmp_path):
+        """"<LLBH" packs byte-for-byte like "<IIBH" — the contract is
+        width + order, so a same-width letter swap must diff clean."""
+        root = self._tree(tmp_path, mutate_host=lambda s: s.replace(
+            'HEAD_FMT = "<IIBH"', 'HEAD_FMT = "<LLBH"'))
+        assert wirecontract.check(root) == [], \
+            [v.render() for v in wirecontract.check(root)]
+
+
+class TestLockOrder:
+    def test_fixture_violations_caught(self, tmp_path):
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": "lockorder_bad.py"})
+        got = sorted((v.line, v.message) for v in pylockorder.check(root))
+        assert [line for line, _ in got] == [15, 33], got
+        assert "lock-order cycle" in got[0][1]
+        # the cycle message names both witness edges
+        assert "mod.py:22" in got[0][1]
+        assert "self-deadlock" in got[1][1]
+
+    def test_good_fixture_clean(self, tmp_path):
+        """Consistent global order + RLock re-entry must pass."""
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": "lockorder_good.py"})
+        assert pylockorder.check(root) == [], \
+            [v.render() for v in pylockorder.check(root)]
+
+    def test_release_inside_with_does_not_crash(self, tmp_path):
+        """The lock-handoff pattern (explicit release() inside the with
+        body) must scan clean, not crash the gate."""
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/mod.py":
+                "import threading\n\n\n"
+                "class Handoff:\n"
+                "    def __init__(self):\n"
+                "        self.mu = threading.Lock()\n\n"
+                "    def hand_over(self):\n"
+                "        with self.mu:\n"
+                "            self.mu.release()\n",
+        })
+        assert pylockorder.check(root) == [], \
+            [v.render() for v in pylockorder.check(root)]
+
+
+class TestBaselineAndJson:
+    """kflint --json / --baseline: new rules can land with a suppression
+    baseline instead of blocking on legacy findings."""
+
+    def _seeded_root(self, tmp_path):
+        return _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": "collective_bad.py"})
+
+    def test_json_output(self, tmp_path, capsys):
+        root = self._seeded_root(tmp_path)
+        rc = cli_main(["--root", root, "--checker", "collective-consistency",
+                       "--json"])
+        assert rc == 1
+        findings = json.loads(capsys.readouterr().out)
+        assert len(findings) == 4
+        assert {f["checker"] for f in findings} == {"collective-consistency"}
+        assert all({"path", "line", "message"} <= set(f) for f in findings)
+
+    def test_baseline_roundtrip(self, tmp_path, capsys):
+        root = self._seeded_root(tmp_path)
+        baseline = str(tmp_path / "baseline.json")
+        # snapshot the legacy findings ...
+        rc = cli_main(["--root", root, "--checker", "collective-consistency",
+                       "--write-baseline", baseline])
+        assert rc == 0
+        entries = json.load(open(baseline))
+        assert len(entries) == 4
+        # ... and the gate passes against them, but fails without them
+        assert cli_main(["--root", root, "--checker",
+                         "collective-consistency",
+                         "--baseline", baseline]) == 0
+        assert cli_main(["--root", root, "--checker",
+                         "collective-consistency"]) == 1
+        capsys.readouterr()
+
+    def test_baseline_does_not_mask_new_findings(self, tmp_path, capsys):
+        root = self._seeded_root(tmp_path)
+        baseline = str(tmp_path / "baseline.json")
+        cli_main(["--root", root, "--checker", "collective-consistency",
+                  "--write-baseline", baseline])
+        # drop one entry: that finding is now "new" again
+        entries = json.load(open(baseline))
+        json.dump(entries[:-1], open(baseline, "w"))
+        assert cli_main(["--root", root, "--checker",
+                         "collective-consistency",
+                         "--baseline", baseline]) == 1
+        capsys.readouterr()
+
+    def test_malformed_baseline_is_loud(self, tmp_path, capsys):
+        root = self._seeded_root(tmp_path)
+        bad = tmp_path / "bad.json"
+        bad.write_text('{"not": "a list"}')
+        assert cli_main(["--root", root, "--baseline", str(bad)]) == 2
+        capsys.readouterr()
 
 
 class TestEnvContract:
