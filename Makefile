@@ -5,12 +5,12 @@
 PY ?= python3
 BASELINE := tests/lint_baseline.json
 
-.PHONY: lint verify check test native help
+.PHONY: lint verify check test native trace-demo help
 
-## lint: all eight kf-lint rules — the Python suite (env-contract,
+## lint: all nine kf-lint rules — the Python suite (env-contract,
 ## jit-sync, blocking-io, retry-discipline, collective-consistency,
-## wire-contract, lock-order) AND the transport.cpp lockcheck
-## (lock-discipline) in one command, honoring the suppression baseline.
+## wire-contract, lock-order, trace-vocab) AND the transport.cpp
+## lockcheck (lock-discipline) in one command, honoring the baseline.
 lint:
 	$(PY) scripts/kflint $(if $(wildcard $(BASELINE)),--baseline $(BASELINE))
 
@@ -33,6 +33,21 @@ test:
 ## native: production build of the native transport.
 native:
 	$(MAKE) -C kungfu_tpu/native
+
+## trace-demo: 4-peer local run with an injected 400 ms straggler on
+## rank 2 (every 9th matching send, so most collectives stay clean and
+## the stalls read as spikes) and the flight recorder on; merges the
+## per-rank dumps into trace-demo/trace.json (chrome://tracing /
+## ui.perfetto.dev) and prints the straggler report — the fault-overlap
+## section should attribute the spikes to chaos:delay on rank 2.
+trace-demo:
+	rm -rf trace-demo && mkdir -p trace-demo
+	$(PY) -m kungfu_tpu.runner.cli -np 4 -H 127.0.0.1:4 \
+	    -trace -trace-dump trace-demo \
+	    -chaos 'delay:ms=400,rank=2,every=9' \
+	    $(PY) examples/mnist_slp.py --n-epochs 1
+	$(PY) scripts/kftrace merge -o trace-demo/trace.json trace-demo/*.jsonl
+	$(PY) scripts/kftrace report trace-demo/*.jsonl
 
 help:
 	@grep -E '^## ' Makefile | sed 's/^## //'

@@ -37,6 +37,7 @@ from kungfu_tpu.analysis import (
     lockcheck,
     pylockorder,
     retrydiscipline,
+    tracevocab,
     wirecontract,
 )
 from kungfu_tpu.analysis.core import Violation, repo_root
@@ -50,6 +51,7 @@ CHECKERS: Dict[str, object] = {
     collectives.CHECKER: collectives.check,
     wirecontract.CHECKER: wirecontract.check,
     pylockorder.CHECKER: pylockorder.check,
+    tracevocab.CHECKER: tracevocab.check,
 }
 
 #: the kf-verify subset: the interprocedural rules built on the shared

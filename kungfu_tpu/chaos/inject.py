@@ -23,6 +23,7 @@ import time
 from typing import List, Optional
 
 from kungfu_tpu.chaos.spec import Clause, parse_spec
+from kungfu_tpu.monitor import timeline
 from kungfu_tpu.utils import envs
 from kungfu_tpu.utils.log import get_logger
 
@@ -75,6 +76,11 @@ class ChaosController:
     def _die(self, clause: Clause, why: str) -> None:
         mode = clause.get("mode", "exit")
         _log.warning("chaos: injecting death (%s, mode=%s)", why, mode)
+        timeline.event("chaos", "die", rank=self.rank, why=why, mode=mode)
+        if mode == "exit":
+            # os._exit skips atexit — flush the flight recorder first so
+            # the injected death is correlatable in the merged timeline
+            timeline.maybe_dump()
         if mode == "raise":
             raise InjectedDeath(why)
         os._exit(DIE_EXIT_CODE)
@@ -130,6 +136,8 @@ class ChaosController:
             self._rng.uniform(0, c.get("jitter", 0)) if c.get("jitter") else 0
         )
         if ms > 0:
+            timeline.event("chaos", "delay", rank=self.rank, ms=ms,
+                           peer=other_rank)
             time.sleep(ms / 1000.0)
 
     def _reset(self, name: str, payload, channel, peer) -> None:
@@ -150,6 +158,8 @@ class ChaosController:
         _log.warning(
             "chaos: reset mid-chunk on %r (%d/%d bytes sent)", name, sent, nbytes
         )
+        timeline.event("chaos", "reset", rank=self.rank, coll=name,
+                       sent=sent, nbytes=nbytes)
         raise InjectedReset(f"injected reset mid-chunk on {name!r}")
 
     # -- control-plane faults ---------------------------------------------
@@ -168,6 +178,7 @@ class ChaosController:
                         continue
                     self._fanout_dropped[i] = used + 1
             _log.warning("chaos: dropping detector fan-out to %s", host)
+            timeline.event("chaos", "drop_fanout", rank=self.rank, host=host)
             return True
         return False
 
@@ -181,6 +192,8 @@ class ChaosController:
             if c.kind == "config_down":
                 after = c.get("after", 0)
                 if after < n <= after + c.get("count", 1):
+                    timeline.event("chaos", "config_down", rank=self.rank,
+                                   fetch=n)
                     return True
         return False
 
@@ -208,7 +221,11 @@ def controller_for(rank: Optional[int]) -> Optional[ChaosController]:
 
 def note_step(rank: Optional[int], step: int) -> None:
     """Training-loop step announcement (drives ``die:step=N``); free when
-    chaos is disabled."""
+    chaos is disabled.  Also stamps the flight recorder's step counter —
+    every instrumented training loop already calls this at each step
+    boundary, so timeline events get step attribution without a second
+    per-step hook."""
+    timeline.set_step(step)
     ctl = controller_for(rank)
     if ctl is not None:
         ctl.on_step(step)

@@ -51,6 +51,7 @@ import numpy as np
 from kungfu_tpu.utils.jaxcompat import axis_size, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from kungfu_tpu.monitor import timeline
 from kungfu_tpu.plan.cluster import Cluster
 from kungfu_tpu.utils.log import get_logger
 
@@ -61,6 +62,23 @@ LOCAL_AXIS = "kf_local"
 GLOBAL_AXES = (HOST_AXIS, LOCAL_AXIS)
 
 _REDUCE_OPS = ("sum", "min", "max", "prod", "mean")
+
+
+def _traced_collective(name: str, op: str, n: int, version: int, fn):
+    """Run an eager collective under a device-plane timeline span.
+
+    JAX dispatch is asynchronous — the eager call returns once the op is
+    enqueued — so an un-fenced span would time dispatch, not execution,
+    and a straggler-stalled collective would record microseconds (the
+    exact signal kftrace exists to expose, inverted).  Traced runs
+    therefore block on the result inside the span; untraced runs (the
+    production default) keep the async fast path untouched."""
+    if not timeline.enabled():
+        return fn()
+    with timeline.span("device", name, op=op, n=n, version=version):
+        out = fn()
+        jax.block_until_ready(out)
+    return out
 
 
 def _tree_stack_check(n: int, x):
@@ -443,7 +461,10 @@ class Communicator:
         if op not in _REDUCE_OPS:
             raise ValueError(f"op {op!r} not in {_REDUCE_OPS}")
         _tree_stack_check(self._local_n, x)
-        return jax.tree_util.tree_map(lambda a: self._all_reduce_leaf(a, op, GLOBAL_AXES), x)
+        return _traced_collective(
+            "device.all_reduce", "all_reduce", self._n, self.version,
+            lambda: jax.tree_util.tree_map(
+                lambda a: self._all_reduce_leaf(a, op, GLOBAL_AXES), x))
 
     def _all_reduce_leaf(self, a, op, axes):
         a = jnp.asarray(a)
@@ -539,7 +560,9 @@ class Communicator:
 
             return self._cached(key, build)(a)
 
-        return jax.tree_util.tree_map(leaf, x)
+        return _traced_collective(
+            "device.broadcast", "broadcast", self._n, self.version,
+            lambda: jax.tree_util.tree_map(leaf, x))
 
     def first_slot_of_process(self, proc: int) -> int:
         """First flat device slot owned by jax process ``proc`` — the
@@ -603,7 +626,9 @@ class Communicator:
 
             return self._cached(key, build)(a)
 
-        return jax.tree_util.tree_map(leaf, x)
+        return _traced_collective(
+            "device.all_gather", "all_gather", self._n, self.version,
+            lambda: jax.tree_util.tree_map(leaf, x))
 
     def gather(self, x, root: int = 0):
         """DELIBERATE SEMANTIC DIVERGENCE from the reference: the
@@ -669,7 +694,9 @@ class Communicator:
         In multi-controller mode this synchronizes exactly the processes
         whose devices are in this mesh epoch."""
         x = jnp.ones((self._local_n, 1), dtype=jnp.int32)
-        jax.block_until_ready(self.all_reduce(x))
+        with timeline.span("device", "device.barrier",
+                           op="barrier", n=self._n, version=self.version):
+            jax.block_until_ready(self.all_reduce(x))
 
     def consensus(self, x) -> bool:
         """True iff every peer's slice is bit-identical — allreduce MIN ==

@@ -31,9 +31,9 @@ from kungfu_tpu import native
 from kungfu_tpu.chaos import controller_for as _chaos_controller_for
 from kungfu_tpu.comm.faults import PeerFailureError
 from kungfu_tpu.comm.host import CONNECT_TIMEOUT_S, ConnType, HostChannel
+from kungfu_tpu.monitor import timeline
 from kungfu_tpu.utils import envs
 from kungfu_tpu.utils.retry import sleep_backoff
-from kungfu_tpu.utils.trace import trace_scope
 from kungfu_tpu.plan import (
     Strategy,
     auto_select,
@@ -210,6 +210,17 @@ class CollectiveEngine:
         self._chaos = _chaos_controller_for(
             self.rank if chaos_rank is _CHAOS_RANK_UNSET else chaos_rank
         )
+        #: identity stamped on timeline events: the STABLE bootstrap rank
+        #: when the owner supplied one (a shrink renumbers self.rank on
+        #: the rebuilt engine, and a merged kftrace timeline must keep
+        #: one track per process — a renumbered survivor would otherwise
+        #: alias a pre-shrink peer's track); engines built directly
+        #: (tests, no resize in play) use the live rank
+        self._timeline_rank = (
+            chaos_rank
+            if chaos_rank is not _CHAOS_RANK_UNSET and chaos_rank is not None
+            else self.rank
+        )
         #: resolved once — _send/_recv run per chunk per peer, and a
         #: per-call env parse on that path is measurable noise (engines
         #: are rebuilt each mesh epoch, so retuning still lands)
@@ -257,7 +268,10 @@ class CollectiveEngine:
         x = np.ascontiguousarray(x)
         flat = x.reshape(-1)
         tag = name or f"ar{self._next_seq()}"
-        with trace_scope(f"engine.all_reduce[{flat.nbytes}B]"):
+        with timeline.span(
+            "collective", f"engine.all_reduce[{flat.nbytes}B]",
+            rank=self._timeline_rank, op="all_reduce", tag=tag, nbytes=flat.nbytes,
+        ):
             out = self._run_over_graphs(
                 flat, eff_op, tag, self._graphs, record=record, inplace=inplace
             )
@@ -289,7 +303,11 @@ class CollectiveEngine:
         tag = name or f"bc{seq}"
         _, bcast_g = gen_star(len(self.peers), center=root)
         flat = np.ascontiguousarray(x).reshape(-1)
-        out = self._run_bcast(flat.copy(), f"{tag}", bcast_g)
+        with timeline.span(
+            "collective", "engine.broadcast", rank=self._timeline_rank,
+            op="broadcast", tag=tag, nbytes=flat.nbytes,
+        ):
+            out = self._run_bcast(flat.copy(), f"{tag}", bcast_g)
         return out.reshape(x.shape)
 
     def reduce(self, x: np.ndarray, root: int = 0, op: str = "sum", name: str = "") -> np.ndarray:
@@ -302,11 +320,13 @@ class CollectiveEngine:
         reduce_g, _ = gen_star(len(self.peers), center=root)
         me = self.rank
         acc = flat.copy()
-        for prev in reduce_g.prevs(me):
-            data = np.frombuffer(self._recv(prev, tag), dtype=flat.dtype)
-            acc = native.transform2(acc, data, eff_op)
-        for nxt in reduce_g.nexts(me):
-            self._send(nxt, tag, acc.tobytes())
+        with timeline.span("collective", "engine.reduce", rank=self._timeline_rank,
+                           op="reduce", tag=tag, nbytes=flat.nbytes):
+            for prev in reduce_g.prevs(me):
+                data = np.frombuffer(self._recv(prev, tag), dtype=flat.dtype)
+                acc = native.transform2(acc, data, eff_op)
+            for nxt in reduce_g.nexts(me):
+                self._send(nxt, tag, acc.tobytes())
         if me == root and op == "mean":
             acc = acc / len(self.peers)
         return acc.reshape(x.shape) if me == root else x
@@ -317,16 +337,20 @@ class CollectiveEngine:
         self._chaos_collective(name or "gather")
         tag = (name or f"ga{self._next_seq()}") + ".g"
         flat = np.ascontiguousarray(x).reshape(-1)
-        if self.rank == root:
-            parts = []
-            for r in range(len(self.peers)):
-                if r == root:
-                    parts.append(flat)
-                else:
-                    parts.append(np.frombuffer(self._recv(r, tag), dtype=flat.dtype))
-            return np.stack(parts).reshape((len(self.peers),) + x.shape)
-        self._send(root, tag, flat.tobytes())
-        return None
+        with timeline.span("collective", "engine.gather", rank=self._timeline_rank,
+                           op="gather", tag=tag, nbytes=flat.nbytes):
+            if self.rank == root:
+                parts = []
+                for r in range(len(self.peers)):
+                    if r == root:
+                        parts.append(flat)
+                    else:
+                        parts.append(
+                            np.frombuffer(self._recv(r, tag), dtype=flat.dtype)
+                        )
+                return np.stack(parts).reshape((len(self.peers),) + x.shape)
+            self._send(root, tag, flat.tobytes())
+            return None
 
     def all_gather(self, x: np.ndarray, name: str = "") -> np.ndarray:
         """Direct full-exchange (reference ``allgather.go:17-45``): every
@@ -335,15 +359,19 @@ class CollectiveEngine:
         tag = (name or f"ag{self._next_seq()}") + ".ag"
         flat = np.ascontiguousarray(x).reshape(-1)
         me = self.rank
-        for r in range(len(self.peers)):
-            if r != me:
-                self._send(r, tag, flat.tobytes())
-        parts = []
-        for r in range(len(self.peers)):
-            if r == me:
-                parts.append(flat)
-            else:
-                parts.append(np.frombuffer(self._recv(r, tag), dtype=flat.dtype))
+        with timeline.span("collective", "engine.all_gather", rank=self._timeline_rank,
+                           op="all_gather", tag=tag, nbytes=flat.nbytes):
+            for r in range(len(self.peers)):
+                if r != me:
+                    self._send(r, tag, flat.tobytes())
+            parts = []
+            for r in range(len(self.peers)):
+                if r == me:
+                    parts.append(flat)
+                else:
+                    parts.append(
+                        np.frombuffer(self._recv(r, tag), dtype=flat.dtype)
+                    )
         return np.stack(parts).reshape((len(self.peers),) + x.shape)
 
     # -- hierarchical (host-partitioned) collectives ----------------------
@@ -389,7 +417,11 @@ class CollectiveEngine:
         flat = np.ascontiguousarray(x).reshape(-1)
         ranks = self._local_ranks()
         root = min(ranks)
-        acc = self._subset_reduce(flat, ranks, root, "sum" if op == "mean" else op, tag)
+        with timeline.span("collective", "engine.local_reduce",
+                           rank=self._timeline_rank, op="local_reduce", tag=tag,
+                           nbytes=flat.nbytes):
+            acc = self._subset_reduce(
+                flat, ranks, root, "sum" if op == "mean" else op, tag)
         if self.rank == root:
             if op == "mean":
                 acc = acc / len(ranks)
@@ -402,7 +434,10 @@ class CollectiveEngine:
         tag = (name or f"lb{self._next_seq()}") + ".lb"
         flat = np.ascontiguousarray(x).reshape(-1)
         ranks = self._local_ranks()
-        out = self._subset_bcast(flat, ranks, min(ranks), tag)
+        with timeline.span("collective", "engine.local_broadcast",
+                           rank=self._timeline_rank, op="local_broadcast", tag=tag,
+                           nbytes=flat.nbytes):
+            out = self._subset_bcast(flat, ranks, min(ranks), tag)
         return out.reshape(x.shape)
 
     def cross_all_reduce(self, x: np.ndarray, op: str = "sum", name: str = "") -> np.ndarray:
@@ -416,15 +451,22 @@ class CollectiveEngine:
         local = self._local_ranks()
         local_root = min(local)
         roots = self._local_roots()
-        acc = self._subset_reduce(flat, local, local_root, eff_op, base + ".lr")
-        if self.rank == local_root and len(roots) > 1:
-            # allreduce among the host roots via the cross-stage strategy
-            # graphs (ring rotations or binary tree over the masters,
-            # reference strategy.go:188-210), chunked like the global path
-            acc = self._run_over_graphs(
-                np.ascontiguousarray(acc), eff_op, base + ".x", self._cross_graphs
-            )
-        acc = self._subset_bcast(acc, local, local_root, base + ".lb")
+        with timeline.span(
+            "collective", "engine.cross_all_reduce", rank=self._timeline_rank,
+            op="cross_all_reduce", tag=base, nbytes=flat.nbytes,
+        ):
+            acc = self._subset_reduce(
+                flat, local, local_root, eff_op, base + ".lr")
+            if self.rank == local_root and len(roots) > 1:
+                # allreduce among the host roots via the cross-stage
+                # strategy graphs (ring rotations or binary tree over the
+                # masters, reference strategy.go:188-210), chunked like
+                # the global path
+                acc = self._run_over_graphs(
+                    np.ascontiguousarray(acc), eff_op, base + ".x",
+                    self._cross_graphs,
+                )
+            acc = self._subset_bcast(acc, local, local_root, base + ".lb")
         if op == "mean":
             acc = acc / len(self.peers)
         return acc.reshape(x.shape)
@@ -537,11 +579,15 @@ class CollectiveEngine:
         # per-peer attribution — rank=None tells the recovery driver to
         # find the dead set by probing (elastic/shrink.find_dead_ranks)
         if rc == 1:
+            timeline.event("deadline", tag, rank=self._timeline_rank,
+                           phase="native-collective", cause="TimeoutError")
             raise PeerFailureError(
                 None, op=tag, phase="native-collective",
                 cause=TimeoutError(f"native collective {tag!r} timed out"),
             )
         if rc == 2:
+            timeline.event("deadline", tag, rank=self._timeline_rank,
+                           phase="native-collective", cause="ConnectionError")
             raise PeerFailureError(
                 None, op=tag, phase="native-collective",
                 cause=ConnectionError(
@@ -629,9 +675,17 @@ class CollectiveEngine:
                 return
             except (ConnectionError, TimeoutError, OSError) as e:
                 if time.monotonic() >= deadline:
+                    timeline.event(
+                        "deadline", name, rank=self._timeline_rank, peer=rank,
+                        phase="send", cause=type(e).__name__,
+                    )
                     raise PeerFailureError(
                         rank, peer, op=name, phase="send", cause=e
                     ) from e
+                timeline.event(
+                    "retry", name, rank=self._timeline_rank, peer=rank,
+                    attempt=attempt, cause=type(e).__name__,
+                )
                 sleep_backoff(attempt, base=0.05, cap=1.0)
                 attempt += 1
 
@@ -644,6 +698,8 @@ class CollectiveEngine:
                 peer, name, ConnType.COLLECTIVE, timeout=self._peer_deadline
             )
         except (TimeoutError, ConnectionError) as e:
+            timeline.event("deadline", name, rank=self._timeline_rank, peer=rank,
+                           phase="recv", cause=type(e).__name__)
             raise PeerFailureError(
                 rank, peer, op=name, phase="recv", cause=e
             ) from e
@@ -662,6 +718,8 @@ class CollectiveEngine:
                 peer, name, arr, ConnType.COLLECTIVE, timeout=self._peer_deadline
             )
         except (TimeoutError, ConnectionError) as e:
+            timeline.event("deadline", name, rank=self._timeline_rank, peer=rank,
+                           phase="recv", cause=type(e).__name__)
             raise PeerFailureError(
                 rank, peer, op=name, phase="recv", cause=e
             ) from e

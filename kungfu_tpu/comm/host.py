@@ -49,6 +49,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from kungfu_tpu.monitor import timeline
 from kungfu_tpu.plan.peer import PeerID, parse_peer_id
 from kungfu_tpu.plan.peerlist import PeerList
 from kungfu_tpu.utils.log import get_logger
@@ -500,6 +501,11 @@ class PyHostChannel(_ChannelOps):
         # sendall accepts buffer-protocol objects directly
         nbytes = _payload_nbytes(payload)
         head = _encode_head(self._token, conn_type, str(self.self_id), name, nbytes)
+        # enabled() guard BEFORE building the kwargs: this runs per chunk
+        # per peer, and the disabled path must not pay str()/dict cost
+        if timeline.enabled():
+            timeline.event("send", name, peer=str(peer), nbytes=nbytes,
+                           conn=int(conn_type))
         if self.monitor is not None:
             # payload bytes on both sides (ingress counts the same), so
             # egress/ingress totals of a symmetric exchange match
@@ -586,9 +592,15 @@ class PyHostChannel(_ChannelOps):
         timeout: Optional[float] = 60.0,
     ) -> bytes:
         try:
-            return self._queue(conn_type, str(src), name, self._token).get(timeout=timeout)
+            payload = self._queue(
+                conn_type, str(src), name, self._token
+            ).get(timeout=timeout)
         except queue.Empty:
             raise TimeoutError(f"recv {name!r} from {src} timed out after {timeout}s") from None
+        if timeline.enabled():
+            timeline.event("recv", name, peer=str(src), nbytes=len(payload),
+                           conn=int(conn_type))
+        return payload
 
     def recv_into(
         self, src: PeerID, name: str, buf,
@@ -612,6 +624,9 @@ class PyHostChannel(_ChannelOps):
             q.put(payload)
             return False
         mv[:] = payload
+        if timeline.enabled():
+            timeline.event("recv", name, peer=str(src), nbytes=mv.nbytes,
+                           conn=int(conn_type))
         return True
 
     def post_recv(
@@ -744,14 +759,24 @@ class NativeHostChannel(_ChannelOps):
     ) -> None:
         # egress is counted in the C++ send (shared with the native engine
         # executor) and polled by _ingress_poll — no wrapper-side count,
-        # which would double it
+        # which would double it.  The timeline mark covers every frame
+        # that crosses THIS wrapper; fully-native engine collectives
+        # bypass it and surface as their collective span instead.
+        if timeline.enabled():
+            timeline.event("send", name, peer=str(peer),
+                           nbytes=_payload_nbytes(payload),
+                           conn=int(conn_type))
         self._t.send(str(peer), name, payload, int(conn_type), retries)
 
     def recv(
         self, src: PeerID, name: str, conn_type: ConnType = ConnType.COLLECTIVE,
         timeout: Optional[float] = 60.0,
     ) -> bytes:
-        return self._t.recv(str(src), name, int(conn_type), timeout)
+        payload = self._t.recv(str(src), name, int(conn_type), timeout)
+        if timeline.enabled():
+            timeline.event("recv", name, peer=str(src), nbytes=len(payload),
+                           conn=int(conn_type))
+        return payload
 
     def recv_into(
         self, src: PeerID, name: str, buf,

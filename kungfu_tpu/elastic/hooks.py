@@ -23,6 +23,7 @@ import numpy as np
 from kungfu_tpu.chaos import note_step as _chaos_note_step
 from kungfu_tpu.elastic.schedule import step_based_schedule
 from kungfu_tpu.initializer import broadcast_parameters
+from kungfu_tpu.monitor import timeline
 from kungfu_tpu.monitor.signals import monitor_compile_grace
 from kungfu_tpu.utils.log import get_logger, log_event
 
@@ -68,6 +69,9 @@ def elastic_step(
     # same step boundary on every rank (no-op unless KF_CHAOS_SPEC).
     # chaos_rank, not rank(): clause targeting survives rank reshuffles
     _chaos_note_step(peer.chaos_rank(), state.step)
+    # note_step above already stamped the flight recorder's step counter;
+    # the mark makes the step boundary itself visible in merged timelines
+    timeline.event("step", f"step{state.step}", rank=peer.chaos_rank())
     step = sync_step(peer, state.step)
     target = step_based_schedule(schedule, step) if schedule else peer.size()
     changed = False

@@ -36,6 +36,7 @@ from __future__ import annotations
 from typing import Iterable, List, Optional, Sequence, Tuple
 
 from kungfu_tpu.comm.faults import PeerFailureError, QuorumLostError
+from kungfu_tpu.monitor import timeline
 from kungfu_tpu.plan.cluster import Cluster
 from kungfu_tpu.utils.log import get_logger, log_event
 
@@ -80,11 +81,15 @@ def find_dead_ranks(peer, suspects: Iterable[int] = (),
             t.join(timeout + 2.0)
         return [r for i, r in enumerate(ranks) if not alive[i]]
 
+    # materialize ONCE: `suspects` may be a generator, and it is read
+    # twice below (the timeline mark and the recheck filter) — iterating
+    # a one-shot iterator twice would silently skip the confirming ping
+    suspects = [s for s in suspects if s is not None]
+    timeline.event("shrink", "ping-confirm", rank=me, suspects=suspects)
     dead = sweep([r for r in range(len(workers)) if r != me])
     recheck = [
         s for s in suspects
-        if s is not None and s != me and s not in dead
-        and 0 <= s < len(workers)
+        if s != me and s not in dead and 0 <= s < len(workers)
     ]
     dead += sweep(recheck)
     return sorted(set(dead))
@@ -113,6 +118,8 @@ def shrink_to_survivors(peer, dead_ranks: Sequence[int]) -> bool:
     # (two half-clusters training independently is silent divergence,
     # worse than a restart) — it falls back to the detector instead
     if 2 * len(survivor_ranks) <= len(workers):
+        timeline.event("shrink", "quorum-lost", rank=me,
+                       survivors=len(survivor_ranks), total=len(workers))
         raise QuorumLostError(len(survivor_ranks), len(workers))
 
     survivors = workers.select(survivor_ranks)
@@ -135,6 +142,8 @@ def shrink_to_survivors(peer, dead_ranks: Sequence[int]) -> bool:
     import hashlib
 
     digest = hashlib.blake2b(payload, digest_size=8).hexdigest()
+    timeline.event("shrink", "consensus", rank=me, dead=dead,
+                   version=version, digest=digest)
     try:
         # send_retries is SHORT: this collective runs exactly when peers
         # are dying, and a consensus root that died after the ping sweep
@@ -157,6 +166,8 @@ def shrink_to_survivors(peer, dead_ranks: Sequence[int]) -> bool:
         "excluding dead rank(s) %s: %d -> %d workers (v%d)",
         dead, len(workers), len(survivors), version,
     )
+    timeline.event("shrink", "propose", rank=me, dead=dead,
+                   version=version, survivors=len(survivors))
     _publish_shrunk_cluster(peer, new_cluster, survivors)
     peer._propose(new_cluster, version)
     log_event(f"shrunk-to-survivors-v{version}-n{len(survivors)}")
@@ -248,6 +259,11 @@ def _sync_replay_point(peer, snapshot):
     survivors = peer.cluster.workers
     version = peer.cluster_version
     name = f"kf.shrink.replay.v{version}"
+    # rank=None → the module default (the process's stable identity set
+    # at Peer.start) stamps the event; the POST-shrink rank would alias
+    # a dead peer's id in the merged timeline
+    timeline.event("shrink", "replay", version=version,
+                   new_rank=survivors.rank(peer.config.self_id))
     try:
         if survivors.rank(peer.config.self_id) == 0:
             peer.channel.broadcast_bytes(

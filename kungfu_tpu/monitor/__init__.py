@@ -5,10 +5,18 @@
 * :mod:`kungfu_tpu.monitor.signals` — worker-side heartbeat senders
   (reference ``kungfu/cmd/__init__.py`` monitor_* + ``libkungfu-comm/send.go``);
 * :mod:`kungfu_tpu.monitor.metrics` — egress/ingress counters + HTTP
-  ``/metrics`` endpoint (reference ``srcs/go/monitor``).
+  ``/metrics`` endpoint (reference ``srcs/go/monitor``);
+* :mod:`kungfu_tpu.monitor.timeline` — the flight recorder: bounded ring
+  of cross-rank structured events, JSONL dumps for ``kftrace``;
+* :mod:`kungfu_tpu.monitor.registry` — unified counters/gauges/latency
+  histograms rendered through ``/metrics``;
+* :mod:`kungfu_tpu.monitor.traceview` — ``kftrace``: merge per-rank
+  dumps into a Chrome/Perfetto trace + straggler report.
 """
 
+from kungfu_tpu.monitor import timeline
 from kungfu_tpu.monitor.detector import DetectorServer, DetectorResults, DEFAULT_DETECTOR_PORT
+from kungfu_tpu.monitor.registry import REGISTRY
 from kungfu_tpu.monitor.adaptive import (
     AdaptiveStrategyDriver,
     DeviceStrategyDriver,

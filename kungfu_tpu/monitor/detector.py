@@ -28,6 +28,7 @@ from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
+from kungfu_tpu.monitor import timeline
 from kungfu_tpu.utils.log import get_logger
 
 _log = get_logger("detector")
@@ -143,6 +144,8 @@ class DetectorServer:
         heartbeat intake)."""
         kind = sig["kind"]
         now = time.time()
+        timeline.event("signal", kind, rank=sig.get("rank"),
+                       epoch=sig.get("epoch"))
         with self._lock:
             if kind == "otherdown":
                 # a failure report; epoch < 0 means the sender had no rank
@@ -262,6 +265,8 @@ class DetectorServer:
                         "rank %d down (%s for %.0fs); restart epoch %d",
                         r, why, now - since, min_epoch,
                     )
+                    timeline.event("down", f"rank{r}", rank=r, why=why,
+                                   epoch=min_epoch)
                     self.results.down_flag = True
                     self.results.epoch_num = min_epoch
                     fanout = {"kind": "otherdown", "epoch": min_epoch,
@@ -342,6 +347,7 @@ class DetectorServer:
                 min_epoch = -1
             self.results.down_flag = True
             self.results.epoch_num = max(min_epoch, 0)
+        timeline.event("down", "local", epoch=min_epoch)
         self._fanout({"kind": "otherdown", "epoch": min_epoch, "relay": True})
 
     def min_epoch(self) -> int:

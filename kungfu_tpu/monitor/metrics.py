@@ -17,6 +17,7 @@ from collections import defaultdict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
+from kungfu_tpu.monitor.registry import REGISTRY
 from kungfu_tpu.utils.envs import MONITORING_PERIOD, parse_bool_env
 from kungfu_tpu.utils.log import get_logger
 
@@ -69,6 +70,14 @@ class NetMonitor:
                     c.sample(dt)
                 for c in self._ingress.values():
                     c.sample(dt)
+                eg = sum(c.total for c in self._egress.values())
+                ing = sum(c.total for c in self._ingress.values())
+            # mirror the aggregate totals into the unified registry so
+            # they render alongside the timeline/engine metrics (the
+            # per-peer breakdown stays in render_prometheus — mirroring
+            # it per label would double every line)
+            REGISTRY.gauge("kf_net_egress_bytes").set(eg)
+            REGISTRY.gauge("kf_net_ingress_bytes").set(ing)
 
     def start(self) -> "NetMonitor":
         self._thread = threading.Thread(target=self._sample_loop, daemon=True)
@@ -107,7 +116,18 @@ class NetMonitor:
 
 
 class MetricsServer:
-    """HTTP ``/metrics`` endpoint (reference ``monitor/server.go``)."""
+    """HTTP ``/metrics`` endpoint (reference ``monitor/server.go``).
+
+    Renders the :class:`NetMonitor` per-peer counters AND the unified
+    :data:`~kungfu_tpu.monitor.registry.REGISTRY` (collective latency
+    histograms, retry/fault/shrink counters, timeline drop counter) in
+    one scrape.
+
+    Binding: ``port=0`` asks the OS for an ephemeral port; a *taken*
+    fixed port degrades to an ephemeral bind with a warning instead of
+    an unhandled ``OSError`` — a stale process squatting
+    worker-port+10000 must not kill the peer.  :attr:`port` always holds
+    the port actually bound."""
 
     def __init__(self, monitor: NetMonitor, port: int, host: str = "0.0.0.0",
                  extra_fn=None):
@@ -122,16 +142,29 @@ class MetricsServer:
                     self.send_response(404)
                     self.end_headers()
                     return
-                body = mon.render_prometheus(extra_fn() if extra_fn else None).encode()
+                text = mon.render_prometheus(extra_fn() if extra_fn else None)
+                text += REGISTRY.render_prometheus()
+                body = text.encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain; version=0.0.4")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
 
-        self._server = ThreadingHTTPServer((host, port), Handler)
+        try:
+            self._server = ThreadingHTTPServer((host, port), Handler)
+        except OSError as e:
+            if port == 0:
+                raise
+            _log.warning(
+                "metrics port %d unavailable (%s); binding an ephemeral "
+                "port instead", port, e,
+            )
+            self._server = ThreadingHTTPServer((host, 0), Handler)
         self._server.daemon_threads = True
-        self.port = port
+        #: the port actually bound (differs from the request under
+        #: port=0 or the taken-port fallback)
+        self.port = self._server.server_address[1]
 
     def start(self) -> "MetricsServer":
         threading.Thread(target=self._server.serve_forever, daemon=True).start()

@@ -1,0 +1,236 @@
+"""Unified metrics registry: counters, gauges, fixed-bucket histograms.
+
+One process-global :data:`REGISTRY` that every subsystem publishes into —
+the flight recorder (:mod:`kungfu_tpu.monitor.timeline`) counts drops and
+fault events here, the collective engine's spans feed per-op latency
+histograms, :class:`~kungfu_tpu.monitor.metrics.NetMonitor` mirrors its
+byte totals, and :class:`~kungfu_tpu.monitor.metrics.MetricsServer`
+renders everything through the existing ``/metrics`` endpoint.  Before
+this module each subsystem kept private aggregates (``utils/trace.py``
+(count, total) pairs, ``NetMonitor`` rate counters) that no one surface
+could render together.
+
+Deliberately dependency-free (stdlib only): ``utils/trace.py`` borrows
+:class:`Histogram` for its percentile report and ``scripts/kftrace``
+imports the package without jax.
+
+Histograms use **fixed** bucket boundaries (seconds, latency-shaped by
+default): observation is O(#buckets) worst case with no allocation, and
+p50/p95/p99 are estimated by linear interpolation inside the bucket the
+requested rank falls in — the standard Prometheus-style estimate, exact
+at bucket edges, never off by more than one bucket width inside.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Tuple
+
+#: default latency buckets (seconds): 100 µs .. 60 s, roughly log-spaced.
+#: The top is open-ended (+Inf bucket) — a collective stuck behind a dead
+#: peer lands there and the max tracks the true value.
+DEFAULT_LATENCY_BUCKETS: Tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+class Counter:
+    """Monotonic counter."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Last-write-wins instantaneous value."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram with min/max/percentile summaries."""
+
+    __slots__ = ("buckets", "_counts", "_lock", "count", "sum", "min", "max")
+
+    def __init__(self, buckets: Tuple[float, ...] = DEFAULT_LATENCY_BUCKETS):
+        self.buckets = tuple(sorted(buckets))
+        # one slot per finite bucket + the +Inf overflow slot
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._lock = threading.Lock()
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+    def observe(self, v: float) -> None:
+        i = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            self._counts[i] += 1
+            self.count += 1
+            self.sum += v
+            if v < self.min:
+                self.min = v
+            if v > self.max:
+                self.max = v
+
+    def percentile(self, q: float) -> float:
+        """Estimated ``q``-quantile (``0 < q <= 1``) from the buckets:
+        linear interpolation inside the bucket holding the target rank;
+        the open +Inf bucket reports the observed max (the only honest
+        bound available there)."""
+        with self._lock:
+            total = self.count
+            if total == 0:
+                return 0.0
+            target = q * total
+            cum = 0
+            for i, c in enumerate(self._counts):
+                if c == 0:
+                    continue
+                prev_cum = cum
+                cum += c
+                if cum < target:
+                    continue
+                if i == len(self.buckets):  # +Inf bucket
+                    return self.max
+                lo = self.buckets[i - 1] if i > 0 else min(self.min, self.buckets[i])
+                hi = self.buckets[i]
+                frac = (target - prev_cum) / c
+                est = lo + (hi - lo) * frac
+                # the interpolation assumes mass spread across the whole
+                # bucket; clamp to the observed range so a sparse bucket
+                # cannot report a quantile outside [min, max]
+                return min(max(est, self.min), self.max)
+            return self.max
+
+    def summary(self) -> Dict[str, float]:
+        with self._lock:
+            if self.count == 0:
+                return {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0}
+            base = {"count": self.count, "sum": self.sum,
+                    "min": self.min, "max": self.max}
+        base["p50"] = self.percentile(0.50)
+        base["p95"] = self.percentile(0.95)
+        base["p99"] = self.percentile(0.99)
+        return base
+
+    def bucket_counts(self) -> List[Tuple[float, int]]:
+        """Cumulative ``(le, count)`` pairs, Prometheus-style; the final
+        entry is ``(inf, total)``."""
+        with self._lock:
+            out = []
+            cum = 0
+            for le, c in zip(self.buckets, self._counts):
+                cum += c
+                out.append((le, cum))
+            out.append((float("inf"), cum + self._counts[-1]))
+            return out
+
+
+def _label_str(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    return "{" + inner + "}"
+
+
+class MetricsRegistry:
+    """Name+labels → metric instance, with one Prometheus rendering."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], object] = {}
+
+    def _get(self, cls, name: str, labels: Dict[str, str], **kwargs):
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = self._metrics[key] = cls(**kwargs)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, requested {cls.__name__}"
+                )
+            return m
+
+    def counter(self, name: str, **labels: str) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, **labels: str) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str,
+                  buckets: Optional[Tuple[float, ...]] = None,
+                  **labels: str) -> Histogram:
+        return self._get(Histogram, name, labels,
+                         buckets=buckets or DEFAULT_LATENCY_BUCKETS)
+
+    def snapshot(self) -> Dict[str, object]:
+        """``{rendered-name: value-or-summary}`` for tests/tools."""
+        with self._lock:
+            items = list(self._metrics.items())
+        out: Dict[str, object] = {}
+        for (name, labels), m in items:
+            key = name + _label_str(dict(labels))
+            if isinstance(m, Histogram):
+                out[key] = m.summary()
+            else:
+                out[key] = m.value
+        return out
+
+    def render_prometheus(self) -> str:
+        with self._lock:
+            items = sorted(self._metrics.items(), key=lambda kv: kv[0])
+        lines: List[str] = []
+        for (name, labels), m in items:
+            ld = dict(labels)
+            if isinstance(m, Counter):
+                lines.append(f"{name}{_label_str(ld)} {m.value}")
+            elif isinstance(m, Gauge):
+                lines.append(f"{name}{_label_str(ld)} {m.value:.6g}")
+            else:  # Histogram
+                for le, cum in m.bucket_counts():
+                    le_s = "+Inf" if le == float("inf") else f"{le:g}"
+                    bl = dict(ld, le=le_s)
+                    lines.append(f"{name}_bucket{_label_str(bl)} {cum}")
+                lines.append(f"{name}_sum{_label_str(ld)} {m.sum:.6g}")
+                lines.append(f"{name}_count{_label_str(ld)} {m.count}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def reset(self) -> None:
+        """Drop every metric (tests; a process-global registry otherwise
+        accumulates across unrelated scenarios)."""
+        with self._lock:
+            self._metrics.clear()
+
+
+#: the process-global registry rendered by ``/metrics``
+REGISTRY = MetricsRegistry()

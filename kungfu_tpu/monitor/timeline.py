@@ -1,0 +1,305 @@
+"""Flight recorder: bounded in-process ring of structured events.
+
+``utils/trace.py`` answers "how much, on average"; this module answers
+"*which rank* stalled *which collective* at *which step*, and was a
+chaos fault or a shrink in flight at the time".  Every event is
+``(ts, rank, step, kind, name, dur, attrs)``:
+
+* ``ts`` — wall-clock start time (``time.time()``, so cross-rank merges
+  align without a clock-sync protocol; NTP-level skew is visible but the
+  per-collective *skew analysis* in ``kftrace`` compares durations, which
+  are immune to it);
+* ``rank`` — the emitting rank (``None`` for rank-less subsystems like
+  the detector; the module-level default set by :func:`set_rank` fills
+  in when the call site passes nothing);
+* ``step`` — the current training step (:func:`set_step`), ``-1`` before
+  the first step;
+* ``kind`` — one of :data:`EVENT_KINDS` (enforced by the ``trace-vocab``
+  kflint rule: a typo'd kind would silently vanish from every ``kftrace``
+  filter);
+* ``dur`` — seconds for :func:`span` regions, ``0`` for one-shot
+  :func:`event` marks.
+
+Cost contract: gated by the same ``KF_CONFIG_ENABLE_TRACE`` switch as
+``trace_scope``.  Disabled, :func:`span` returns a shared no-op context
+manager (zero allocation) and :func:`event` returns after one env check
+— except for the rare *counted* kinds (retry/deadline/chaos/down/
+shrink), whose registry counters tick regardless so ``/metrics`` stays
+truthful without paying for the ring on the hot path.
+
+Dump: one JSONL file per process (= per rank under the runner) written
+by :func:`maybe_dump` (``Peer.close``) and an ``atexit`` hook when
+``KF_CONFIG_TRACE_DUMP`` names a directory (or a ``*.jsonl`` file).
+``scripts/kftrace`` merges N ranks' dumps into one Chrome-trace JSON and
+prints the straggler report (:mod:`kungfu_tpu.monitor.traceview`).
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from kungfu_tpu.monitor.registry import REGISTRY
+from kungfu_tpu.utils.log import get_logger
+from kungfu_tpu.utils.trace import record_duration, trace_enabled
+
+_log = get_logger("timeline")
+
+#: JSONL dump location: a directory (one ``trace-*.jsonl`` per process)
+#: or an exact ``*.jsonl`` path (single-process runs)
+DUMP_ENV = "KF_CONFIG_TRACE_DUMP"
+#: ring capacity override (events); default 65536
+CAP_ENV = "KF_CONFIG_TIMELINE_CAP"
+
+DEFAULT_CAP = 65536
+
+#: the event vocabulary.  The ``trace-vocab`` kflint rule rejects any
+#: ``span()``/``event()`` call site whose kind is not listed here — add
+#: the kind FIRST, then the instrumentation.
+EVENT_KINDS = frozenset({
+    "collective",  # host-engine collective span (comm/engine.py)
+    "device",      # device-plane collective span (comm/device.py)
+    "send",        # host-channel frame egress mark, byte-counted
+    "recv",        # host-channel frame ingress mark, byte-counted
+    "retry",       # engine send retry after a transient wire fault
+    "deadline",    # per-peer deadline exhausted -> PeerFailureError
+    "signal",      # detector heartbeat intake (begin/end/epoch/...)
+    "down",        # detector down verdict / local down report
+    "shrink",      # shrink-to-survivors phase boundary
+    "chaos",       # fault injection fired (chaos/inject.py)
+    "step",        # training-step mark
+    "mark",        # generic one-shot annotation
+})
+
+#: kinds whose registry counters tick even with tracing off — rare
+#: events that /metrics must count unconditionally.  Values are the
+#: counter names; chaos/shrink additionally label by the event name
+#: (a closed set: clause kinds / phase names).
+_COUNTED_KINDS = {
+    "retry": "kf_engine_retries_total",
+    "deadline": "kf_peer_faults_total",
+    "chaos": "kf_chaos_injections_total",
+    "down": "kf_detector_down_total",
+    "shrink": "kf_shrink_events_total",
+}
+_LABELED_KINDS = ("chaos", "shrink")
+
+_lock = threading.Lock()
+_ring: collections.deque = collections.deque()
+_cap: Optional[int] = None  # resolved lazily from CAP_ENV
+_dropped = 0
+_rank: Optional[int] = None
+_step = -1
+
+
+def enabled() -> bool:
+    """Same gate as ``trace_scope`` (``KF_CONFIG_ENABLE_TRACE``)."""
+    return trace_enabled()
+
+
+def set_rank(rank: Optional[int]) -> None:
+    """Default rank stamped on events whose call site passes none.
+    (In-process multi-rank test clusters pass ``rank=`` explicitly at
+    the rank-owning call sites; this default serves real one-rank-per-
+    process workers and the dump filename.)"""
+    global _rank
+    _rank = rank
+
+
+def set_step(step: int) -> None:
+    """Current training step, stamped on subsequent events."""
+    global _step
+    _step = step
+
+
+def _capacity() -> int:
+    global _cap
+    if _cap is None:
+        try:
+            _cap = max(1, int(os.environ.get(CAP_ENV, "") or DEFAULT_CAP))
+        except ValueError:
+            _cap = DEFAULT_CAP
+    return _cap
+
+
+def _append(ts: float, rank: Optional[int], kind: str, name: str,
+            dur: float, attrs: Optional[Dict]) -> None:
+    global _dropped
+    ev = (ts, rank if rank is not None else _rank, _step, kind, name, dur,
+          attrs or None)
+    cap = _capacity()
+    with _lock:
+        if len(_ring) >= cap:
+            # flight-recorder semantics: keep the newest, evict the
+            # oldest, and count the loss so a truncated dump says so
+            _ring.popleft()
+            _dropped += 1
+            REGISTRY.counter("kf_timeline_dropped_total").inc()
+        _ring.append(ev)
+
+
+def _count(kind: str, name: str) -> None:
+    metric = _COUNTED_KINDS.get(kind)
+    if metric is None:
+        return
+    if kind in _LABELED_KINDS:
+        REGISTRY.counter(metric, what=name).inc()
+    else:
+        REGISTRY.counter(metric).inc()
+
+
+def event(kind: str, name: str, rank: Optional[int] = None,
+          force: bool = False, **attrs) -> None:
+    """One-shot mark.  Counted kinds always tick their registry counter;
+    the ring records only when tracing is enabled (or ``force``)."""
+    _count(kind, name)
+    if not (force or trace_enabled()):
+        return
+    _append(time.time(), rank, kind, name, 0.0, attrs)
+
+
+class _NoopSpan:
+    """Shared disabled-path span: no allocation, no timing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("kind", "name", "rank", "attrs", "_t0", "_ts")
+
+    def __init__(self, kind, name, rank, attrs):
+        self.kind = kind
+        self.name = name
+        self.rank = rank
+        self.attrs = attrs
+
+    def __enter__(self):
+        self._ts = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, et, ev, tb):
+        dt = time.perf_counter() - self._t0
+        attrs = self.attrs
+        if et is not None:
+            attrs = dict(attrs or {})
+            attrs["error"] = et.__name__
+        _append(self._ts, self.rank, self.kind, self.name, dt, attrs)
+        # aggregate parity: spans ARE trace scopes — trace_report() and
+        # its histogram percentiles see every span duration, and the live
+        # per-scope log line trace_scope users rely on keeps appearing
+        record_duration(self.name, dt)
+        _log.info("%s took %.3fms", self.name, dt * 1e3)
+        if self.kind in ("collective", "device"):
+            op = (attrs or {}).get("op") if attrs else None
+            REGISTRY.histogram(
+                "kf_collective_latency_seconds",
+                plane=self.kind, op=op or self.name,
+            ).observe(dt)
+        return False
+
+
+def span(kind: str, name: str, rank: Optional[int] = None,
+         force: bool = False, **attrs):
+    """Timed region: records one event with ``dur`` set, feeds the trace
+    aggregates, and (for collective/device kinds) the per-op latency
+    histogram.  Returns a shared no-op when tracing is off."""
+    if not (force or trace_enabled()):
+        return _NOOP_SPAN
+    return _Span(kind, name, rank, attrs or None)
+
+
+def dropped() -> int:
+    with _lock:
+        return _dropped
+
+
+def snapshot() -> List[Dict]:
+    """Current ring contents as dicts, oldest first."""
+    with _lock:
+        evs = list(_ring)
+    return [
+        {"ts": ts, "rank": r, "step": s, "kind": k, "name": n, "dur": d,
+         "attrs": a or {}}
+        for ts, r, s, k, n, d, a in evs
+    ]
+
+
+def reset(cap: Optional[int] = None) -> None:
+    """Clear the ring — tests and long-lived processes re-arming a
+    capture.  ``cap`` pins a capacity; without it the next append
+    re-resolves ``KF_CONFIG_TIMELINE_CAP``."""
+    global _dropped, _cap, _step
+    with _lock:
+        _ring.clear()
+        _dropped = 0
+        _cap = max(1, cap) if cap is not None else None
+        _step = -1
+
+
+def dump_path_from_env() -> Optional[str]:
+    """Resolve ``KF_CONFIG_TRACE_DUMP`` to this process's dump file, or
+    None when dumping is not configured."""
+    target = os.environ.get(DUMP_ENV, "").strip()
+    if not target:
+        return None
+    if target.endswith(".jsonl"):
+        return target
+    r = _rank if _rank is not None else "x"
+    return os.path.join(target, f"trace-r{r}-p{os.getpid()}.jsonl")
+
+
+def dump(path: str) -> int:
+    """Write the ring as JSONL (header line first); returns the event
+    count written."""
+    events = snapshot()
+    header = {
+        "kftrace": 1,
+        "rank": _rank,
+        "pid": os.getpid(),
+        "dropped": dropped(),
+        "wall": time.time(),
+    }
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(json.dumps(header) + "\n")
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+    return len(events)
+
+
+def maybe_dump() -> Optional[str]:
+    """Dump to the env-configured path if set and the ring is non-empty;
+    returns the path written (idempotent: later calls overwrite with a
+    superset, so close + atexit double-firing is harmless)."""
+    path = dump_path_from_env()
+    if path is None:
+        return None
+    with _lock:
+        if not _ring:
+            return None
+    try:
+        n = dump(path)
+    except OSError as e:
+        _log.warning("cannot dump timeline to %s: %s", path, e)
+        return None
+    _log.info("%d event(s) dumped to %s", n, path)
+    return path
+
+
+atexit.register(maybe_dump)

@@ -1,0 +1,405 @@
+"""``kftrace``: merge per-rank flight-recorder dumps, find stragglers.
+
+Consumes the JSONL dumps written by :mod:`kungfu_tpu.monitor.timeline`
+(one file per rank/process) and produces:
+
+* ``kftrace merge -o trace.json r0.jsonl r1.jsonl ...`` — one
+  Chrome-trace/Perfetto JSON: every span becomes a complete (``ph: X``)
+  event on the emitting rank's track, every mark an instant (``ph: i``),
+  so ``chrome://tracing`` / https://ui.perfetto.dev render the
+  cross-rank timeline directly;
+* ``kftrace report ...`` — the straggler report: per-collective
+  cross-rank skew (same rendezvous tag compared across ranks — duration
+  comparison, immune to wall-clock skew between hosts), the slowest rank
+  per step window, and the overlap of fault events (chaos injections,
+  peer deadlines, down verdicts) with latency spikes — "was a fault in
+  flight when this collective stalled?" answered mechanically;
+* ``kftrace --self-check [dumps...]`` — dump schema validation (with no
+  arguments it synthesizes a dump via the live timeline module and
+  round-trips it), wired into ``scripts/check.sh``.
+
+Deliberately stdlib-only so the CLI runs in bare CI images (the
+``scripts/kftrace`` launcher stubs the package like ``scripts/kflint``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+from collections import defaultdict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: required keys of one event line (see timeline.snapshot())
+EVENT_KEYS = ("ts", "rank", "step", "kind", "name", "dur", "attrs")
+
+#: event kinds that count as faults for the overlap analysis
+FAULT_KINDS = ("chaos", "deadline", "down", "retry")
+
+#: how far above the per-collective median a duration must sit to be
+#: called a spike in the fault-overlap section
+SPIKE_FACTOR = 3.0
+
+#: how far BEFORE a spiking span's start a fault still counts as
+#: overlapping: a peer that dies an instant before the survivors enter
+#: the collective is the cause of their stall, not a coincidence
+FAULT_SLACK_S = 1.0
+
+
+class DumpError(ValueError):
+    """A dump file failed schema validation."""
+
+
+def _check_event(obj: dict, lineno: int, kinds: Optional[frozenset]) -> None:
+    missing = [k for k in EVENT_KEYS if k not in obj]
+    if missing:
+        raise DumpError(f"line {lineno}: missing key(s) {missing}")
+    if not isinstance(obj["kind"], str) or not isinstance(obj["name"], str):
+        raise DumpError(f"line {lineno}: kind/name must be strings")
+    if kinds is not None and obj["kind"] not in kinds:
+        raise DumpError(
+            f"line {lineno}: unknown event kind {obj['kind']!r}")
+    for k in ("ts", "dur"):
+        if not isinstance(obj[k], (int, float)):
+            raise DumpError(f"line {lineno}: {k} must be a number")
+    if obj["rank"] is not None and not isinstance(obj["rank"], int):
+        raise DumpError(f"line {lineno}: rank must be int or null")
+    if not isinstance(obj["attrs"], dict):
+        raise DumpError(f"line {lineno}: attrs must be an object")
+
+
+def load_dump(path: str,
+              kinds: Optional[frozenset] = None
+              ) -> Tuple[Optional[dict], List[dict]]:
+    """``(header, events)`` from one JSONL dump, schema-validated.
+    ``kinds`` (default: the live vocabulary when importable) restricts
+    event kinds; pass ``None``-able explicitly to skip that check."""
+    if kinds is None:
+        try:
+            from kungfu_tpu.monitor.timeline import EVENT_KINDS
+
+            kinds = EVENT_KINDS
+        except ImportError:
+            kinds = None
+    header: Optional[dict] = None
+    events: List[dict] = []
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise DumpError(f"line {lineno}: not JSON ({e})") from None
+            if lineno == 1 and "kftrace" in obj:
+                header = obj
+                continue
+            _check_event(obj, lineno, kinds)
+            events.append(obj)
+    return header, events
+
+
+def _event_rank(ev: dict, header: Optional[dict]) -> int:
+    r = ev.get("rank")
+    if r is None and header is not None:
+        r = header.get("rank")
+    return -1 if r is None else int(r)
+
+
+def load_all(paths: Sequence[str]) -> List[dict]:
+    """All events from all dumps, rank-resolved (header rank filled in
+    where the event carries none), time-sorted."""
+    out: List[dict] = []
+    for p in paths:
+        header, events = load_dump(p)
+        for ev in events:
+            ev = dict(ev)
+            ev["rank"] = _event_rank(ev, header)
+            out.append(ev)
+    out.sort(key=lambda e: e["ts"])
+    return out
+
+
+# -- Chrome trace ----------------------------------------------------------
+def chrome_trace(events: List[dict]) -> dict:
+    """Chrome-trace JSON object: one process track per rank, spans as
+    complete events, marks as instants, all timestamps rebased to the
+    earliest event (µs)."""
+    if events:
+        t0 = min(e["ts"] for e in events)
+    else:
+        t0 = 0.0
+    ranks = sorted({e["rank"] for e in events})
+    trace_events: List[dict] = []
+    for r in ranks:
+        trace_events.append({
+            "name": "process_name", "ph": "M", "pid": r, "tid": 0,
+            "args": {"name": f"rank {r}" if r >= 0 else "rankless"},
+        })
+    for e in events:
+        base = {
+            "name": e["name"],
+            "cat": e["kind"],
+            "pid": e["rank"],
+            "tid": 0,
+            "ts": (e["ts"] - t0) * 1e6,
+            "args": dict(e["attrs"], step=e["step"]),
+        }
+        if e["dur"] > 0:
+            base["ph"] = "X"
+            base["dur"] = e["dur"] * 1e6
+        else:
+            base["ph"] = "i"
+            base["s"] = "p"
+        trace_events.append(base)
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+
+# -- straggler analysis ----------------------------------------------------
+def _collective_groups(events: List[dict]) -> Dict[Tuple[str, str], Dict[int, float]]:
+    """``{(op, tag): {rank: duration}}`` over collective/device spans;
+    a rank reporting the same tag more than once keeps its max (chunked
+    collectives re-enter per chunk — the slowest chunk IS the stall)."""
+    groups: Dict[Tuple[str, str], Dict[int, float]] = defaultdict(dict)
+    for e in events:
+        if e["kind"] not in ("collective", "device") or e["dur"] <= 0:
+            continue
+        attrs = e["attrs"]
+        op = attrs.get("op") or e["name"]
+        tag = attrs.get("tag") or e["name"]
+        cur = groups[(op, tag)].get(e["rank"])
+        if cur is None or e["dur"] > cur:
+            groups[(op, tag)][e["rank"]] = e["dur"]
+    return groups
+
+
+def skew_rows(events: List[dict]) -> List[dict]:
+    """Per-collective cross-rank skew, widest first.  Only tags seen on
+    ≥2 ranks qualify (a single-rank duration has no skew to measure)."""
+    rows = []
+    for (op, tag), per_rank in _collective_groups(events).items():
+        if len(per_rank) < 2:
+            continue
+        slowest = max(per_rank, key=per_rank.get)
+        fastest = min(per_rank, key=per_rank.get)
+        rows.append({
+            "op": op, "tag": tag,
+            "slowest_rank": slowest, "slowest_s": per_rank[slowest],
+            "fastest_rank": fastest, "fastest_s": per_rank[fastest],
+            "skew_s": per_rank[slowest] - per_rank[fastest],
+            "ranks": len(per_rank),
+        })
+    rows.sort(key=lambda r: r["skew_s"], reverse=True)
+    return rows
+
+
+def slowest_rank_per_step(events: List[dict]) -> List[dict]:
+    """Per step window: the rank with the largest total collective time."""
+    by_step: Dict[int, Dict[int, float]] = defaultdict(lambda: defaultdict(float))
+    for e in events:
+        if e["kind"] in ("collective", "device") and e["dur"] > 0:
+            by_step[e["step"]][e["rank"]] += e["dur"]
+    out = []
+    for step in sorted(by_step):
+        per_rank = by_step[step]
+        slowest = max(per_rank, key=per_rank.get)
+        out.append({"step": step, "slowest_rank": slowest,
+                    "total_s": per_rank[slowest],
+                    "ranks": len(per_rank)})
+    return out
+
+
+def fault_overlaps(events: List[dict]) -> List[dict]:
+    """Latency spikes (span > SPIKE_FACTOR x its group median, groups of
+    ≥2) paired with the fault events that fall inside their window —
+    any rank's fault counts: an injected delay on rank 1 stalls rank 0's
+    recv just as surely as its own send."""
+    faults = [e for e in events if e["kind"] in FAULT_KINDS]
+    # the spike baseline is the median over ALL spans of an op (every
+    # tag, every rank): a per-tag median would be the stall itself when
+    # the majority of ranks block on one dead peer
+    by_op: Dict[str, List[dict]] = defaultdict(list)
+    for e in events:
+        if e["kind"] in ("collective", "device") and e["dur"] > 0:
+            by_op[e["attrs"].get("op") or e["name"]].append(e)
+    out = []
+    for op, spans in by_op.items():
+        if len(spans) < 2:
+            continue
+        med = statistics.median(s["dur"] for s in spans)
+        if med <= 0:
+            continue
+        for s in spans:
+            if s["dur"] < SPIKE_FACTOR * med:
+                continue
+            lo, hi = s["ts"] - FAULT_SLACK_S, s["ts"] + s["dur"]
+            inside = [
+                f for f in faults
+                if lo <= f["ts"] <= hi
+            ]
+            if inside:
+                out.append({
+                    "op": op,
+                    "tag": s["attrs"].get("tag") or s["name"],
+                    "rank": s["rank"],
+                    "step": s["step"], "dur_s": s["dur"],
+                    "x_median": s["dur"] / med,
+                    "faults": [
+                        {"kind": f["kind"], "name": f["name"],
+                         "rank": f["rank"], "attrs": f["attrs"]}
+                        for f in inside
+                    ],
+                })
+    out.sort(key=lambda r: r["dur_s"], reverse=True)
+    return out
+
+
+def straggler_verdict(events: List[dict]) -> Optional[int]:
+    """The rank most often slowest across the skew groups, or None when
+    no group spans ≥2 ranks."""
+    votes: Dict[int, int] = defaultdict(int)
+    for row in skew_rows(events):
+        votes[row["slowest_rank"]] += 1
+    if not votes:
+        return None
+    return max(votes, key=votes.get)
+
+
+def render_report(events: List[dict], top: int = 10) -> str:
+    lines: List[str] = []
+    rows = skew_rows(events)
+    verdict = straggler_verdict(events)
+    n_faults = sum(1 for e in events if e["kind"] in FAULT_KINDS)
+    lines.append(f"kftrace: {len(events)} event(s), "
+                 f"{len(rows)} cross-rank collective group(s), "
+                 f"{n_faults} fault event(s)")
+    if verdict is not None:
+        lines.append(f"straggler verdict: rank {verdict} "
+                     f"(slowest in {sum(1 for r in rows if r['slowest_rank'] == verdict)}"
+                     f"/{len(rows)} groups)")
+    lines.append("")
+    lines.append("== per-collective cross-rank skew (widest first)")
+    if not rows:
+        lines.append("  (no collective seen on more than one rank)")
+    for r in rows[:top]:
+        lines.append(
+            f"  {r['op']}/{r['tag']}: skew {r['skew_s'] * 1e3:.1f}ms — "
+            f"rank {r['slowest_rank']} {r['slowest_s'] * 1e3:.1f}ms vs "
+            f"rank {r['fastest_rank']} {r['fastest_s'] * 1e3:.1f}ms "
+            f"({r['ranks']} ranks)"
+        )
+    lines.append("")
+    lines.append("== slowest rank per step window")
+    steps = slowest_rank_per_step(events)
+    if not steps:
+        lines.append("  (no stepped collective spans)")
+    for s in steps[:top]:
+        lines.append(
+            f"  step {s['step']}: rank {s['slowest_rank']} "
+            f"({s['total_s'] * 1e3:.1f}ms total collective time, "
+            f"{s['ranks']} ranks)"
+        )
+    lines.append("")
+    lines.append("== fault overlap with latency spikes "
+                 f"(> {SPIKE_FACTOR:g}x group median)")
+    overlaps = fault_overlaps(events)
+    if not overlaps:
+        lines.append("  (none)")
+    for o in overlaps[:top]:
+        faults = ", ".join(
+            f"{f['kind']}:{f['name']}@rank{f['rank']}" for f in o["faults"]
+        )
+        lines.append(
+            f"  {o['op']}/{o['tag']} rank {o['rank']} step {o['step']}: "
+            f"{o['dur_s'] * 1e3:.1f}ms ({o['x_median']:.1f}x median) "
+            f"overlaps [{faults}]"
+        )
+    return "\n".join(lines) + "\n"
+
+
+# -- self-check ------------------------------------------------------------
+def self_check(paths: Sequence[str]) -> int:
+    """Validate dump schemas; with no paths, synthesize a dump via the
+    live timeline module and round-trip it (proves recorder and reader
+    agree byte-for-byte on the schema)."""
+    if not paths:
+        import os
+        import tempfile
+
+        from kungfu_tpu.monitor import timeline
+
+        timeline.reset(cap=64)
+        with timeline.span("collective", "engine.all_reduce[64B]",
+                           rank=0, force=True, op="all_reduce",
+                           tag="selfcheck", nbytes=64):
+            pass
+        timeline.event("chaos", "delay", rank=0, force=True, ms=1)
+        timeline.event("mark", "selfcheck", rank=0, force=True)
+        fd, tmp = tempfile.mkstemp(suffix=".jsonl", prefix="kftrace-")
+        os.close(fd)
+        try:
+            timeline.dump(tmp)
+            header, events = load_dump(tmp)
+        finally:
+            os.unlink(tmp)
+            timeline.reset()
+        if header is None or len(events) != 3:
+            print("kftrace: self-check FAILED (round-trip mismatch)",
+                  file=sys.stderr)
+            return 1
+        print("kftrace: self-check ok (synthetic round-trip)")
+        return 0
+    rc = 0
+    for p in paths:
+        try:
+            header, events = load_dump(p)
+        except (OSError, DumpError) as e:
+            print(f"kftrace: {p}: INVALID — {e}", file=sys.stderr)
+            rc = 1
+            continue
+        dropped = (header or {}).get("dropped", 0)
+        print(f"kftrace: {p}: ok ({len(events)} event(s), "
+              f"{dropped} dropped)")
+    return rc
+
+
+# -- CLI -------------------------------------------------------------------
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--self-check" in argv:
+        argv.remove("--self-check")
+        return self_check(argv)
+    p = argparse.ArgumentParser(
+        prog="kftrace",
+        description="merge kungfu-tpu flight-recorder dumps; find stragglers",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pm = sub.add_parser("merge", help="merge dumps into a Chrome-trace JSON")
+    pm.add_argument("-o", "--out", required=True, help="output trace.json")
+    pm.add_argument("dumps", nargs="+", help="per-rank JSONL dumps")
+    pr = sub.add_parser("report", help="print the straggler report")
+    pr.add_argument("--top", type=int, default=10,
+                    help="rows per section (default 10)")
+    pr.add_argument("dumps", nargs="+", help="per-rank JSONL dumps")
+    args = p.parse_args(argv)
+    try:
+        events = load_all(args.dumps)
+    except (OSError, DumpError) as e:
+        print(f"kftrace: {e}", file=sys.stderr)
+        return 1
+    if args.cmd == "merge":
+        trace = chrome_trace(events)
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(trace, f)
+        ranks = sorted({e['rank'] for e in events})
+        print(f"kftrace: wrote {len(trace['traceEvents'])} trace event(s) "
+              f"from {len(args.dumps)} dump(s) (ranks {ranks}) to {args.out}")
+        return 0
+    sys.stdout.write(render_report(events, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -98,6 +98,7 @@ class Peer:
                     self._metrics_server = MetricsServer(
                         monitor, self.config.self_id.port + METRICS_PORT_OFFSET
                     ).start()
+                    _log.info("/metrics on port %d", self._metrics_server.port)
                 except OSError as e:
                     _log.warning("metrics server not started: %s", e)
             if not self.config.single_process:
@@ -134,6 +135,13 @@ class Peer:
             from kungfu_tpu.monitor.signals import monitor_compile_grace
 
             monitor_compile_grace(self.rank())
+            # flight-recorder identity: events (and the dump filename)
+            # default to this worker's rank; in-process multi-peer test
+            # clusters pass rank= explicitly at rank-owning call sites
+            from kungfu_tpu.monitor import timeline
+
+            timeline.set_rank(None if self.detached or self.standby
+                              else self.rank())
             log_event("peer-started")
 
     def _init_jax_distributed(self) -> None:
@@ -250,6 +258,12 @@ class Peer:
         return devs, local_size
 
     def close(self) -> None:
+        # flush the flight recorder before tearing channels down (the
+        # atexit hook also fires, but a long-lived driver that closes and
+        # re-opens peers would otherwise only dump its last incarnation)
+        from kungfu_tpu.monitor import timeline
+
+        timeline.maybe_dump()
         with self._lock:
             if self._channel is not None:
                 self._notify_done()

@@ -92,6 +92,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "e.g. 'die:step=5,rank=1' kills rank 1 at step 5)")
     p.add_argument("-chaos-seed", dest="chaos_seed", type=int, default=None,
                    help="KF_CHAOS_SEED for the workers (delay jitter)")
+    p.add_argument("-trace", dest="trace", action="store_true",
+                   help="enable scoped tracing + the flight-recorder "
+                        "timeline in every worker (KF_CONFIG_ENABLE_TRACE)")
+    p.add_argument("-trace-dump", dest="trace_dump", default="",
+                   help="directory for per-rank timeline JSONL dumps "
+                        "(KF_CONFIG_TRACE_DUMP; implies -trace).  Merge "
+                        "and analyze with scripts/kftrace")
     p.add_argument("prog", help="worker program")
     p.add_argument("args", nargs=argparse.REMAINDER, help="worker program args")
     return p
@@ -261,6 +268,19 @@ def main(argv: Optional[List[str]] = None) -> int:
             "the config server)"
         )
     chaos_envs = {}
+    if ns.trace or ns.trace_dump:
+        from kungfu_tpu.monitor.timeline import DUMP_ENV
+        from kungfu_tpu.utils.trace import ENABLE_TRACE
+
+        chaos_envs[ENABLE_TRACE] = "1"
+        if ns.trace_dump:
+            import os as _os
+
+            dump_dir = _os.path.abspath(ns.trace_dump)
+            _os.makedirs(dump_dir, exist_ok=True)
+            chaos_envs[DUMP_ENV] = dump_dir
+            _log.info("timeline dumps -> %s (merge: scripts/kftrace)",
+                      dump_dir)
     if ns.chaos:
         # validate at the launcher so a typo'd spec dies here, not as a
         # mysteriously fault-free experiment in N worker logs

@@ -57,7 +57,19 @@ Tuning envs (read anywhere, any time):
                                    shrink-to-survivors recovery.  Default
                                    = the engine timeout (comm/engine.py)
 ``KF_CONFIG_ENABLE_TRACE``         truthy: log scope entry depth +
-                                   duration (utils/trace.py)
+                                   duration (utils/trace.py) AND record
+                                   flight-recorder timeline events
+                                   (monitor/timeline.py)
+``KF_CONFIG_TRACE_DUMP``           timeline JSONL dump target: a
+                                   directory (one trace-*.jsonl per
+                                   process) or an exact *.jsonl path;
+                                   written on Peer.close/exit and merged
+                                   by scripts/kftrace
+                                   (monitor/timeline.py)
+``KF_CONFIG_TIMELINE_CAP``         flight-recorder ring capacity in
+                                   events, default 65536; evictions are
+                                   counted in kf_timeline_dropped_total
+                                   (monitor/timeline.py)
 ``KF_CONFIG_P2P_RESPONDERS``       p2p blob responder pool size,
                                    default 2 (store/p2p.py)
 ``KF_CONFIG_USE_AFFINITY``         truthy: partition host cores between
@@ -173,6 +185,12 @@ CHUNK_SIZE = "KF_CONFIG_CHUNK_SIZE"
 ENGINE_THREADS = "KF_CONFIG_ENGINE_THREADS"
 ENGINE_TIMEOUT = "KF_CONFIG_ENGINE_TIMEOUT"
 PEER_DEADLINE = "KF_CONFIG_PEER_DEADLINE"
+
+# observability envs (read by kungfu_tpu/monitor/timeline.py, which
+# defines mirror constants next to its reader code; registered here so
+# the env-contract scan anchors them like every other KF_* knob)
+TRACE_DUMP = "KF_CONFIG_TRACE_DUMP"
+TIMELINE_CAP = "KF_CONFIG_TIMELINE_CAP"
 
 # fault-injection envs (read by kungfu_tpu/chaos/inject.py at controller
 # creation; registered here so the env-contract scan anchors them to the

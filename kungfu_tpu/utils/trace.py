@@ -38,16 +38,47 @@ ENABLE_TRACE = "KF_CONFIG_ENABLE_TRACE"
 _local = threading.local()
 _stats_lock = threading.Lock()
 _stats: Dict[str, Tuple[int, float]] = {}
+#: per-name duration histograms (monitor.registry.Histogram, imported
+#: lazily — utils must stay importable without the monitor package)
+_hists: Dict[str, object] = {}
+_Histogram = None
 
 
 def trace_enabled() -> bool:
     return os.environ.get(ENABLE_TRACE, "").lower() in ("1", "true", "yes")
 
 
+def _hist_cls():
+    global _Histogram
+    if _Histogram is None:
+        from kungfu_tpu.monitor.registry import Histogram
+
+        _Histogram = Histogram
+    return _Histogram
+
+
 def _record(name: str, dt: float) -> None:
+    # resolve the histogram class BEFORE taking the lock: the first call
+    # imports the monitor package, and running the import machinery under
+    # _stats_lock could deadlock against a module whose import-time code
+    # records a scope (import lock vs stats lock, opposite orders)
+    cls = _hist_cls()
     with _stats_lock:
         n, total = _stats.get(name, (0, 0.0))
         _stats[name] = (n + 1, total + dt)
+        h = _hists.get(name)
+        if h is None:
+            h = _hists[name] = cls()
+    # observe outside _stats_lock: the histogram has its own lock and
+    # nesting the two would put an avoidable edge in the lock graph
+    h.observe(dt)
+
+
+def record_duration(name: str, dt: float) -> None:
+    """Public aggregation hook: feed one scope duration into the trace
+    stats AND its latency histogram — ``timeline.span`` regions report
+    here so ``trace_report`` covers them like any ``trace_scope``."""
+    _record(name, dt)
 
 
 @contextlib.contextmanager
@@ -84,22 +115,36 @@ def traced(fn=None, *, name: Optional[str] = None):
 
 
 def trace_report() -> Dict[str, Dict[str, float]]:
-    """Aggregated scope stats: ``{name: {count, total_s, mean_ms}}``."""
+    """Aggregated scope stats: ``{name: {count, total_s, mean_ms,
+    min_ms, max_ms, p50_ms, p95_ms}}``.  The original three keys keep
+    their exact semantics; the tail keys come from the fixed-bucket
+    histogram (``monitor.registry.Histogram``) — a mean alone hides
+    exactly the straggler tails this subsystem exists to expose."""
     with _stats_lock:
         snap = dict(_stats)
-    return {
-        name: {
+        hists = dict(_hists)
+    out: Dict[str, Dict[str, float]] = {}
+    for name, (n, total) in snap.items():
+        row = {
             "count": n,
             "total_s": total,
             "mean_ms": (total / n * 1e3) if n else 0.0,
         }
-        for name, (n, total) in snap.items()
-    }
+        h = hists.get(name)
+        if h is not None and h.count:
+            s = h.summary()
+            row["min_ms"] = s["min"] * 1e3
+            row["max_ms"] = s["max"] * 1e3
+            row["p50_ms"] = s["p50"] * 1e3
+            row["p95_ms"] = s["p95"] * 1e3
+        out[name] = row
+    return out
 
 
 def reset_trace_stats() -> None:
     with _stats_lock:
         _stats.clear()
+        _hists.clear()
 
 
 @contextlib.contextmanager

@@ -4,13 +4,16 @@
 #
 #   scripts/check.sh
 #
-# 1. kflint        — all eight project-invariant checkers, including the
-#                    kf-verify interprocedural rules (docs/lint.md).
-#                    Findings fingerprinted in tests/lint_baseline.json
-#                    are suppressed (legacy debt being ratcheted down);
-#                    anything NOT in the baseline fails the gate.
-# 2. compileall    — every .py parses/compiles on this interpreter
-# 3. flag stamps   — no sanitizer flags leaked into the production
+# 1. kflint        — all nine project-invariant checkers, including the
+#                    kf-verify interprocedural rules and trace-vocab
+#                    (docs/lint.md).  Findings fingerprinted in
+#                    tests/lint_baseline.json are suppressed (legacy
+#                    debt being ratcheted down); anything NOT in the
+#                    baseline fails the gate.
+# 2. kftrace       — flight-recorder dump schema self-check (recorder
+#                    and reader must agree byte-for-byte, docs/tracing.md)
+# 3. compileall    — every .py parses/compiles on this interpreter
+# 4. flag stamps   — no sanitizer flags leaked into the production
 #                    .buildflags stamp (variants must never mix)
 set -euo pipefail
 
@@ -24,6 +27,11 @@ if [ -f tests/lint_baseline.json ]; then
     KFLINT_ARGS+=(--baseline tests/lint_baseline.json)
 fi
 if ! python3 scripts/kflint "${KFLINT_ARGS[@]}"; then
+    fail=1
+fi
+
+echo "== kftrace self-check (dump schema round-trip)"
+if ! python3 scripts/kftrace --self-check; then
     fail=1
 fi
 

@@ -1,0 +1,43 @@
+"""trace-vocab fixture: typo'd / dynamic / missing event kinds."""
+
+from kungfu_tpu.monitor import timeline
+from kungfu_tpu.monitor.timeline import event as ev
+
+
+def good_mark():
+    timeline.event("mark", "boot-done")  # in vocabulary: clean
+
+
+def typo_kind():
+    timeline.event("colective", "engine.all_reduce")  # typo: flagged
+
+
+def dynamic_kind(k):
+    with timeline.span(k, "engine.all_reduce"):  # dynamic: flagged
+        pass
+
+
+def no_kind():
+    ev()  # missing kind: flagged
+
+
+def aliased_typo():
+    ev("shrnk", "consensus")  # typo through the alias: flagged
+
+
+def waived(k):
+    timeline.event(k, "escape-hatch")  # kflint: allow(trace-vocab)
+
+
+class Unrelated:
+    def span(self, *a):
+        return self
+
+    def event(self, *a):
+        return self
+
+
+def not_the_timeline():
+    u = Unrelated()
+    u.span("whatever")  # other receiver: NOT flagged
+    u.event("whatever")

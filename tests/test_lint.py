@@ -17,6 +17,7 @@ from kungfu_tpu.analysis import (
     lockcheck,
     pylockorder,
     retrydiscipline,
+    tracevocab,
     wirecontract,
 )
 from kungfu_tpu.analysis.cli import main as cli_main, run_checkers
@@ -311,6 +312,55 @@ class TestLockOrder:
         })
         assert pylockorder.check(root) == [], \
             [v.render() for v in pylockorder.check(root)]
+
+
+MINI_TIMELINE = (
+    "EVENT_KINDS = frozenset({\n"
+    '    "collective", "device", "send", "recv", "retry", "deadline",\n'
+    '    "signal", "down", "shrink", "chaos", "step", "mark",\n'
+    "})\n"
+)
+
+
+class TestTraceVocab:
+    """The observability rule: span()/event() kinds must be string
+    literals from timeline.py's EVENT_KINDS — a typo'd kind silently
+    vanishes from every kftrace filter instead of erroring."""
+
+    def _tree(self, tmp_path):
+        return _tmp_tree(tmp_path, {
+            "kungfu_tpu/monitor/timeline.py": MINI_TIMELINE,
+            "kungfu_tpu/mod.py": "tracevocab_bad.py",
+        })
+
+    def test_fixture_violations_caught(self, tmp_path):
+        got = sorted((v.line, v.message)
+                     for v in tracevocab.check(self._tree(tmp_path)))
+        assert [line for line, _ in got] == [12, 16, 21, 25], got
+        assert "not in the EVENT_KINDS vocabulary" in got[0][1]
+        assert "must be a string literal" in got[1][1]
+        assert "without a kind argument" in got[2][1]
+        assert "'shrnk'" in got[3][1]
+
+    def test_suppression_honored(self, tmp_path):
+        # the waived dynamic kind (allow line) must not surface
+        flagged = {v.line for v in tracevocab.check(self._tree(tmp_path))}
+        assert not any(line > 26 for line in flagged), flagged
+
+    def test_unrelated_receivers_not_flagged(self, tmp_path):
+        # Unrelated.span()/.event() calls at the fixture tail are clean
+        got = tracevocab.check(self._tree(tmp_path))
+        assert all("Unrelated" not in v.message for v in got)
+
+    def test_vocab_parsed_from_real_tree(self, tmp_path):
+        from kungfu_tpu.analysis.tracevocab import _vocabulary
+        from kungfu_tpu.monitor.timeline import EVENT_KINDS
+
+        assert _vocabulary(ROOT) == set(EVENT_KINDS)
+
+    def test_no_timeline_module_is_silent(self, tmp_path):
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": "tracevocab_bad.py"})
+        assert tracevocab.check(root) == []
 
 
 class TestBaselineAndJson:
