@@ -7,10 +7,11 @@ BASELINE := tests/lint_baseline.json
 
 .PHONY: lint verify check test native trace-demo help
 
-## lint: all nine kf-lint rules — the Python suite (env-contract,
+## lint: all ten kf-lint rules — the Python suite (env-contract,
 ## jit-sync, blocking-io, retry-discipline, collective-consistency,
-## wire-contract, lock-order, trace-vocab) AND the transport.cpp
-## lockcheck (lock-discipline) in one command, honoring the baseline.
+## wire-contract, lock-order, trace-vocab, agg-schema) AND the
+## transport.cpp lockcheck (lock-discipline) in one command, honoring
+## the baseline.
 lint:
 	$(PY) scripts/kflint $(if $(wildcard $(BASELINE)),--baseline $(BASELINE))
 

@@ -30,6 +30,7 @@ import sys
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from kungfu_tpu.analysis import (
+    aggschema,
     blockingio,
     collectives,
     envcheck,
@@ -52,6 +53,7 @@ CHECKERS: Dict[str, object] = {
     wirecontract.CHECKER: wirecontract.check,
     pylockorder.CHECKER: pylockorder.check,
     tracevocab.CHECKER: tracevocab.check,
+    aggschema.CHECKER: aggschema.check,
 }
 
 #: the kf-verify subset: the interprocedural rules built on the shared

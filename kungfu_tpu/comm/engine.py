@@ -32,6 +32,7 @@ from kungfu_tpu.chaos import controller_for as _chaos_controller_for
 from kungfu_tpu.comm.faults import PeerFailureError
 from kungfu_tpu.comm.host import CONNECT_TIMEOUT_S, ConnType, HostChannel
 from kungfu_tpu.monitor import timeline
+from kungfu_tpu.monitor.registry import REGISTRY
 from kungfu_tpu.utils import envs
 from kungfu_tpu.utils.retry import sleep_backoff
 from kungfu_tpu.plan import (
@@ -225,6 +226,10 @@ class CollectiveEngine:
         #: per-call env parse on that path is measurable noise (engines
         #: are rebuilt each mesh epoch, so retuning still lands)
         self._peer_deadline = peer_deadline_s()
+        #: resolved once for the same reason: _begin_collective runs on
+        #: every public collective, and the registry lookup is a lock +
+        #: dict hash it doesn't need to repay per call
+        self._coll_counter = REGISTRY.counter("kf_engine_collectives_total")
         self._seq = 0
         self._lock = threading.Lock()
         self._stats_lock = threading.Lock()  # guards stats/_window swaps
@@ -260,7 +265,7 @@ class CollectiveEngine:
         raises instead of silently downgrading."""
         if op not in REDUCE_OPS and op != "mean":
             raise ValueError(f"op {op!r}")
-        self._chaos_collective(name or "all_reduce")
+        self._begin_collective(name or "all_reduce")
         eff_op = "sum" if op == "mean" else op
         if inplace and not x.flags["WRITEABLE"]:
             raise ValueError("inplace=True requires a writable array")
@@ -287,16 +292,19 @@ class CollectiveEngine:
             return orig
         return out
 
-    def _chaos_collective(self, tag: str) -> None:
-        """Every public collective advances the injector's ``coll``
-        counter — ``die:coll=N`` means the Nth engine collective of any
-        kind, so an experiment against a loop that opens with a
-        parameter broadcast still dies where the spec says."""
+    def _begin_collective(self, tag: str) -> None:
+        """Entry hook of every public collective: ticks the unified
+        collective counter (the live plane's per-push rate source) and
+        advances the injector's ``coll`` counter — ``die:coll=N`` means
+        the Nth engine collective of any kind, so an experiment against
+        a loop that opens with a parameter broadcast still dies where
+        the spec says."""
+        self._coll_counter.inc()
         if self._chaos is not None:
             self._chaos.on_collective(tag)
 
     def broadcast(self, x: np.ndarray, root: int = 0, name: str = "") -> np.ndarray:
-        self._chaos_collective(name or "broadcast")
+        self._begin_collective(name or "broadcast")
         with self._lock:
             seq = self._seq
             self._seq += 1
@@ -313,7 +321,7 @@ class CollectiveEngine:
     def reduce(self, x: np.ndarray, root: int = 0, op: str = "sum", name: str = "") -> np.ndarray:
         """Reduce to ``root`` (reference ``session.go:157-161``): only the
         root returns the reduced value; other ranks get their input back."""
-        self._chaos_collective(name or "reduce")
+        self._begin_collective(name or "reduce")
         tag = (name or f"rd{self._next_seq()}") + ".r"
         flat = np.ascontiguousarray(x).reshape(-1)
         eff_op = "sum" if op == "mean" else op
@@ -334,7 +342,7 @@ class CollectiveEngine:
     def gather(self, x: np.ndarray, root: int = 0, name: str = "") -> Optional[np.ndarray]:
         """Root returns [n, ...] stacked in rank order; others None
         (reference gathers to rank 0, ``session.go:189-211``)."""
-        self._chaos_collective(name or "gather")
+        self._begin_collective(name or "gather")
         tag = (name or f"ga{self._next_seq()}") + ".g"
         flat = np.ascontiguousarray(x).reshape(-1)
         with timeline.span("collective", "engine.gather", rank=self._timeline_rank,
@@ -355,7 +363,7 @@ class CollectiveEngine:
     def all_gather(self, x: np.ndarray, name: str = "") -> np.ndarray:
         """Direct full-exchange (reference ``allgather.go:17-45``): every
         peer sends to every other; returns [n, ...] in rank order."""
-        self._chaos_collective(name or "all_gather")
+        self._begin_collective(name or "all_gather")
         tag = (name or f"ag{self._next_seq()}") + ".ag"
         flat = np.ascontiguousarray(x).reshape(-1)
         me = self.rank
@@ -412,7 +420,7 @@ class CollectiveEngine:
     def local_reduce(self, x: np.ndarray, op: str = "sum", name: str = "") -> np.ndarray:
         """Reduce among same-host peers; result on the local root
         (reference ``LocalReduce``).  Non-roots get their input back."""
-        self._chaos_collective(name or "local_reduce")
+        self._begin_collective(name or "local_reduce")
         tag = (name or f"lr{self._next_seq()}") + ".lr"
         flat = np.ascontiguousarray(x).reshape(-1)
         ranks = self._local_ranks()
@@ -430,7 +438,7 @@ class CollectiveEngine:
 
     def local_broadcast(self, x: np.ndarray, name: str = "") -> np.ndarray:
         """Broadcast from the local root to same-host peers."""
-        self._chaos_collective(name or "local_broadcast")
+        self._begin_collective(name or "local_broadcast")
         tag = (name or f"lb{self._next_seq()}") + ".lb"
         flat = np.ascontiguousarray(x).reshape(-1)
         ranks = self._local_ranks()
@@ -444,7 +452,7 @@ class CollectiveEngine:
         """Hierarchical allreduce (reference ``allreduce.go:38``
         CrossAllReduce + the ScheduledHierarchical pattern): local reduce
         to the host roots, allreduce among roots, local broadcast."""
-        self._chaos_collective(name or "cross_all_reduce")
+        self._begin_collective(name or "cross_all_reduce")
         base = name or f"xa{self._next_seq()}"
         eff_op = "sum" if op == "mean" else op
         flat = np.ascontiguousarray(x).reshape(-1)

@@ -1,4 +1,4 @@
-"""HTTP cluster-config store.
+"""HTTP cluster-config store (+ the mounted live-monitoring plane).
 
 REST parity with reference ``elastic/configserver/configserver.go:24-112``:
 
@@ -7,6 +7,16 @@ REST parity with reference ``elastic/configserver/configserver.go:24-112``:
 * ``POST /reset`` → body = cluster JSON; reset to version 0
 * ``DELETE /``    → clear
 * ``GET  /stop``  → shut the server down
+
+When a :class:`~kungfu_tpu.monitor.aggregator.ClusterAggregator` is
+mounted (``kfrun -monitor`` / ``kf-config-server -monitor``), three more
+routes serve the live cluster plane — co-hosted here because this is the
+one process every peer already knows the address of, and it survives a
+shrink:
+
+* ``POST /push``    → rank snapshot / control-event intake
+* ``GET  /cluster`` → the rolling cluster view (JSON; ``kftop`` renders it)
+* ``GET  /metrics`` → cluster-plane Prometheus text
 """
 
 from __future__ import annotations
@@ -23,21 +33,25 @@ _log = get_logger("config-server")
 
 
 class ConfigServer:
-    def __init__(self, port: int = 9100, cluster: Optional[Cluster] = None, host: str = "0.0.0.0"):
+    def __init__(self, port: int = 9100, cluster: Optional[Cluster] = None,
+                 host: str = "0.0.0.0", aggregator=None):
         self.port = port
         self._lock = threading.Lock()
         self._cluster = cluster
         self._version = 0
         self._thread: Optional[threading.Thread] = None
+        #: mounted live-monitoring plane (None = routes answer 404)
+        self.aggregator = aggregator
         srv = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # quiet
                 _log.debug(fmt, *args)
 
-            def _reply(self, code: int, body: bytes = b""):
+            def _reply(self, code: int, body: bytes = b"",
+                       content_type: str = "application/json"):
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 if body:
@@ -51,6 +65,29 @@ class ConfigServer:
                 if self.path.startswith("/stop"):
                     self._reply(200, b"{}")
                     threading.Thread(target=srv.stop, daemon=True).start()
+                    return
+                if self.path.startswith("/cluster"):
+                    agg = srv.aggregator
+                    if agg is None:
+                        self._reply(404, b'{"error": "no aggregator"}')
+                        return
+                    view = agg.cluster_view(srv._cluster_info())
+                    self._reply(200, json.dumps(view).encode())
+                    return
+                if self.path.startswith("/metrics"):
+                    agg = srv.aggregator
+                    if agg is None:
+                        self._reply(404, b'{"error": "no aggregator"}')
+                        return
+                    from kungfu_tpu.monitor.registry import REGISTRY
+
+                    # cluster view + this process's own registry (the
+                    # aggregator ticks kf_cluster_control_events_total
+                    # there — it must be scrapeable somewhere)
+                    text = (agg.render_prometheus(srv._cluster_info())
+                            + REGISTRY.render_prometheus())
+                    self._reply(200, text.encode(),
+                                content_type="text/plain; version=0.0.4")
                     return
                 with srv._lock:
                     if srv._cluster is None:
@@ -75,6 +112,18 @@ class ConfigServer:
                 self._reply(200, json.dumps({"version": v}).encode())
 
             def do_POST(self):
+                if self.path.startswith("/push"):
+                    agg = srv.aggregator
+                    if agg is None:
+                        self._reply(404, b'{"error": "no aggregator"}')
+                        return
+                    try:
+                        agg.ingest(json.loads(self._body().decode()))
+                    except (ValueError, KeyError) as e:
+                        self._reply(400, json.dumps({"error": str(e)}).encode())
+                        return
+                    self._reply(200, b"{}")
+                    return
                 try:
                     cluster = Cluster.from_json(self._body().decode())
                 except (ValueError, KeyError) as e:
@@ -114,6 +163,20 @@ class ConfigServer:
         with self._lock:
             return self._version, self._cluster
 
+    def _cluster_info(self) -> Optional[dict]:
+        """``{version, size, workers}`` for the aggregator's cluster
+        health, or None when no cluster is stored.  Takes and releases
+        the config lock BEFORE the aggregator's own lock is touched —
+        the two must never nest (pylockorder)."""
+        version, cluster = self.snapshot()
+        if cluster is None:
+            return None
+        return {
+            "version": version,
+            "size": cluster.size(),
+            "workers": [str(w) for w in cluster.workers],
+        }
+
 
 def main(argv=None) -> int:
     """Standalone elastic config server (reference
@@ -124,8 +187,17 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="kf-config-server")
     p.add_argument("-port", type=int, default=9100)
     p.add_argument("-host", default="0.0.0.0")
+    p.add_argument("-monitor", action="store_true",
+                   help="mount the live cluster aggregator "
+                        "(/push, /cluster, /metrics; view with kftop)")
     ns = p.parse_args(argv)
-    srv = ConfigServer(port=ns.port, host=ns.host).start()
+    aggregator = None
+    if ns.monitor:
+        from kungfu_tpu.monitor.aggregator import ClusterAggregator
+
+        aggregator = ClusterAggregator()
+    srv = ConfigServer(port=ns.port, host=ns.host,
+                       aggregator=aggregator).start()
     _log.info("config server listening on %s:%d", ns.host, ns.port)
     try:
         while srv._thread is not None and srv._thread.is_alive():

@@ -120,6 +120,12 @@ def shrink_to_survivors(peer, dead_ranks: Sequence[int]) -> bool:
     if 2 * len(survivor_ranks) <= len(workers):
         timeline.event("shrink", "quorum-lost", rank=me,
                        survivors=len(survivor_ranks), total=len(workers))
+        if me == min(survivor_ranks):
+            from kungfu_tpu.monitor.aggregator import post_control_if_enabled
+
+            # the operator's "full restart incoming" signal on kftop
+            post_control_if_enabled(peer, "quorum-lost", dead=dead,
+                                    survivors=len(survivor_ranks))
         raise QuorumLostError(len(survivor_ranks), len(workers))
 
     survivors = workers.select(survivor_ranks)
@@ -171,6 +177,15 @@ def shrink_to_survivors(peer, dead_ranks: Sequence[int]) -> bool:
     _publish_shrunk_cluster(peer, new_cluster, survivors)
     peer._propose(new_cluster, version)
     log_event(f"shrunk-to-survivors-v{version}-n{len(survivors)}")
+    # control event for the live plane, AFTER _propose: the propose path
+    # posts its own generic "resize" event, and kftop's cluster-health
+    # line shows only the newest control — the shrink (which names the
+    # dead set, the thing the operator needs) must be the one that sticks
+    if survivors.rank(peer.config.self_id) == 0:
+        from kungfu_tpu.monitor.aggregator import post_control_if_enabled
+
+        post_control_if_enabled(peer, "shrink", dead=dead, version=version,
+                                survivors=len(survivors))
     return True
 
 

@@ -11,7 +11,14 @@
 * :mod:`kungfu_tpu.monitor.registry` — unified counters/gauges/latency
   histograms rendered through ``/metrics``;
 * :mod:`kungfu_tpu.monitor.traceview` — ``kftrace``: merge per-rank
-  dumps into a Chrome/Perfetto trace + straggler report.
+  dumps into a Chrome/Perfetto trace + straggler report;
+* :mod:`kungfu_tpu.monitor.skew` — the straggler math itself, one pure
+  module shared by the offline report and the live plane;
+* :mod:`kungfu_tpu.monitor.aggregator` — kfmon: per-rank snapshot
+  pushes to a cluster aggregator co-hosted with the config server
+  (freshness/staleness, online skew, cluster health);
+* :mod:`kungfu_tpu.monitor.kftop` — ``kftop``: live refreshing terminal
+  view of the aggregator's ``/cluster`` endpoint.
 """
 
 from kungfu_tpu.monitor import timeline

@@ -1,0 +1,639 @@
+"""kfmon: the live cluster observability plane.
+
+After PR 4 every rank can tell its own story (``/metrics``,
+flight-recorder dumps) — but only *post mortem*, and only one rank at a
+time.  At pod scale the operating question is always "**which rank,
+right now**": this module gives every rank a :class:`RankReporter`
+thread that periodically pushes a compact :func:`make_snapshot` to a
+:class:`ClusterAggregator` co-hosted with the elastic
+:class:`~kungfu_tpu.elastic.configserver.ConfigServer` — the one process
+every peer already knows the address of, and that survives a shrink.
+
+The aggregator maintains a rolling cluster view served by the config
+server as ``/cluster`` (JSON, rendered live by ``scripts/kftop``) and
+merged into its ``/metrics`` (Prometheus text):
+
+* **freshness** — a rank whose snapshots stop arriving is flagged
+  *stale* after ``KF_CONFIG_MONITOR_STALE_AFTER`` seconds (default 3
+  push periods ≈ 3 s), well before the failure detector's 10 s ``down``
+  verdict — the first cross-rank signal that something is wrong;
+* **online skew** — each snapshot carries the collective spans the
+  flight recorder captured since the last push; the aggregator feeds
+  them to the SAME :mod:`kungfu_tpu.monitor.skew` math ``kftrace`` uses
+  offline, so the live straggler verdict and the post-mortem report
+  cannot disagree;
+* **cluster health** — peer set + config version (from the co-hosted
+  config server), per-rank strategy, the last shrink/resize control
+  events (pushed by the elastic layer via :func:`post_control`), and the
+  quorum margin (how many more deaths until shrink-to-survivors must
+  give up).
+
+Wire contract: everything is plain JSON over the config server's
+existing HTTP endpoint (``POST /push``).  Snapshot field names are
+**literals from the declared schema constants below** — enforced by the
+``agg-schema`` kflint rule, because a typo'd field would not error, it
+would silently vanish from every ``kftop`` column (the same failure mode
+the ``trace-vocab`` rule exists to prevent).
+
+Cost contract: the whole plane is off unless
+``KF_CONFIG_ENABLE_CLUSTER_MONITOR`` is truthy (``kfrun -monitor``); on,
+it is one daemon thread per rank doing O(new events) work per push.
+Online skew additionally needs the flight recorder enabled
+(``KF_CONFIG_ENABLE_TRACE`` — ``-monitor`` implies it); without it the
+snapshots still carry step/counter/net freshness.
+
+Stdlib-only by design, like :mod:`~kungfu_tpu.monitor.registry` and
+:mod:`~kungfu_tpu.monitor.skew`: ``scripts/kftop`` must run in bare CI
+images and on operator laptops without jax.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import threading
+import time
+import urllib.request
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from kungfu_tpu.monitor import skew as skewlib
+from kungfu_tpu.monitor.registry import REGISTRY, _escape_label_value
+from kungfu_tpu.utils.log import get_logger
+
+_log = get_logger("kfmon")
+
+# env mirror constants, defined next to their reader like timeline.py's
+# DUMP_ENV/CAP_ENV; utils/envs.py registers the same tokens for the
+# env-contract scan
+ENABLE_ENV = "KF_CONFIG_ENABLE_CLUSTER_MONITOR"
+PUSH_PERIOD_ENV = "KF_CONFIG_MONITOR_PUSH_PERIOD"
+STALE_AFTER_ENV = "KF_CONFIG_MONITOR_STALE_AFTER"
+
+DEFAULT_PUSH_PERIOD_S = 1.0
+#: stale = this many push periods without a snapshot (when
+#: KF_CONFIG_MONITOR_STALE_AFTER does not pin an absolute value)
+STALE_PERIODS = 3.0
+
+#: wire-format version stamped on every snapshot/control message
+WIRE_VERSION = 1
+
+#: one snapshot = one JSON object with EXACTLY these fields.  Producers
+#: go through :func:`make_snapshot`, consumers through :func:`field` —
+#: both enforced to literal members of this set by the ``agg-schema``
+#: kflint rule (and revalidated at runtime, for payloads built by hand).
+SNAPSHOT_FIELDS = frozenset({
+    "kfmon",         # wire version (int)
+    "rank",          # stable process identity (bootstrap rank)
+    "pid",           # sender pid
+    "wall",          # sender wall-clock at build time
+    "step",          # current training step (-1 before the first)
+    "step_time_s",   # EMA seconds per step (None until measurable)
+    "counters",      # {metric-key: int} cumulative registry counters
+    "gauges",        # {metric-key: float} registry gauges (GNS et al.)
+    "latency",       # {metric-key: {count, sum}} histogram DELTAS
+    "events",        # recent flight-recorder events (skew feedstock)
+    "net",           # {egress_bytes, ingress_bytes} cumulative totals
+    "strategy",      # active allreduce strategy name ("" = default)
+})
+
+#: fields of the ``/cluster`` view (and its per-rank rows / control
+#: entries) — the read-side vocabulary ``kftop`` renders from.
+VIEW_FIELDS = frozenset({
+    "kfmon", "wall", "stale_after_s", "cluster", "ranks", "stale",
+    "skew", "slowest_per_step", "straggler", "controls",
+    # cluster-health subfields
+    "version", "size", "workers", "quorum_margin", "last_control",
+    # per-rank row subfields (snapshot fields age_s/stale are computed)
+    "rank", "pid", "step", "step_time_s", "age_s", "counters", "gauges",
+    "latency", "net", "strategy",
+    # control-event subfields
+    "kind", "attrs",
+    # skew-row subfields (monitor/skew.py row dicts)
+    "op", "tag", "slowest_rank", "slowest_s", "fastest_rank",
+    "fastest_s", "skew_s", "total_s",
+})
+
+
+def _esc_label(v) -> str:
+    """Prometheus exposition-format label-value escaping (one rule set
+    for the whole package — registry.py owns it)."""
+    return _escape_label_value(str(v))
+
+
+def _parse_float_env(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+#: floor on the push period: a 0/negative env value must not turn every
+#: rank into a busy-loop of HTTP POSTs (disable via the ENABLE env, not
+#: a zero period)
+MIN_PUSH_PERIOD_S = 0.05
+
+
+def push_period_from_env() -> float:
+    v = _parse_float_env(PUSH_PERIOD_ENV, DEFAULT_PUSH_PERIOD_S)
+    if v <= 0:
+        return DEFAULT_PUSH_PERIOD_S
+    # clamp a too-small positive value UP rather than ignoring it, so
+    # every consumer of the knob (reporter period, staleness default,
+    # the launcher's aggregator) lands on the same effective period
+    return max(v, MIN_PUSH_PERIOD_S)
+
+
+def stale_after_from_env(period: Optional[float] = None) -> float:
+    period = push_period_from_env() if period is None else period
+    return _parse_float_env(STALE_AFTER_ENV, STALE_PERIODS * period)
+
+
+def make_snapshot(**fields) -> dict:
+    """Build one wire snapshot; unknown field names raise — the runtime
+    backstop behind the static ``agg-schema`` rule."""
+    unknown = set(fields) - SNAPSHOT_FIELDS
+    if unknown:
+        raise ValueError(
+            f"unknown snapshot field(s) {sorted(unknown)}; the schema is "
+            f"SNAPSHOT_FIELDS in kungfu_tpu/monitor/aggregator.py"
+        )
+    snap = {"kfmon": WIRE_VERSION}
+    snap.update(fields)
+    return snap
+
+
+def field(obj: dict, name: str, default=None):
+    """Schema-checked read of one snapshot/view field.  Call sites must
+    pass a string literal from the declared schema (``agg-schema``
+    kflint rule) — so a typo'd field fails lint instead of silently
+    rendering an empty ``kftop`` column."""
+    return obj.get(name, default)
+
+
+def control_event(kind: str, rank: Optional[int] = None, **attrs) -> dict:
+    """A control-plane event (shrink/resize/...) for :func:`post_control`."""
+    return {
+        "kfmon_control": WIRE_VERSION,
+        "kind": kind,
+        "rank": rank,
+        "wall": time.time(),
+        "attrs": attrs,
+    }
+
+
+def server_base(config_server_url: str) -> str:
+    """The aggregator's HTTP base from any config-server URL: scheme +
+    authority, path dropped (``http://h:9100/get`` → ``http://h:9100``)."""
+    from urllib.parse import urlsplit
+
+    url = config_server_url.strip().rstrip("/")
+    if "://" not in url:
+        # a bare host:port would parse its host as a scheme
+        url = "http://" + url
+    parts = urlsplit(url)
+    return f"{parts.scheme}://{parts.netloc}"
+
+
+def _post_json(url: str, obj: dict, timeout: float) -> None:
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(obj).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        resp.read()
+
+
+def post_control(config_server_url: str, kind: str,
+                 rank: Optional[int] = None, timeout: float = 2.0,
+                 **attrs) -> bool:
+    """Best-effort control-event push (elastic layer → aggregator).
+    Never raises: the monitoring plane must not take a recovery path
+    down with it.  Returns delivery success for tests."""
+    if not config_server_url:
+        return False
+    try:
+        _post_json(server_base(config_server_url) + "/push",
+                   control_event(kind, rank=rank, **attrs), timeout)
+        return True
+    except (OSError, http.client.HTTPException) as e:
+        _log.debug("control event %r not delivered: %s", kind, e)
+        return False
+
+
+# -- aggregator (config-server side) ---------------------------------------
+class ClusterAggregator:
+    """Rolling cluster view over pushed rank snapshots + control events.
+
+    Thread-safe; mounted into the ConfigServer's HTTP handler (`/push`,
+    `/cluster`, `/metrics`).  Per-rank event windows are bounded: skew
+    is an *online* signal over the recent past, not an archive — the
+    archive is the flight-recorder dump."""
+
+    def __init__(self, stale_after: Optional[float] = None,
+                 max_events_per_rank: int = 4096,
+                 max_controls: int = 64,
+                 time_fn: Callable[[], float] = time.time):
+        self.stale_after = (stale_after if stale_after is not None
+                            else stale_after_from_env())
+        self._lock = threading.Lock()
+        self._time = time_fn
+        self._ranks: Dict[int, dict] = {}        # rank -> last snapshot
+        self._seen: Dict[int, float] = {}        # rank -> arrival time
+        self._events: Dict[int, deque] = {}      # rank -> recent events
+        self._max_events = max_events_per_rank
+        self._controls: deque = deque(maxlen=max_controls)
+
+    # -- ingest ----------------------------------------------------------
+    def ingest(self, obj: dict) -> None:
+        """One pushed JSON object: a rank snapshot or a control event."""
+        if not isinstance(obj, dict):
+            raise ValueError("push payload must be a JSON object")
+        if obj.get("kfmon_control"):
+            dead = []
+            if obj.get("kind") == "shrink":
+                dead = [r for r in (obj.get("attrs") or {}).get("dead", [])
+                        if isinstance(r, int)]
+            with self._lock:
+                self._controls.append(dict(obj))
+                # a shrink evicts the dead ranks' state: their last spans
+                # would otherwise feed the skew verdict forever (no new
+                # pushes rotate a dead rank's window), leaving /cluster
+                # naming a rank that no longer exists
+                for r in dead:
+                    self._events.pop(r, None)
+                    self._ranks.pop(r, None)
+                    self._seen.pop(r, None)
+            REGISTRY.counter("kf_cluster_control_events_total",
+                             what=str(obj.get("kind"))).inc()
+            return
+        if not obj.get("kfmon"):
+            raise ValueError("push payload is neither snapshot nor control")
+        unknown = set(obj) - SNAPSHOT_FIELDS
+        if unknown:
+            raise ValueError(f"unknown snapshot field(s) {sorted(unknown)}")
+        rank = obj.get("rank")
+        if not isinstance(rank, int):
+            raise ValueError("snapshot carries no integer rank")
+        events = obj.get("events") or []
+        with self._lock:
+            self._ranks[rank] = obj
+            self._seen[rank] = self._time()
+            win = self._events.get(rank)
+            if win is None:
+                win = self._events[rank] = deque(maxlen=self._max_events)
+            for ev in events:
+                # the skew math keys on the emitting rank; a reporter
+                # forwarding ring events recorded before Peer.start
+                # installed the default stamps them itself
+                if ev.get("rank") is None:
+                    ev = dict(ev, rank=rank)
+                win.append(ev)
+
+    # -- views -----------------------------------------------------------
+    def _all_events(self) -> List[dict]:
+        with self._lock:
+            return [e for win in self._events.values() for e in win]
+
+    def stale_ranks(self) -> List[int]:
+        now = self._time()
+        with self._lock:
+            return sorted(r for r, t in self._seen.items()
+                          if now - t > self.stale_after)
+
+    def cluster_view(self, cluster_info: Optional[dict] = None,
+                     top: int = 20) -> dict:
+        """The ``/cluster`` JSON: cluster health + per-rank freshness +
+        online skew.  ``cluster_info`` is the co-hosted config server's
+        ``{version, size, workers}`` (None when it holds no cluster)."""
+        now = self._time()
+        with self._lock:
+            ranks = dict(self._ranks)
+            seen = dict(self._seen)
+            controls = list(self._controls)
+        events = self._all_events()
+        rows = []
+        stale = []
+        for rank in sorted(ranks):
+            snap = ranks[rank]
+            age = now - seen[rank]
+            is_stale = age > self.stale_after
+            if is_stale:
+                stale.append(rank)
+            rows.append({
+                "rank": rank,
+                "pid": snap.get("pid"),
+                "step": snap.get("step"),
+                "step_time_s": snap.get("step_time_s"),
+                "age_s": age,
+                "stale": is_stale,
+                "counters": snap.get("counters") or {},
+                "gauges": snap.get("gauges") or {},
+                "latency": snap.get("latency") or {},
+                "net": snap.get("net") or {},
+                "strategy": snap.get("strategy") or "",
+            })
+        health = dict(cluster_info or {})
+        size = health.get("size")
+        if isinstance(size, int) and size > 0:
+            # deaths survivable before strict majority is lost: the
+            # shrink path needs 2*survivors > size
+            health["quorum_margin"] = size - (size // 2 + 1)
+        if controls:
+            health["last_control"] = controls[-1]
+        return {
+            "kfmon": WIRE_VERSION,
+            "wall": now,
+            "stale_after_s": self.stale_after,
+            "cluster": health,
+            "ranks": rows,
+            "stale": stale,
+            "skew": skewlib.skew_rows(events)[:top],
+            "slowest_per_step": skewlib.slowest_rank_per_step(events)[-top:],
+            "straggler": skewlib.straggler_verdict(events),
+            "controls": controls[-top:],
+        }
+
+    def render_prometheus(self, cluster_info: Optional[dict] = None,
+                          top: int = 20) -> str:
+        """Cluster-plane series merged into the config server's
+        ``/metrics`` so one stock-Prometheus scrape of the control
+        process covers the whole job."""
+        view = self.cluster_view(cluster_info, top=top)
+        lines = [
+            "# HELP kf_cluster_ranks ranks that have pushed a snapshot",
+            "# TYPE kf_cluster_ranks gauge",
+            f"kf_cluster_ranks {len(view['ranks'])}",
+            "# HELP kf_cluster_stale_ranks ranks past the staleness threshold",
+            "# TYPE kf_cluster_stale_ranks gauge",
+            f"kf_cluster_stale_ranks {len(view['stale'])}",
+        ]
+        version = (view["cluster"] or {}).get("version")
+        if version is not None:
+            lines += [
+                "# HELP kf_cluster_config_version current cluster config version",
+                "# TYPE kf_cluster_config_version gauge",
+                f"kf_cluster_config_version {version}",
+            ]
+        if view["ranks"]:
+            lines += [
+                "# HELP kf_cluster_rank_age_seconds seconds since a rank's last snapshot",
+                "# TYPE kf_cluster_rank_age_seconds gauge",
+            ]
+            for row in view["ranks"]:
+                lines.append(
+                    f'kf_cluster_rank_age_seconds{{rank="{row["rank"]}"}} '
+                    f'{row["age_s"]:.6g}')
+            lines += [
+                "# HELP kf_cluster_rank_step a rank's last reported training step",
+                "# TYPE kf_cluster_rank_step gauge",
+            ]
+            for row in view["ranks"]:
+                if row["step"] is not None:
+                    lines.append(
+                        f'kf_cluster_rank_step{{rank="{row["rank"]}"}} '
+                        f'{row["step"]}')
+            st_rows = [r for r in view["ranks"]
+                       if r["step_time_s"] is not None]
+            if st_rows:
+                lines += [
+                    "# HELP kf_cluster_rank_step_time_seconds EMA step time per rank",
+                    "# TYPE kf_cluster_rank_step_time_seconds gauge",
+                ]
+                for row in st_rows:
+                    lines.append(
+                        f'kf_cluster_rank_step_time_seconds'
+                        f'{{rank="{row["rank"]}"}} {row["step_time_s"]:.6g}')
+        if view["skew"]:
+            lines += [
+                "# HELP kf_cluster_skew_seconds cross-rank duration skew per collective tag",
+                "# TYPE kf_cluster_skew_seconds gauge",
+            ]
+            for row in view["skew"]:
+                # op/tag are user-supplied collective names — escape per
+                # the exposition format or one odd name (quote, newline)
+                # invalidates the entire cluster-plane scrape
+                lines.append(
+                    f'kf_cluster_skew_seconds{{op="{_esc_label(row["op"])}",'
+                    f'tag="{_esc_label(row["tag"])}"}} {row["skew_s"]:.6g}')
+        return "\n".join(lines) + "\n"
+
+
+# -- reporter (rank side) --------------------------------------------------
+#: event kinds a snapshot forwards to the aggregator: the skew feedstock
+#: plus the fault kinds (so `/cluster` can correlate them online)
+REPORT_KINDS = frozenset(skewlib.COLLECTIVE_KINDS) | frozenset(skewlib.FAULT_KINDS)
+
+#: EMA weight for the step-time estimate (~5-push memory)
+_STEP_EMA_ALPHA = 0.2
+
+
+class RankReporter:
+    """Per-rank snapshot pusher: one daemon thread, one HTTP POST per
+    ``KF_CONFIG_MONITOR_PUSH_PERIOD``.  Delivery failures are swallowed
+    (a dead aggregator must not take training down); the aggregator's
+    staleness clock is the receiving side of the same contract."""
+
+    def __init__(self, rank: int, server_url: str,
+                 period: Optional[float] = None,
+                 strategy_fn: Optional[Callable[[], str]] = None,
+                 net_totals_fn: Optional[Callable[[], Dict[str, int]]] = None,
+                 events_fn: Optional[Callable[[], List[dict]]] = None):
+        self.rank = rank
+        self.period = max(MIN_PUSH_PERIOD_S,
+                          push_period_from_env() if period is None else period)
+        self._push_url = server_base(server_url) + "/push"
+        self._strategy_fn = strategy_fn
+        self._net_totals_fn = net_totals_fn
+        self._events_fn = events_fn
+        self._cursor = 0           # timeline.events_tail cursor
+        self._hist_prev: Dict[str, tuple] = {}
+        # a failed push must not eat its window: the cursor and delta
+        # baselines advance at COLLECTION time, so the undelivered
+        # events/deltas are carried here and merged into the next
+        # snapshot — otherwise a config-server blip during the very
+        # incident being diagnosed would hole the online skew window and
+        # break the online==offline agreement.  Bounded like the
+        # aggregator's own windows (a long outage keeps the newest).
+        self._pending_events: List[dict] = []
+        self._pending_latency: Dict[str, dict] = {}
+        self._max_pending = 4096
+        self._last_step: Optional[int] = None
+        self._last_step_wall = 0.0
+        self._step_ema: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # serializes pushes: stop()'s final push can otherwise run while
+        # the loop thread is still blocked inside a slow HTTP POST (the
+        # join below times out) — two threads advancing the cursor and
+        # pending buffers concurrently would duplicate or drop events
+        self._push_lock = threading.Lock()
+
+    # -- snapshot assembly ----------------------------------------------
+    def _collect_events(self) -> List[dict]:
+        if self._events_fn is not None:
+            return list(self._events_fn())
+        from kungfu_tpu.monitor import timeline
+
+        self._cursor, events = timeline.events_tail(
+            self._cursor, kinds=REPORT_KINDS)
+        return events
+
+    def _split_registry(self):
+        counters: Dict[str, int] = {}
+        gauges: Dict[str, float] = {}
+        latency: Dict[str, dict] = {}
+        for key, val in REGISTRY.snapshot().items():
+            if isinstance(val, dict):  # histogram summary
+                prev_count, prev_sum = self._hist_prev.get(key, (0, 0.0))
+                self._hist_prev[key] = (val["count"], val["sum"])
+                if val["count"] > prev_count:
+                    latency[key] = {
+                        "count": val["count"] - prev_count,
+                        "sum": val["sum"] - prev_sum,
+                    }
+            elif isinstance(val, bool):
+                continue
+            elif isinstance(val, int):
+                counters[key] = val
+            else:
+                gauges[key] = float(val)
+        return counters, gauges, latency
+
+    def _step_time(self, step: int, now: float) -> Optional[float]:
+        if step is None or step < 0:
+            return self._step_ema
+        if self._last_step is None or step < self._last_step:
+            # first sight — or the step went BACKWARD (shrink replay from
+            # the leader-agreed boundary): rebase the rate baseline so
+            # the first post-replay advance cannot smear the whole
+            # stall+replay wall time over a few steps as one bogus sample
+            self._last_step, self._last_step_wall = step, now
+            return self._step_ema
+        if step > self._last_step:
+            x = (now - self._last_step_wall) / (step - self._last_step)
+            self._step_ema = (
+                x if self._step_ema is None
+                else (1 - _STEP_EMA_ALPHA) * self._step_ema
+                + _STEP_EMA_ALPHA * x
+            )
+            self._last_step, self._last_step_wall = step, now
+        return self._step_ema
+
+    def snapshot_once(self) -> dict:
+        """Build (but do not send) one snapshot — also the test surface."""
+        from kungfu_tpu.monitor import timeline
+
+        now = time.time()
+        step = timeline.current_step()
+        counters, gauges, latency = self._split_registry()
+        net = {"egress_bytes": 0, "ingress_bytes": 0}
+        if self._net_totals_fn is not None:
+            try:
+                net.update(self._net_totals_fn())
+            except Exception as e:  # noqa: BLE001 - monitoring must not raise
+                _log.debug("net totals unavailable: %s", e)
+        else:
+            net["egress_bytes"] = int(gauges.get("kf_net_egress_bytes", 0))
+            net["ingress_bytes"] = int(gauges.get("kf_net_ingress_bytes", 0))
+        for key, delta in self._pending_latency.items():
+            cur = latency.get(key)
+            if cur is None:
+                latency[key] = delta
+            else:
+                latency[key] = {"count": cur["count"] + delta["count"],
+                                "sum": cur["sum"] + delta["sum"]}
+        events = self._pending_events + self._collect_events()
+        strategy = ""
+        if self._strategy_fn is not None:
+            # guarded like net_totals_fn: a raising user callback after
+            # the cursor/delta baselines advanced would otherwise drop
+            # this window's events on the push_once build-failure path
+            try:
+                strategy = self._strategy_fn()
+            except Exception as e:  # noqa: BLE001 - monitoring must not raise
+                _log.debug("strategy_fn unavailable: %s", e)
+        return make_snapshot(
+            rank=self.rank,
+            pid=os.getpid(),
+            wall=now,
+            step=step,
+            step_time_s=self._step_time(step, now),
+            counters=counters,
+            gauges=gauges,
+            latency=latency,
+            events=events[-self._max_pending:],
+            net=net,
+            strategy=strategy,
+        )
+
+    # -- lifecycle -------------------------------------------------------
+    def push_once(self) -> bool:
+        with self._push_lock:
+            try:
+                snap = self.snapshot_once()
+            except Exception as e:  # noqa: BLE001 - monitoring must not raise
+                _log.warning("snapshot build failed: %s", e)
+                return False
+            try:
+                _post_json(self._push_url, snap,
+                           timeout=max(1.0, min(self.period, 5.0)))
+                self._pending_events = []
+                self._pending_latency = {}
+                return True
+            except (OSError, http.client.HTTPException) as e:
+                # the snapshot already merged any earlier pending window,
+                # so carrying IT forward carries everything undelivered
+                self._pending_events = (snap.get("events")
+                                        or [])[-self._max_pending:]
+                self._pending_latency = dict(snap.get("latency") or {})
+                _log.debug("snapshot push failed: %s", e)
+                return False
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.period):
+            self.push_once()
+
+    def start(self) -> "RankReporter":
+        self._thread = threading.Thread(
+            target=self._loop, name=f"kfmon-r{self.rank}", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, final_push: bool = False) -> None:
+        """Stop the loop; ``final_push`` sends one last snapshot so a
+        clean shutdown leaves fresh numbers rather than a stale flag."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(self.period + 1.0)
+            self._thread = None
+        if final_push:
+            self.push_once()
+
+
+def post_control_if_enabled(peer, kind: str, **attrs) -> bool:
+    """The elastic layer's one-line control post: applies the shared
+    gate (plane enabled + config server known) and stamps the peer's
+    stable chaos-rank identity.  Callers keep only their own leader
+    check — which rank announces differs per protocol.  Imports lazily:
+    this module must stay importable from the stubbed ``kftop``/CI
+    context where :mod:`kungfu_tpu.utils.envs`'s plan imports are
+    unavailable."""
+    from kungfu_tpu.utils import envs
+
+    if not envs.parse_bool_env(envs.ENABLE_CLUSTER_MONITOR):
+        return False
+    if not peer.config.config_server:
+        return False
+    return post_control(peer.config.config_server, kind,
+                        rank=peer.chaos_rank(), **attrs)
+
+
+def publish_stat(name: str, value: float) -> None:
+    """Publish a training statistic (GNS, gradient variance, ...) into
+    the unified registry so the next snapshot carries it to ``kftop``:
+    ``publish_stat("gns", v)`` → gauge ``kf_stat_gns``."""
+    REGISTRY.gauge(f"kf_stat_{name}").set(float(value))

@@ -1,0 +1,263 @@
+"""``kftop``: live terminal view of the cluster observability plane.
+
+Fetches the config server's ``/cluster`` JSON (the rolling view the
+:class:`~kungfu_tpu.monitor.aggregator.ClusterAggregator` maintains from
+per-rank snapshot pushes) and renders it as a refreshing terminal table:
+per-rank freshness/step/step-time/fault counters, the online cross-rank
+skew section (same :mod:`~kungfu_tpu.monitor.skew` math as the offline
+``kftrace`` report), and cluster health (membership version, quorum
+margin, last shrink/resize control event).
+
+Modes::
+
+    kftop                         # live view, refresh every 2 s
+    kftop --server http://h:9100  # point at the config server
+    kftop --once                  # render one frame and exit
+    kftop --json                  # one-shot raw /cluster JSON (scripts)
+    kftop --self-check            # schema round-trip on a canned payload
+
+Stdlib-only and launched through ``scripts/kftop`` with the same package
+stubs as ``kftrace``: it must run on an operator laptop or bare CI image
+with no jax installed.
+
+Every read of a snapshot/view field goes through
+:func:`~kungfu_tpu.monitor.aggregator.field` with a literal name — the
+``agg-schema`` kflint rule fails a typo'd field at lint time instead of
+letting a column silently render empty.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+from typing import List, Optional, Sequence
+
+from kungfu_tpu.monitor.aggregator import (
+    ClusterAggregator,
+    VIEW_FIELDS,
+    control_event,
+    field,
+    make_snapshot,
+    server_base,
+)
+
+DEFAULT_SERVER = "http://127.0.0.1:9100"
+
+
+def fetch_view(server: str, timeout: float = 5.0) -> dict:
+    url = server_base(server) + "/cluster"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+# -- rendering -------------------------------------------------------------
+def _fmt_s(v: Optional[float], unit: str = "s") -> str:
+    if v is None:
+        return "-"
+    if unit == "ms":
+        return f"{v * 1e3:.1f}ms"
+    return f"{v:.1f}s"
+
+
+def _fmt_bytes(n) -> str:
+    try:
+        n = int(n)
+    except (TypeError, ValueError):
+        return "-"
+    for suffix, scale in (("GiB", 1 << 30), ("MiB", 1 << 20), ("KiB", 1 << 10)):
+        if n >= scale:
+            return f"{n / scale:.1f}{suffix}"
+    return f"{n}B"
+
+
+def _counter(row: dict, name: str) -> int:
+    """Sum of a pushed counter over its label variants (the registry
+    renders ``kf_chaos_injections_total{what="delay"}`` per label set)."""
+    counters = field(row, "counters") or {}
+    return sum(v for k, v in counters.items()
+               if k == name or k.startswith(name + "{"))
+
+
+def _window_latency_s(row: dict) -> Optional[float]:
+    """Mean collective latency over the rank's last push window, from
+    the histogram count/sum deltas the snapshot carries."""
+    lat = field(row, "latency") or {}
+    count = sum(d.get("count", 0) for d in lat.values())
+    total = sum(d.get("sum", 0.0) for d in lat.values())
+    return (total / count) if count else None
+
+
+def render_view(view: dict, top: int = 10) -> str:
+    lines: List[str] = []
+    wall = field(view, "wall")
+    clock = time.strftime("%H:%M:%S", time.localtime(wall)) if wall else "?"
+    rows = field(view, "ranks") or []
+    stale = field(view, "stale") or []
+    straggler = field(view, "straggler")
+    cluster = field(view, "cluster") or {}
+    head = (f"kfmon @ {clock} — {len(rows)} rank(s), {len(stale)} stale "
+            f"(threshold {_fmt_s(field(view, 'stale_after_s'))})")
+    version = field(cluster, "version")
+    if version is not None:
+        head += (f" | cluster v{version} n={field(cluster, 'size')}"
+                 f" quorum-margin {field(cluster, 'quorum_margin')}")
+    if straggler is not None:
+        head += f" | straggler: rank {straggler}"
+    lines.append(head)
+    last = field(cluster, "last_control")
+    if last:
+        age = (wall or time.time()) - (field(last, "wall") or 0)
+        lines.append(
+            f"last control: {field(last, 'kind')} "
+            f"({_fmt_s(age)} ago, rank {field(last, 'rank')}) "
+            f"{field(last, 'attrs') or ''}")
+    lines.append("")
+    hdr = (f"{'rank':>4} {'state':<6} {'age':>7} {'step':>7} "
+           f"{'step-time':>10} {'coll-lat':>9} {'retries':>8} "
+           f"{'faults':>7} {'chaos':>6} "
+           f"{'egress':>9} {'ingress':>9}  strategy")
+    lines.append(hdr)
+    for row in rows:
+        state = "STALE" if field(row, "stale") else "ok"
+        net = field(row, "net") or {}
+        faults = (_counter(row, "kf_peer_faults_total")
+                  + _counter(row, "kf_detector_down_total"))
+        lat = _window_latency_s(row)
+        lines.append(
+            f"{field(row, 'rank'):>4} {state:<6} "
+            f"{_fmt_s(field(row, 'age_s')):>7} "
+            f"{field(row, 'step') if field(row, 'step') is not None else '-':>7} "
+            f"{_fmt_s(field(row, 'step_time_s')):>10} "
+            f"{_fmt_s(lat, 'ms') if lat is not None else '-':>9} "
+            f"{_counter(row, 'kf_engine_retries_total'):>8} "
+            f"{faults:>7} "
+            f"{_counter(row, 'kf_chaos_injections_total'):>6} "
+            f"{_fmt_bytes(net.get('egress_bytes')):>9} "
+            f"{_fmt_bytes(net.get('ingress_bytes')):>9}  "
+            f"{field(row, 'strategy') or '-'}")
+    if not rows:
+        lines.append("  (no snapshots yet — workers push once per "
+                     "KF_CONFIG_MONITOR_PUSH_PERIOD)")
+    lines.append("")
+    lines.append("== cross-rank skew (widest first; online, same math as "
+                 "`kftrace report`)")
+    skew = field(view, "skew") or []
+    for r in skew[:top]:
+        lines.append(
+            f"  {field(r, 'op')}/{field(r, 'tag')}: "
+            f"skew {_fmt_s(field(r, 'skew_s'), 'ms')} — "
+            f"rank {field(r, 'slowest_rank')} "
+            f"{_fmt_s(field(r, 'slowest_s'), 'ms')} vs "
+            f"rank {field(r, 'fastest_rank')} "
+            f"{_fmt_s(field(r, 'fastest_s'), 'ms')}")
+    if not skew:
+        lines.append("  (no cross-rank collective spans in the window — "
+                     "is KF_CONFIG_ENABLE_TRACE on?)")
+    return "\n".join(lines) + "\n"
+
+
+# -- self-check ------------------------------------------------------------
+def self_check() -> int:
+    """Schema round-trip on a canned payload: build snapshots through
+    :func:`make_snapshot`, ingest them into a live aggregator, serialize
+    the view through JSON, and re-render — proving the push wire format,
+    the view schema, and the renderer agree (wired into check.sh)."""
+    clock = [1000.0]
+    agg = ClusterAggregator(stale_after=1.0, time_fn=lambda: clock[0])
+
+    def span(rank, dur, tag):
+        return {"ts": 999.0, "rank": rank, "step": 3, "kind": "collective",
+                "name": "engine.all_reduce", "dur": dur,
+                "attrs": {"op": "all_reduce", "tag": tag}}
+
+    for rank in range(3):
+        dur = 0.10 if rank == 2 else 0.01
+        agg.ingest(make_snapshot(
+            rank=rank, pid=100 + rank, wall=999.5, step=3,
+            step_time_s=0.25,
+            counters={"kf_engine_retries_total": rank},
+            gauges={"kf_stat_gns": 1.5},
+            latency={"kf_collective_latency_seconds": {"count": 2, "sum": dur}},
+            events=[span(rank, dur, "grad3")],
+            net={"egress_bytes": 1 << 20, "ingress_bytes": 1 << 20},
+            strategy="RING",
+        ))
+    agg.ingest(control_event("shrink", rank=0, dead=[4], version=2))
+    clock[0] += 2.0  # every rank now past the 1 s staleness threshold
+    view = json.loads(json.dumps(agg.cluster_view(
+        {"version": 2, "size": 3, "workers": ["h:1", "h:2", "h:3"]})))
+    bad = set(view) - VIEW_FIELDS
+    ok = (
+        not bad
+        and field(view, "straggler") == 2
+        and [field(r, "rank") for r in field(view, "ranks")] == [0, 1, 2]
+        and field(view, "stale") == [0, 1, 2]
+        and field(view, "skew")
+        and abs(field(field(view, "skew")[0], "skew_s") - 0.09) < 1e-9
+        and field(field(view, "cluster"), "quorum_margin") == 1
+        and field(field(field(view, "cluster"), "last_control"), "kind")
+        == "shrink"
+    )
+    ok = ok and bool(field(field(view, "ranks")[0], "latency"))
+    text = render_view(view)
+    ok = (ok and "STALE" in text and "all_reduce/grad3" in text
+          and "coll-lat" in text)
+    if not ok:
+        print("kftop: self-check FAILED (view schema/round-trip mismatch)",
+              file=sys.stderr)
+        return 1
+    print("kftop: self-check ok (canned /cluster round-trip)")
+    return 0
+
+
+# -- CLI -------------------------------------------------------------------
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--self-check" in argv:
+        return self_check()
+    p = argparse.ArgumentParser(
+        prog="kftop",
+        description="live kungfu-tpu cluster view (config server /cluster)",
+    )
+    p.add_argument("-s", "--server", default=DEFAULT_SERVER,
+                   help=f"config server URL (default {DEFAULT_SERVER})")
+    p.add_argument("-n", "--interval", type=float, default=2.0,
+                   help="refresh period seconds (default 2)")
+    p.add_argument("--top", type=int, default=10,
+                   help="skew rows shown (default 10)")
+    p.add_argument("--once", action="store_true",
+                   help="render one frame and exit")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw /cluster JSON once and exit")
+    args = p.parse_args(argv)
+    if args.json or args.once:
+        try:
+            view = fetch_view(args.server)
+        except (OSError, ValueError) as e:
+            print(f"kftop: {args.server}: {e}", file=sys.stderr)
+            return 1
+        if args.json:
+            json.dump(view, sys.stdout, indent=2)
+            sys.stdout.write("\n")
+        else:
+            sys.stdout.write(render_view(view, top=args.top))
+        return 0
+    try:
+        while True:
+            try:
+                frame = render_view(fetch_view(args.server), top=args.top)
+            except (OSError, ValueError) as e:
+                frame = f"kftop: {args.server}: {e} (retrying)\n"
+            # clear + home, then the frame — a live refreshing view
+            sys.stdout.write("\x1b[2J\x1b[H" + frame)
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

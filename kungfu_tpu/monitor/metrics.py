@@ -142,8 +142,31 @@ class MetricsServer:
                     self.send_response(404)
                     self.end_headers()
                     return
-                text = mon.render_prometheus(extra_fn() if extra_fn else None)
-                text += REGISTRY.render_prometheus()
+                # section isolation: a raised exception inside a user
+                # extra_fn (or a render bug in one section) must not 500
+                # the whole scrape — Prometheus would mark the target
+                # down and every OTHER healthy series would vanish with
+                # it.  Render what renders; report the rest as comment
+                # lines (legal exposition-format noise).
+                errors: list = []
+                extra = None
+                if extra_fn is not None:
+                    try:
+                        extra = extra_fn()
+                    except Exception as e:  # noqa: BLE001 - user callback
+                        errors.append(f"extra_fn: {type(e).__name__}: {e}")
+                try:
+                    text = mon.render_prometheus(extra)
+                except Exception as e:  # noqa: BLE001
+                    text = ""
+                    errors.append(f"netmonitor: {type(e).__name__}: {e}")
+                try:
+                    text += REGISTRY.render_prometheus()
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"registry: {type(e).__name__}: {e}")
+                for err in errors:
+                    _log.warning("metrics scrape section failed: %s", err)
+                    text += "# error: " + err.replace("\n", " ") + "\n"
                 body = text.encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain; version=0.0.4")

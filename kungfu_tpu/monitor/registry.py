@@ -154,11 +154,45 @@ class Histogram:
             return out
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus exposition-format escaping: one odd label value (a
+    quote or newline in a user-supplied op name) must not invalidate
+    the whole scrape.  Well-formed values render byte-identically."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 def _label_str(labels: Dict[str, str]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    inner = ",".join(f'{k}="{_escape_label_value(str(labels[k]))}"'
+                     for k in sorted(labels))
     return "{" + inner + "}"
+
+
+#: ``# HELP`` text per metric family.  Stock Prometheus scrapers accept
+#: samples without metadata, but exposition-format validators (and every
+#: dashboard's tooltip) want the HELP/TYPE header — new metrics get a
+#: generic line until someone writes a better one.
+METRIC_HELP: Dict[str, str] = {
+    "kf_collective_latency_seconds":
+        "collective duration by plane (host engine / device) and op",
+    "kf_engine_collectives_total":
+        "engine collectives started (any op)",
+    "kf_engine_retries_total":
+        "engine send retries after transient wire faults",
+    "kf_peer_faults_total":
+        "per-peer deadline exhaustions raised as PeerFailureError",
+    "kf_chaos_injections_total": "chaos faults injected, by clause kind",
+    "kf_detector_down_total": "failure-detector down verdicts",
+    "kf_shrink_events_total": "shrink-to-survivors phase events, by phase",
+    "kf_timeline_dropped_total": "flight-recorder ring evictions",
+    "kf_net_egress_bytes":
+        "aggregate egress bytes (mirrored from NetMonitor)",
+    "kf_net_ingress_bytes":
+        "aggregate ingress bytes (mirrored from NetMonitor)",
+    "kf_cluster_control_events_total":
+        "control events (shrink/resize/...) received by the aggregator",
+}
 
 
 class MetricsRegistry:
@@ -207,16 +241,30 @@ class MetricsRegistry:
         return out
 
     def render_prometheus(self) -> str:
+        """Prometheus exposition text: per metric *family* one ``# HELP``
+        + ``# TYPE`` header (label variants sort together, so the header
+        lands once), then the samples — whose names and label encoding
+        are byte-identical to the pre-HELP/TYPE rendering, so existing
+        scrape configs and dashboards keep matching."""
         with self._lock:
             items = sorted(self._metrics.items(), key=lambda kv: kv[0])
         lines: List[str] = []
+        last_family = None
         for (name, labels), m in items:
             ld = dict(labels)
+            if name != last_family:
+                kind = ("counter" if isinstance(m, Counter)
+                        else "gauge" if isinstance(m, Gauge)
+                        else "histogram")
+                lines.append(f"# HELP {name} "
+                             f"{METRIC_HELP.get(name, 'kungfu-tpu metric')}")
+                lines.append(f"# TYPE {name} {kind}")
+                last_family = name
             if isinstance(m, Counter):
                 lines.append(f"{name}{_label_str(ld)} {m.value}")
             elif isinstance(m, Gauge):
                 lines.append(f"{name}{_label_str(ld)} {m.value:.6g}")
-            else:  # Histogram
+            else:  # Histogram: the _bucket/_sum/_count encoding
                 for le, cum in m.bucket_counts():
                     le_s = "+Inf" if le == float("inf") else f"{le:g}"
                     bl = dict(ld, le=le_s)

@@ -42,7 +42,7 @@ import json
 import os
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from kungfu_tpu.monitor.registry import REGISTRY
 from kungfu_tpu.utils.log import get_logger
@@ -115,6 +115,17 @@ def set_step(step: int) -> None:
     """Current training step, stamped on subsequent events."""
     global _step
     _step = step
+
+
+def current_step() -> int:
+    """The step last stamped by :func:`set_step` (``-1`` before the
+    first) — the live plane's reporter reads it for its snapshot."""
+    return _step
+
+
+def current_rank() -> Optional[int]:
+    """The process-default rank installed by :func:`set_rank`."""
+    return _rank
 
 
 def _capacity() -> int:
@@ -233,6 +244,29 @@ def snapshot() -> List[Dict]:
     with _lock:
         evs = list(_ring)
     return [
+        {"ts": ts, "rank": r, "step": s, "kind": k, "name": n, "dur": d,
+         "attrs": a or {}}
+        for ts, r, s, k, n, d, a in evs
+    ]
+
+
+def events_tail(since: int, kinds: Optional[frozenset] = None
+                ) -> Tuple[int, List[Dict]]:
+    """``(cursor, events)``: every event appended after the ``since``
+    cursor (0 = beginning of time), optionally kind-filtered, oldest
+    first.  The cursor is the cumulative append count (evicted + live),
+    so the cluster reporter's incremental read costs O(new events) per
+    push and never re-sends or misses one — a timestamp filter would
+    miss long spans, which are appended at exit carrying their *start*
+    time.  Events evicted before the caller returned are simply gone
+    (flight-recorder semantics; the drop counter says how many)."""
+    with _lock:
+        total = _dropped + len(_ring)
+        start = max(0, since - _dropped)
+        evs = list(_ring)[start:] if start < len(_ring) else []
+    if kinds is not None:
+        evs = [e for e in evs if e[3] in kinds]
+    return total, [
         {"ts": ts, "rank": r, "step": s, "kind": k, "name": n, "dur": d,
          "attrs": a or {}}
         for ts, r, s, k, n, d, a in evs

@@ -68,6 +68,8 @@ class Peer:
         self._ctrl_store = VersionedStore(window=8)
         self.net_monitor = None
         self._metrics_server = None
+        #: live-plane snapshot pusher (KF_CONFIG_ENABLE_CLUSTER_MONITOR)
+        self._reporter = None
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> None:
@@ -142,7 +144,43 @@ class Peer:
 
             timeline.set_rank(None if self.detached or self.standby
                               else self.rank())
+            # live cluster plane: push snapshots to the aggregator
+            # co-hosted with the config server (kfrun -monitor).  The
+            # reporter's identity is the STABLE bootstrap rank, matching
+            # the flight recorder's per-process tracks — a shrink must
+            # not make a promoted survivor alias a dead rank's row.
+            if (envs.parse_bool_env(envs.ENABLE_CLUSTER_MONITOR)
+                    and self.config.config_server):
+                rank = self.chaos_rank()
+                if rank is None and not (self.detached or self.standby):
+                    rank = self.rank()
+                if rank is not None:
+                    from kungfu_tpu.monitor.aggregator import RankReporter
+
+                    self._reporter = RankReporter(
+                        rank, self.config.config_server,
+                        strategy_fn=self._active_strategy,
+                        net_totals_fn=(self._net_totals
+                                       if monitor is not None else None),
+                    ).start()
             log_event("peer-started")
+
+    def _active_strategy(self) -> str:
+        """The host-engine strategy currently in force (swaps via
+        set_strategy/adaptation included) — stamped on live snapshots."""
+        engine = self._engine
+        s = engine.strategy if engine is not None else self.config.strategy
+        return getattr(s, "name", str(s))
+
+    def _net_totals(self) -> dict:
+        mon = self.net_monitor
+        if mon is None:
+            return {}
+        totals = mon.totals()
+        return {
+            "egress_bytes": sum(totals["egress"].values()),
+            "ingress_bytes": sum(totals["ingress"].values()),
+        }
 
     def _init_jax_distributed(self) -> None:
         """Bring up the jax.distributed world ONCE per process.
@@ -264,6 +302,11 @@ class Peer:
         from kungfu_tpu.monitor import timeline
 
         timeline.maybe_dump()
+        if self._reporter is not None:
+            # final push BEFORE channels tear down: a clean shutdown
+            # leaves fresh numbers on the aggregator, not a stale flag
+            self._reporter.stop(final_push=True)
+            self._reporter = None
         with self._lock:
             if self._channel is not None:
                 self._notify_done()
@@ -549,7 +592,15 @@ class Peer:
                             new_procs, self._jax_world_procs,
                         )
             log_event(f"cluster-resized-v{version}-n{new_cluster.size()}")
-            return True
+        # control event for the live plane (best-effort, outside the
+        # lock): rank 0 of the NEW membership announces the resize so
+        # kftop's cluster-health line flips with the epoch
+        if new_cluster.workers.rank(self.config.self_id) == 0:
+            from kungfu_tpu.monitor.aggregator import post_control_if_enabled
+
+            post_control_if_enabled(self, "resize", version=version,
+                                    size=new_cluster.size())
+        return True
 
     def _notify_done(self) -> None:
         """Tell every runner the job completed cleanly (rank 0, on close).

@@ -92,6 +92,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "e.g. 'die:step=5,rank=1' kills rank 1 at step 5)")
     p.add_argument("-chaos-seed", dest="chaos_seed", type=int, default=None,
                    help="KF_CHAOS_SEED for the workers (delay jitter)")
+    p.add_argument("-monitor", dest="monitor", action="store_true",
+                   help="live cluster observability plane: mount the "
+                        "aggregator on the (builtin) config server, make "
+                        "every worker push snapshots "
+                        "(KF_CONFIG_ENABLE_CLUSTER_MONITOR), and enable "
+                        "tracing + the network monitor so snapshots carry "
+                        "collective spans and byte rates.  View with "
+                        "scripts/kftop; starts an ephemeral builtin config "
+                        "server when none is configured")
+    p.add_argument("-monitor-interval", dest="monitor_interval", type=float,
+                   default=0.0,
+                   help="snapshot push period seconds "
+                        "(KF_CONFIG_MONITOR_PUSH_PERIOD; default 1)")
     p.add_argument("-trace", dest="trace", action="store_true",
                    help="enable scoped tracing + the flight-recorder "
                         "timeline in every worker (KF_CONFIG_ENABLE_TRACE)")
@@ -245,13 +258,40 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     config_server_url = ns.config_server
     builtin = None
-    if ns.builtin_config_port:
+    if ns.builtin_config_port or (ns.monitor and not config_server_url):
         from kungfu_tpu.elastic.configserver import ConfigServer
 
-        builtin = ConfigServer(port=ns.builtin_config_port, cluster=cluster)
+        aggregator = None
+        if ns.monitor:
+            from kungfu_tpu.monitor.aggregator import (
+                MIN_PUSH_PERIOD_S,
+                STALE_PERIODS,
+                ClusterAggregator,
+            )
+
+            if ns.monitor_interval > 0:
+                # same floor the workers apply to the env value — a
+                # below-floor interval must not give the aggregator a
+                # tighter staleness clock than any worker can satisfy
+                # (every healthy rank would render permanently STALE)
+                ns.monitor_interval = max(ns.monitor_interval,
+                                          MIN_PUSH_PERIOD_S)
+            aggregator = ClusterAggregator(
+                stale_after=(STALE_PERIODS * ns.monitor_interval
+                             if ns.monitor_interval > 0 else None))
+        # -monitor with no config server still needs a push target: an
+        # ephemeral builtin server carries the aggregator (port 0 = OS-
+        # assigned, reflected in builtin.port)
+        builtin = ConfigServer(port=ns.builtin_config_port, cluster=cluster,
+                               aggregator=aggregator)
         builtin.start()
-        config_server_url = f"http://127.0.0.1:{ns.builtin_config_port}/get"
+        config_server_url = f"http://127.0.0.1:{builtin.port}/get"
         _log.info("builtin config server at %s", config_server_url)
+    elif ns.monitor:
+        _log.info(
+            "-monitor with an external config server: run it with "
+            "`kf-config-server -monitor` so /push and /cluster exist there"
+        )
 
     world = None
     if ns.device_world:
@@ -268,6 +308,25 @@ def main(argv: Optional[List[str]] = None) -> int:
             "the config server)"
         )
     chaos_envs = {}
+    if ns.monitor:
+        from kungfu_tpu.monitor.aggregator import (
+            PUSH_PERIOD_ENV,
+            server_base,
+        )
+        from kungfu_tpu.utils.envs import (
+            ENABLE_CLUSTER_MONITOR,
+            ENABLE_MONITORING,
+        )
+
+        chaos_envs[ENABLE_CLUSTER_MONITOR] = "1"
+        # byte rates for the snapshots; the net monitor is cheap
+        chaos_envs[ENABLE_MONITORING] = "true"
+        # online skew feeds on flight-recorder spans
+        ns.trace = True
+        if ns.monitor_interval > 0:
+            chaos_envs[PUSH_PERIOD_ENV] = str(ns.monitor_interval)
+        _log.info("live cluster view: scripts/kftop --server %s",
+                  server_base(config_server_url))
     if ns.trace or ns.trace_dump:
         from kungfu_tpu.monitor.timeline import DUMP_ENV
         from kungfu_tpu.utils.trace import ENABLE_TRACE

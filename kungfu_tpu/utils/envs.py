@@ -70,6 +70,19 @@ Tuning envs (read anywhere, any time):
                                    events, default 65536; evictions are
                                    counted in kf_timeline_dropped_total
                                    (monitor/timeline.py)
+``KF_CONFIG_ENABLE_CLUSTER_MONITOR`` truthy: each worker pushes live
+                                   snapshots (step, counters, latency
+                                   deltas, recent collective spans) to
+                                   the cluster aggregator co-hosted with
+                                   the config server; view with
+                                   ``kftop`` (monitor/aggregator.py)
+``KF_CONFIG_MONITOR_PUSH_PERIOD``  snapshot push interval seconds,
+                                   default 1 (monitor/aggregator.py)
+``KF_CONFIG_MONITOR_STALE_AFTER``  seconds without a snapshot before the
+                                   aggregator flags a rank *stale*;
+                                   default 3x the push period — well
+                                   inside the failure detector's 10 s
+                                   down verdict (monitor/aggregator.py)
 ``KF_CONFIG_P2P_RESPONDERS``       p2p blob responder pool size,
                                    default 2 (store/p2p.py)
 ``KF_CONFIG_USE_AFFINITY``         truthy: partition host cores between
@@ -191,6 +204,12 @@ PEER_DEADLINE = "KF_CONFIG_PEER_DEADLINE"
 # the env-contract scan anchors them like every other KF_* knob)
 TRACE_DUMP = "KF_CONFIG_TRACE_DUMP"
 TIMELINE_CAP = "KF_CONFIG_TIMELINE_CAP"
+
+# live cluster-monitor envs (monitor/aggregator.py: per-rank snapshot
+# pushes to the aggregator co-hosted with the config server)
+ENABLE_CLUSTER_MONITOR = "KF_CONFIG_ENABLE_CLUSTER_MONITOR"
+MONITOR_PUSH_PERIOD = "KF_CONFIG_MONITOR_PUSH_PERIOD"
+MONITOR_STALE_AFTER = "KF_CONFIG_MONITOR_STALE_AFTER"
 
 # fault-injection envs (read by kungfu_tpu/chaos/inject.py at controller
 # creation; registered here so the env-contract scan anchors them to the

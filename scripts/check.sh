@@ -4,16 +4,19 @@
 #
 #   scripts/check.sh
 #
-# 1. kflint        — all nine project-invariant checkers, including the
-#                    kf-verify interprocedural rules and trace-vocab
-#                    (docs/lint.md).  Findings fingerprinted in
-#                    tests/lint_baseline.json are suppressed (legacy
+# 1. kflint        — all ten project-invariant checkers, including the
+#                    kf-verify interprocedural rules, trace-vocab, and
+#                    agg-schema (docs/lint.md).  Findings fingerprinted
+#                    in tests/lint_baseline.json are suppressed (legacy
 #                    debt being ratcheted down); anything NOT in the
 #                    baseline fails the gate.
 # 2. kftrace       — flight-recorder dump schema self-check (recorder
 #                    and reader must agree byte-for-byte, docs/tracing.md)
-# 3. compileall    — every .py parses/compiles on this interpreter
-# 4. flag stamps   — no sanitizer flags leaked into the production
+# 3. kftop         — live-plane /cluster schema self-check (push wire
+#                    format, view schema, and renderer must agree,
+#                    docs/monitoring.md)
+# 4. compileall    — every .py parses/compiles on this interpreter
+# 5. flag stamps   — no sanitizer flags leaked into the production
 #                    .buildflags stamp (variants must never mix)
 set -euo pipefail
 
@@ -32,6 +35,11 @@ fi
 
 echo "== kftrace self-check (dump schema round-trip)"
 if ! python3 scripts/kftrace --self-check; then
+    fail=1
+fi
+
+echo "== kftop self-check (/cluster schema round-trip)"
+if ! python3 scripts/kftop --self-check; then
     fail=1
 fi
 

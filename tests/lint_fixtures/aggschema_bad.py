@@ -1,0 +1,57 @@
+"""agg-schema fixture: typo'd / dynamic snapshot+view field names."""
+
+from kungfu_tpu.monitor import aggregator
+from kungfu_tpu.monitor.aggregator import field as fld, make_snapshot
+
+
+def good_reads(view):
+    step = aggregator.field(view, "step")  # in schema: clean
+    return step, fld(view, "straggler")  # through the alias: clean
+
+
+def typo_read(view):
+    return aggregator.field(view, "stragler")  # typo: flagged
+
+
+def dynamic_read(view, k):
+    return fld(view, k)  # dynamic: flagged
+
+
+def no_name(view):
+    return aggregator.field(view)  # missing name: flagged
+
+
+def good_snapshot():
+    return make_snapshot(rank=0, step=3)  # literal schema fields: clean
+
+
+def typo_snapshot():
+    return make_snapshot(rank=0, stepp=3)  # typo'd field: flagged
+
+
+def splat_snapshot(extra):
+    return make_snapshot(rank=0, **extra)  # dynamic splat: flagged
+
+
+def waived(view, k):
+    return aggregator.field(view, k)  # kflint: allow(agg-schema)
+
+
+class Unrelated:
+    def field(self, *a):
+        return self
+
+    def make_snapshot(self, *a):
+        return self
+
+
+def not_the_aggregator():
+    u = Unrelated()
+    u.field("whatever")  # other receiver: NOT flagged
+    u.make_snapshot(bogus=1)
+
+
+def view_only_snapshot():
+    # "stale" is a VIEW field — field() may read it, but make_snapshot()
+    # rejects it at runtime, so lint must too: flagged
+    return make_snapshot(rank=0, stale=True)

@@ -1,0 +1,509 @@
+"""Live cluster plane tests: snapshot schema, aggregator staleness +
+online skew, the config-server mounting, ``kftop``, and the offline
+(kftrace) vs online (aggregator) skew agreement."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kungfu_tpu.monitor import timeline, traceview
+from kungfu_tpu.monitor.aggregator import (
+    ClusterAggregator,
+    RankReporter,
+    SNAPSHOT_FIELDS,
+    VIEW_FIELDS,
+    control_event,
+    field,
+    make_snapshot,
+    post_control,
+    push_period_from_env,
+    server_base,
+    stale_after_from_env,
+)
+from kungfu_tpu.monitor.registry import REGISTRY
+from kungfu_tpu.utils import trace
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _span(rank, step, dur, tag, ts=None):
+    return {"ts": time.time() if ts is None else ts, "rank": rank,
+            "step": step, "kind": "collective", "name": "engine.all_reduce",
+            "dur": dur, "attrs": {"op": "all_reduce", "tag": tag}}
+
+
+class TestSchema:
+    def test_make_snapshot_rejects_unknown_field(self):
+        with pytest.raises(ValueError, match="stepp"):
+            make_snapshot(rank=0, stepp=1)
+
+    def test_make_snapshot_stamps_wire_version(self):
+        snap = make_snapshot(rank=3, step=7)
+        assert snap["kfmon"] == 1
+        assert field(snap, "rank") == 3 and field(snap, "step") == 7
+
+    def test_view_fields_cover_snapshot_row_fields(self):
+        # every per-rank row field kftop renders must be declared
+        assert {"rank", "step", "step_time_s", "age_s", "counters",
+                "net", "strategy"} <= VIEW_FIELDS
+        assert "events" in SNAPSHOT_FIELDS  # the skew feedstock
+
+    def test_server_base(self):
+        assert server_base("http://h:9100/get") == "http://h:9100"
+        assert server_base("http://h:9100") == "http://h:9100"
+        assert server_base("h:9100") == "http://h:9100"
+
+    def test_env_defaults(self, monkeypatch):
+        monkeypatch.delenv("KF_CONFIG_MONITOR_PUSH_PERIOD", raising=False)
+        monkeypatch.delenv("KF_CONFIG_MONITOR_STALE_AFTER", raising=False)
+        assert push_period_from_env() == 1.0
+        assert stale_after_from_env() == 3.0
+        monkeypatch.setenv("KF_CONFIG_MONITOR_PUSH_PERIOD", "0.5")
+        assert push_period_from_env() == 0.5
+        assert stale_after_from_env() == 1.5  # 3 push periods
+        monkeypatch.setenv("KF_CONFIG_MONITOR_STALE_AFTER", "7")
+        assert stale_after_from_env() == 7.0  # absolute override wins
+
+
+class TestSkewDeterminism:
+    def test_tie_breaks_independent_of_event_order(self):
+        """The shared-math guarantee would be vacuous if arrival order
+        (offline: time-sorted; online: push order) could flip a tie."""
+        import itertools
+
+        from kungfu_tpu.monitor import skew as skewlib
+
+        evs = [_span(r, 1, 0.01 if r < 2 else 0.1, "g") for r in range(3)]
+        rows0 = skewlib.skew_rows(evs)
+        assert rows0[0]["fastest_rank"] == 0  # tie with rank 1 → lowest
+        for perm in itertools.permutations(evs):
+            assert skewlib.skew_rows(list(perm)) == rows0
+            assert skewlib.straggler_verdict(list(perm)) == 2
+
+
+class TestAggregator:
+    def _agg(self, stale_after=1.0):
+        clock = [1000.0]
+        agg = ClusterAggregator(stale_after=stale_after,
+                                time_fn=lambda: clock[0])
+        return agg, clock
+
+    def test_ingest_rejects_garbage(self):
+        agg, _ = self._agg()
+        with pytest.raises(ValueError):
+            agg.ingest({"hello": 1})
+        with pytest.raises(ValueError):
+            agg.ingest(make_snapshot(step=1))  # no rank
+        with pytest.raises(ValueError):
+            agg.ingest({"kfmon": 1, "rank": 0, "bogus_field": 1})
+
+    def test_staleness_clock(self):
+        agg, clock = self._agg(stale_after=1.0)
+        agg.ingest(make_snapshot(rank=0, step=1))
+        agg.ingest(make_snapshot(rank=1, step=1))
+        assert agg.stale_ranks() == []
+        clock[0] += 0.5
+        agg.ingest(make_snapshot(rank=0, step=2))  # rank 0 refreshes
+        clock[0] += 0.7                            # rank 1 now 1.2s old
+        assert agg.stale_ranks() == [1]
+        view = agg.cluster_view()
+        assert field(view, "stale") == [1]
+        rows = {field(r, "rank"): r for r in field(view, "ranks")}
+        assert rows[1]["stale"] and not rows[0]["stale"]
+        assert rows[0]["step"] == 2
+
+    def test_online_skew_names_planted_rank(self):
+        agg, _ = self._agg()
+        for rank in range(3):
+            dur = 0.2 if rank == 2 else 0.02
+            agg.ingest(make_snapshot(
+                rank=rank, step=1, events=[_span(rank, 1, dur, "g1")]))
+        view = agg.cluster_view()
+        assert field(view, "straggler") == 2
+        row = field(view, "skew")[0]
+        assert field(row, "slowest_rank") == 2
+        assert field(row, "skew_s") == pytest.approx(0.18)
+
+    def test_rankless_events_get_stamped(self):
+        agg, _ = self._agg()
+        ev = _span(None, 1, 0.1, "g1")
+        agg.ingest(make_snapshot(rank=5, step=1, events=[ev]))
+        agg.ingest(make_snapshot(rank=6, step=1,
+                                 events=[_span(6, 1, 0.01, "g1")]))
+        assert field(agg.cluster_view(), "skew")[0]["slowest_rank"] == 5
+
+    def test_shrink_control_evicts_dead_rank_state(self):
+        """A dead rank's last spans must not feed the skew verdict
+        forever: the shrink control event (which names the dead set)
+        evicts its window and row."""
+        agg, _ = self._agg()
+        for rank in range(3):
+            dur = 0.2 if rank == 2 else 0.02
+            agg.ingest(make_snapshot(
+                rank=rank, step=1, events=[_span(rank, 1, dur, "g1")]))
+        assert field(agg.cluster_view(), "straggler") == 2
+        agg.ingest(control_event("shrink", rank=0, dead=[2], version=2))
+        view = agg.cluster_view()
+        assert 2 not in [field(r, "rank") for r in field(view, "ranks")]
+        assert field(view, "straggler") != 2
+        assert field(view, "stale") == []  # the dead rank can't sit stale
+
+    def test_control_events_and_quorum_margin(self):
+        agg, _ = self._agg()
+        agg.ingest(control_event("shrink", rank=0, dead=[3], version=9))
+        view = agg.cluster_view({"version": 9, "size": 5, "workers": []})
+        cluster = field(view, "cluster")
+        assert field(cluster, "quorum_margin") == 2  # 5 -> 3 still majority
+        assert field(field(cluster, "last_control"), "kind") == "shrink"
+        assert field(view, "controls")[-1]["attrs"]["dead"] == [3]
+
+    def test_prometheus_render(self):
+        agg, clock = self._agg(stale_after=1.0)
+        agg.ingest(make_snapshot(rank=0, step=4, step_time_s=0.5,
+                                 events=[_span(0, 4, 0.1, "g")]))
+        agg.ingest(make_snapshot(rank=1, step=4,
+                                 events=[_span(1, 4, 0.01, "g")]))
+        clock[0] += 2.0
+        text = agg.render_prometheus({"version": 7, "size": 2, "workers": []})
+        assert "kf_cluster_ranks 2" in text
+        assert "kf_cluster_stale_ranks 2" in text
+        assert "kf_cluster_config_version 7" in text
+        assert 'kf_cluster_rank_step{rank="0"} 4' in text
+        assert 'kf_cluster_skew_seconds{op="all_reduce",tag="g"}' in text
+        assert "# TYPE kf_cluster_ranks gauge" in text
+
+
+class TestReporter:
+    @pytest.fixture(autouse=True)
+    def _clean(self, monkeypatch):
+        monkeypatch.delenv(trace.ENABLE_TRACE, raising=False)
+        timeline.reset()
+        timeline.set_rank(None)
+        yield
+        timeline.reset()
+        timeline.set_rank(None)
+
+    def test_snapshot_contents_and_incremental_events(self):
+        rep = RankReporter(2, "http://127.0.0.1:1/get", period=0.1)
+        timeline.set_step(11)
+        with timeline.span("collective", "engine.all_reduce", rank=2,
+                           force=True, op="all_reduce", tag="t0"):
+            pass
+        snap = rep.snapshot_once()
+        assert field(snap, "rank") == 2 and field(snap, "step") == 11
+        evs = field(snap, "events")
+        assert [e["attrs"]["tag"] for e in evs] == ["t0"]
+        # a collective span also lands in the latency histogram deltas
+        assert any("kf_collective_latency_seconds" in k
+                   for k in field(snap, "latency"))
+        # second snapshot: cursor advanced, nothing re-sent
+        assert field(rep.snapshot_once(), "events") == []
+        timeline.event("mark", "not-reported", force=True)  # not a REPORT_KIND
+        timeline.event("chaos", "delay", rank=2, force=True)
+        evs = field(rep.snapshot_once(), "events")
+        assert [e["kind"] for e in evs] == ["chaos"]
+
+    def test_step_time_ema(self):
+        rep = RankReporter(0, "http://127.0.0.1:1", period=0.1)
+        now = 100.0
+        assert rep._step_time(5, now) is None        # first sight: no rate
+        assert rep._step_time(7, now + 1.0) == pytest.approx(0.5)
+        # EMA pulls toward the new 1.0 s/step sample
+        second = rep._step_time(8, now + 2.0)
+        assert 0.5 < second < 1.0
+
+    def test_push_failure_is_swallowed(self):
+        rep = RankReporter(0, "http://127.0.0.1:9/get", period=0.1)
+        assert rep.push_once() is False  # nothing listening: no raise
+
+    def test_failed_push_carries_window_to_next_snapshot(self):
+        """Collection advances the cursor/delta baselines, so an
+        undelivered window must ride along to the next push — a config-
+        server blip during an incident must not hole the skew window."""
+        rep = RankReporter(0, "http://127.0.0.1:9/get", period=0.1)
+        with timeline.span("collective", "engine.all_reduce", rank=0,
+                           force=True, op="all_reduce", tag="carried"):
+            pass
+        assert rep.push_once() is False  # nothing listening
+        snap = rep.snapshot_once()
+        assert [e["attrs"]["tag"] for e in field(snap, "events")] \
+            == ["carried"]
+        assert any("kf_collective_latency_seconds" in k
+                   for k in field(snap, "latency"))
+
+
+@pytest.fixture
+def live_cluster():
+    """ConfigServer + aggregator on an ephemeral port, with a stored
+    3-worker cluster — the co-hosting layout `kfrun -monitor` builds."""
+    from kungfu_tpu.elastic.configserver import ConfigServer
+    from kungfu_tpu.plan import Cluster, PeerList
+
+    workers = PeerList.parse("127.0.0.1:27411,127.0.0.1:27412,127.0.0.1:27413")
+    cluster = Cluster(PeerList.parse("127.0.0.1:38091"), workers)
+    agg = ClusterAggregator(stale_after=0.45)
+    srv = ConfigServer(port=0, cluster=cluster, aggregator=agg).start()
+    yield srv, agg, f"http://127.0.0.1:{srv.port}/get"
+    srv.stop()
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return json.loads(resp.read().decode())
+
+
+class TestLiveCluster:
+    """The acceptance path: a 3-rank in-process cluster with a planted
+    slow rank, observed online through ``/cluster``."""
+
+    PERIOD = 0.15
+
+    def _reporters(self, url, events):
+        return [
+            RankReporter(r, url, period=self.PERIOD,
+                         events_fn=lambda r=r: events.pop(r, []))
+            for r in range(3)
+        ]
+
+    def _planted_events(self):
+        """Rank 2 is ~10x slower on every tag; distinct skews per tag so
+        row order is deterministic for the offline/online comparison."""
+        events = {}
+        for rank in range(3):
+            evs = []
+            for step in range(3):
+                dur = (0.10 + 0.01 * step) if rank == 2 else 0.01
+                evs.append(_span(rank, step, dur, f"grad{step}",
+                                 ts=100.0 + step + 0.01 * rank))
+            events[rank] = evs
+        return events
+
+    def test_cluster_names_slow_rank_within_push_interval(self, live_cluster):
+        srv, _, url = live_cluster
+        events = self._planted_events()
+        offline = [list(v) for v in events.values()]  # copy before pop
+        reps = self._reporters(url, events)
+        for rp in reps:
+            rp.start()
+        try:
+            deadline = time.time() + 10 * self.PERIOD
+            view = None
+            while time.time() < deadline:
+                view = _get_json(f"http://127.0.0.1:{srv.port}/cluster")
+                if len(field(view, "skew")) >= 3:
+                    break
+                time.sleep(self.PERIOD / 3)
+            assert view is not None and len(field(view, "skew")) >= 3
+            assert field(view, "straggler") == 2
+            for row in field(view, "skew"):
+                assert field(row, "slowest_rank") == 2
+            # per-step windows also finger rank 2
+            for w in field(view, "slowest_per_step"):
+                assert w["slowest_rank"] == 2
+            # cluster health from the co-hosted config store
+            cluster = field(view, "cluster")
+            assert field(cluster, "size") == 3
+            assert field(cluster, "quorum_margin") == 1
+
+            # -- offline/online agreement: kftrace over dumps of the SAME
+            # events must produce byte-identical skew rows (shared
+            # monitor/skew.py math is the guarantee under test)
+            import tempfile
+
+            dumps = []
+            with tempfile.TemporaryDirectory() as td:
+                for rank, evs in enumerate(offline):
+                    p = os.path.join(td, f"trace-r{rank}.jsonl")
+                    with open(p, "w") as f:
+                        f.write(json.dumps(
+                            {"kftrace": 1, "rank": rank, "pid": rank,
+                             "dropped": 0, "wall": 0.0}) + "\n")
+                        for ev in evs:
+                            f.write(json.dumps(ev) + "\n")
+                    dumps.append(p)
+                offline_rows = traceview.skew_rows(traceview.load_all(dumps))
+            assert offline_rows == field(view, "skew")
+        finally:
+            for rp in reps:
+                rp.stop()
+
+    def test_dead_rank_goes_stale_before_detector_window(self, live_cluster):
+        """A rank whose pushes stop (the observable effect of a chaos
+        ``die`` on that process) flips to *stale* on the aggregator's
+        clock — which sits far inside the failure detector's 10 s down
+        verdict, so kftop shows the problem first."""
+        from kungfu_tpu.monitor.detector import DEFAULT_STALL_TIMEOUT_S
+
+        srv, agg, url = live_cluster
+        assert agg.stale_after < DEFAULT_STALL_TIMEOUT_S / 10
+        reps = self._reporters(url, {})
+        for rp in reps:
+            rp.start()
+        try:
+            time.sleep(2.5 * self.PERIOD)
+            view = _get_json(f"http://127.0.0.1:{srv.port}/cluster")
+            assert field(view, "stale") == []
+            reps[1].stop()  # rank 1 "dies": its snapshots stop arriving
+            killed = time.time()
+            deadline = killed + 2 * agg.stale_after + 1.0
+            stale = []
+            while time.time() < deadline:
+                view = _get_json(f"http://127.0.0.1:{srv.port}/cluster")
+                stale = field(view, "stale")
+                if stale:
+                    break
+                time.sleep(0.05)
+            assert stale == [1]
+            # flagged well before a detector could have ruled it down
+            assert time.time() - killed < DEFAULT_STALL_TIMEOUT_S
+        finally:
+            for rp in reps:
+                rp.stop()
+
+    def test_control_event_round_trip(self, live_cluster):
+        srv, _, url = live_cluster
+        assert post_control(url, "resize", rank=0, version=4, size=2)
+        view = _get_json(f"http://127.0.0.1:{srv.port}/cluster")
+        last = field(field(view, "cluster"), "last_control")
+        assert field(last, "kind") == "resize"
+        assert field(last, "attrs") == {"version": 4, "size": 2}
+
+    def test_metrics_endpoint_merged(self, live_cluster):
+        srv, _, url = live_cluster
+        rep = RankReporter(0, url, period=self.PERIOD)
+        rep.push_once()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=5) as resp:
+            text = resp.read().decode()
+        assert "kf_cluster_ranks 1" in text
+        assert "kf_cluster_config_version 0" in text
+
+    def test_push_rejects_malformed(self, live_cluster):
+        srv, _, _ = live_cluster
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/push", data=b'{"bogus": 1}',
+            method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 400
+
+    def test_post_control_if_enabled_gates_on_env(self, monkeypatch,
+                                                  live_cluster):
+        from kungfu_tpu.monitor.aggregator import post_control_if_enabled
+
+        srv, agg, url = live_cluster
+
+        class ShimConfig:
+            config_server = url
+
+        class ShimPeer:
+            config = ShimConfig()
+
+            @staticmethod
+            def chaos_rank():
+                return 0
+
+        monkeypatch.delenv("KF_CONFIG_ENABLE_CLUSTER_MONITOR", raising=False)
+        assert post_control_if_enabled(ShimPeer, "resize", version=1) is False
+        monkeypatch.setenv("KF_CONFIG_ENABLE_CLUSTER_MONITOR", "1")
+        assert post_control_if_enabled(ShimPeer, "resize", version=1) is True
+        view = _get_json(f"http://127.0.0.1:{srv.port}/cluster")
+        assert field(field(field(view, "cluster"), "last_control"),
+                     "kind") == "resize"
+
+    def test_config_routes_still_work(self, live_cluster):
+        srv, _, _ = live_cluster
+        got = _get_json(f"http://127.0.0.1:{srv.port}/get")
+        assert got["version"] == 0 and "cluster" in got
+
+
+class TestKftop:
+    def test_render_view_marks_stale_and_skew(self):
+        from kungfu_tpu.monitor.kftop import render_view
+
+        clock = [50.0]
+        agg = ClusterAggregator(stale_after=1.0, time_fn=lambda: clock[0])
+        agg.ingest(make_snapshot(
+            rank=0, step=9, step_time_s=0.3,
+            counters={"kf_engine_retries_total": 4},
+            events=[_span(0, 9, 0.2, "g9", ts=49.0)],
+            net={"egress_bytes": 5 << 20, "ingress_bytes": 0},
+            strategy="RING"))
+        agg.ingest(make_snapshot(rank=1, step=9,
+                                 events=[_span(1, 9, 0.01, "g9", ts=49.0)]))
+        clock[0] += 0.5
+        # pushes are complete snapshots — the latest one replaces the row
+        agg.ingest(make_snapshot(
+            rank=0, step=10, step_time_s=0.3,
+            counters={"kf_engine_retries_total": 4},
+            net={"egress_bytes": 5 << 20, "ingress_bytes": 0},
+            strategy="RING"))
+        clock[0] += 0.7  # rank 1 now stale
+        text = render_view(agg.cluster_view({"version": 3, "size": 2,
+                                             "workers": []}))
+        assert "STALE" in text and "straggler: rank 0" in text
+        assert "all_reduce/g9" in text
+        assert "cluster v3" in text
+        assert "RING" in text and "5.0MiB" in text
+
+    def test_json_mode_against_live_server(self, live_cluster, capsys):
+        from kungfu_tpu.monitor.kftop import main
+
+        srv, _, url = live_cluster
+        RankReporter(0, url, period=0.1).push_once()
+        assert main(["--json", "--server", url]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert [field(r, "rank") for r in field(out, "ranks")] == [0]
+        assert main(["--once", "--server", url]) == 0
+        assert "kfmon @" in capsys.readouterr().out
+
+    def test_unreachable_server_exits_nonzero(self, capsys):
+        from kungfu_tpu.monitor.kftop import main
+
+        assert main(["--json", "--server", "http://127.0.0.1:9/get"]) == 1
+
+    def test_script_self_check(self):
+        rc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "scripts", "kftop"),
+             "--self-check"],
+            capture_output=True, timeout=60,
+        )
+        assert rc.returncode == 0, rc.stdout.decode() + rc.stderr.decode()
+
+
+class TestPeerWiring:
+    def test_peer_starts_and_stops_reporter(self, monkeypatch, live_cluster):
+        from kungfu_tpu.peer import Peer
+        from kungfu_tpu.plan import Cluster, PeerList
+        from kungfu_tpu.utils.envs import Config
+
+        srv, agg, url = live_cluster
+        monkeypatch.setenv("KF_CONFIG_ENABLE_CLUSTER_MONITOR", "1")
+        monkeypatch.setenv("KF_CONFIG_MONITOR_PUSH_PERIOD", "0.1")
+        workers = PeerList.parse("127.0.0.1:27421,127.0.0.1:27422")
+        cluster = Cluster(PeerList.parse("127.0.0.1:38092"), workers)
+        peers = [Peer(Config(self_id=w, cluster=cluster, config_server=url))
+                 for w in workers]
+        for p in peers:
+            p.start()
+        try:
+            assert all(p._reporter is not None for p in peers)
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                view = _get_json(f"http://127.0.0.1:{srv.port}/cluster")
+                if len(field(view, "ranks")) == 2:
+                    break
+                time.sleep(0.05)
+            assert [field(r, "rank") for r in field(view, "ranks")] == [0, 1]
+            # the engine strategy lands on the snapshot
+            assert all(field(r, "strategy") for r in field(view, "ranks"))
+        finally:
+            for p in peers:
+                p.close()
+        assert all(p._reporter is None for p in peers)

@@ -10,6 +10,7 @@ import subprocess
 import sys
 
 from kungfu_tpu.analysis import (
+    aggschema,
     blockingio,
     collectives,
     envcheck,
@@ -361,6 +362,69 @@ class TestTraceVocab:
     def test_no_timeline_module_is_silent(self, tmp_path):
         root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": "tracevocab_bad.py"})
         assert tracevocab.check(root) == []
+
+
+MINI_AGGREGATOR = (
+    "SNAPSHOT_FIELDS = frozenset({\n"
+    '    "kfmon", "rank", "step", "counters", "events",\n'
+    "})\n"
+    "VIEW_FIELDS = frozenset({\n"
+    '    "ranks", "stale", "skew", "straggler",\n'
+    "})\n"
+)
+
+
+class TestAggSchema:
+    """The live-plane sibling of trace-vocab: aggregator.field() names
+    and make_snapshot() keywords must be literals from the declared
+    SNAPSHOT_FIELDS/VIEW_FIELDS schema — a typo'd field silently empties
+    a kftop column instead of erroring."""
+
+    def _tree(self, tmp_path):
+        return _tmp_tree(tmp_path, {
+            "kungfu_tpu/monitor/aggregator.py": MINI_AGGREGATOR,
+            "kungfu_tpu/mod.py": "aggschema_bad.py",
+        })
+
+    def test_fixture_violations_caught(self, tmp_path):
+        got = sorted((v.line, v.message)
+                     for v in aggschema.check(self._tree(tmp_path)))
+        assert [line for line, _ in got] == [13, 17, 21, 29, 33, 57], got
+        assert "'stragler'" in got[0][1]
+        assert "must be a string literal" in got[1][1]
+        assert "without a field name" in got[2][1]
+        assert "'stepp'" in got[3][1]
+        assert "**dynamic" in got[4][1]
+        # a VIEW-only field in make_snapshot raises at runtime, so lint
+        # must flag it too (the union is only valid for field() reads)
+        assert "'stale'" in got[5][1]
+
+    def test_suppression_honored(self, tmp_path):
+        flagged = {v.line for v in aggschema.check(self._tree(tmp_path))}
+        assert 37 not in flagged, flagged  # the waived dynamic read
+
+    def test_unrelated_receivers_not_flagged(self, tmp_path):
+        flagged = {v.line for v in aggschema.check(self._tree(tmp_path))}
+        assert 51 not in flagged and 52 not in flagged, flagged
+
+    def test_schema_parsed_from_real_tree(self):
+        from kungfu_tpu.analysis.aggschema import _schemas
+        from kungfu_tpu.monitor.aggregator import SNAPSHOT_FIELDS, VIEW_FIELDS
+
+        got = _schemas(ROOT)
+        assert got["SNAPSHOT_FIELDS"] == set(SNAPSHOT_FIELDS)
+        assert got["VIEW_FIELDS"] == set(VIEW_FIELDS)
+
+    def test_kftop_is_covered_and_clean(self):
+        # the viewer is the rule's main client: in scan scope, no findings
+        assert os.path.isfile(
+            os.path.join(ROOT, "kungfu_tpu", "monitor", "kftop.py"))
+        assert [v for v in aggschema.check(ROOT)
+                if "kftop" in v.path] == []
+
+    def test_no_aggregator_module_is_silent(self, tmp_path):
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": "aggschema_bad.py"})
+        assert aggschema.check(root) == []
 
 
 class TestBaselineAndJson:

@@ -45,6 +45,86 @@ class TestNetMonitor:
             m.stop()
 
 
+class TestHostNoiseScale:
+    """ops/monitor.py::host_noise_scale — the host-plane (engine) GNS
+    estimator: the n==1 no-signal contract, and agreement with the
+    in-graph ``global_noise_scale`` on identical inputs."""
+
+    def _engines(self, base_port, n):
+        from kungfu_tpu.comm.engine import CollectiveEngine
+        from kungfu_tpu.comm.host import HostChannel
+        from kungfu_tpu.plan import PeerID, PeerList
+        from kungfu_tpu.plan.strategy import Strategy
+
+        peers = PeerList.of(*(PeerID("127.0.0.1", base_port + i)
+                              for i in range(n)))
+        chans = [HostChannel(p, bind_host="127.0.0.1") for p in peers]
+        engines = [CollectiveEngine(c, peers, strategy=Strategy.STAR)
+                   for c in chans]
+        return chans, engines
+
+    def test_single_worker_reports_no_signal(self):
+        """b_small == b_big on one worker: the two-batch estimator is
+        undefined; callers treat <=0 as "no signal" and must get 0.0,
+        not a division artifact."""
+        from kungfu_tpu.ops.monitor import host_noise_scale
+
+        chans, engines = self._engines(23720, 1)
+        try:
+            g = np.random.RandomState(0).uniform(-1, 1, 32).astype(np.float32)
+            assert host_noise_scale(engines[0], g, g, 16) == 0.0
+        finally:
+            for c in chans:
+                c.close()
+
+    def test_two_peer_engine_matches_in_graph_estimator(self):
+        """The host-plane estimate over a real 2-peer CollectiveEngine
+        equals the in-graph ``global_noise_scale`` over a 2-device mesh
+        on the SAME per-peer gradients — the two planes implement one
+        estimator, not two approximations of it."""
+        import jax
+        from jax.sharding import Mesh
+        from jax.sharding import PartitionSpec as P
+
+        import kungfu_tpu.ops.collective as kc
+        from kungfu_tpu.ops.monitor import global_noise_scale, host_noise_scale
+        from kungfu_tpu.utils.jaxcompat import shard_map
+
+        b_small = 16.0
+        rng = np.random.RandomState(7)
+        # base + per-peer noise keeps |G|^2 well away from zero, so the
+        # estimator is well-conditioned and float32-vs-float64 plane
+        # differences stay in the mantissa, not the structure
+        base = rng.uniform(1.0, 2.0, 64)
+        grads = np.stack(
+            [base + 0.1 * rng.uniform(-1, 1, 64) for _ in range(2)]
+        ).astype(np.float32)
+
+        chans, engines = self._engines(23730, 2)
+        try:
+            def one(i):
+                avg = engines[i].all_reduce(grads[i], op="mean")
+                return host_noise_scale(engines[i], grads[i], avg, b_small)
+
+            host_vals = run_all([lambda i=i: one(i) for i in range(2)])
+        finally:
+            for c in chans:
+                c.close()
+        # symmetric by construction (the inner mean is a collective)
+        assert host_vals[0] == pytest.approx(host_vals[1], rel=1e-9)
+
+        mesh = Mesh(np.array(jax.devices()[:2]), ("kf",))
+
+        def gns_fn(g):
+            avg = kc.all_reduce(g, "kf", op="mean")
+            return global_noise_scale(g, avg, b_small, "kf")[None]
+
+        got = shard_map(gns_fn, mesh=mesh, in_specs=P("kf"),
+                        out_specs=P("kf"))(grads)
+        in_graph = float(np.asarray(got)[0])
+        assert host_vals[0] == pytest.approx(in_graph, rel=1e-3)
+
+
 class TestMST:
     def test_chain(self):
         # latencies force a chain 0-1-2

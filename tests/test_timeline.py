@@ -221,6 +221,31 @@ class TestRegistry:
         assert 'lat_seconds_count{op="ar"} 1' in text
         assert "lat_seconds_sum" in text
 
+    def test_help_type_headers_once_per_family(self):
+        """Stock-scraper metadata: # HELP/# TYPE per metric family (one
+        header even across label variants), sample lines untouched."""
+        reg = MetricsRegistry()
+        reg.counter("kf_engine_retries_total").inc(2)
+        reg.gauge("g").set(1.5)
+        reg.histogram("lat_seconds", op="a").observe(0.003)
+        reg.histogram("lat_seconds", op="b").observe(0.004)
+        text = reg.render_prometheus()
+        # known metric gets its curated help line; unknown the fallback
+        assert ("# HELP kf_engine_retries_total engine send retries "
+                "after transient wire faults") in text
+        assert "# TYPE kf_engine_retries_total counter" in text
+        assert "# HELP g kungfu-tpu metric" in text
+        assert "# TYPE g gauge" in text
+        assert text.count("# TYPE lat_seconds histogram") == 1
+        # metadata precedes the family's first sample
+        lines = text.splitlines()
+        assert lines.index("# TYPE kf_engine_retries_total counter") \
+            < lines.index("kf_engine_retries_total 2")
+        # sample encoding byte-compatible with the pre-HELP rendering
+        assert "kf_engine_retries_total 2" in lines
+        assert "g 1.5" in lines
+        assert 'lat_seconds_bucket{le="+Inf",op="a"} 1' in text
+
     def test_type_conflict_raises(self):
         reg = MetricsRegistry()
         reg.counter("m")
@@ -342,6 +367,45 @@ class TestMetricsServer:
             assert "kf_collective_latency_seconds_bucket" in text
             assert 'op="scrape_probe"' in text
             assert "kf_collective_latency_seconds_count" in text
+        finally:
+            s.stop()
+
+    def test_broken_extra_fn_does_not_500_the_scrape(self):
+        """A raised exception inside extra_fn must not take the whole
+        endpoint down: healthy sections render, the failure appears as a
+        comment line (legal exposition-format noise)."""
+        from kungfu_tpu.monitor.metrics import MetricsServer, NetMonitor
+
+        REGISTRY.counter("kf_scrape_probe_total").inc()
+        m = NetMonitor(period=0.1)
+        m.egress("peer:1", 512)
+
+        def broken_extra():
+            raise RuntimeError("gns collector exploded")
+
+        s = MetricsServer(m, port=0, extra_fn=broken_extra).start()
+        try:
+            text = self._scrape(s.port)  # 200, not 500
+            assert 'kf_egress_bytes_total{peer="peer:1"} 512' in text
+            assert "kf_scrape_probe_total 1" in text
+            assert "# error: extra_fn: RuntimeError: gns collector exploded" in text
+        finally:
+            s.stop()
+
+    def test_registry_render_error_isolated(self, monkeypatch):
+        from kungfu_tpu.monitor import metrics as metrics_mod
+        from kungfu_tpu.monitor.metrics import MetricsServer, NetMonitor
+
+        m = NetMonitor(period=0.1)
+        m.ingress("peer:2", 64)
+        monkeypatch.setattr(
+            metrics_mod.REGISTRY, "render_prometheus",
+            lambda: (_ for _ in ()).throw(ValueError("bad metric")))
+        s = MetricsServer(m, port=0).start()
+        try:
+            text = self._scrape(s.port)
+            assert 'kf_ingress_bytes_total{peer="peer:2"} 64' in text
+            assert "# error: registry: ValueError: bad metric" in text
         finally:
             s.stop()
 
