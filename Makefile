@@ -5,13 +5,13 @@
 PY ?= python3
 BASELINE := tests/lint_baseline.json
 
-.PHONY: lint verify check test native trace-demo help
+.PHONY: lint verify shardcheck check test native trace-demo help
 
-## lint: all ten kf-lint rules — the Python suite (env-contract,
+## lint: all thirteen kf-lint rules — the Python suite (env-contract,
 ## jit-sync, blocking-io, retry-discipline, collective-consistency,
-## wire-contract, lock-order, trace-vocab, agg-schema) AND the
-## transport.cpp lockcheck (lock-discipline) in one command, honoring
-## the baseline.
+## wire-contract, lock-order, trace-vocab, agg-schema, shard-axis,
+## shard-spec, recompile-hazard) AND the transport.cpp lockcheck
+## (lock-discipline) in one command, honoring the baseline.
 lint:
 	$(PY) scripts/kflint $(if $(wildcard $(BASELINE)),--baseline $(BASELINE))
 
@@ -21,6 +21,13 @@ verify:
 	$(PY) scripts/kflint --checker collective-consistency \
 	    --checker wire-contract --checker lock-order \
 	    $(if $(wildcard $(BASELINE)),--baseline $(BASELINE))
+
+## shardcheck: just the kf-shard axis-environment rules (fast iteration
+## on sharding/mesh changes) — deliberately NO baseline: the tree must
+## hold these rules clean (the check.sh empty-baseline gate).
+shardcheck:
+	$(PY) scripts/kflint --checker shard-axis --checker shard-spec \
+	    --checker recompile-hazard
 
 ## check: the full pre-merge gate (lint + compileall + build stamps).
 check:

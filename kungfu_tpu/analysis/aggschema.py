@@ -34,10 +34,9 @@ from typing import Dict, List, Optional, Set
 from kungfu_tpu.analysis.core import (
     Violation,
     iter_py_files,
-    read_lines,
+    parse_module,
     relpath,
     suppressed,
-    suppressions,
 )
 
 CHECKER = "agg-schema"
@@ -56,8 +55,10 @@ def _schemas(root: str) -> Dict[str, Set[str]]:
     path = os.path.join(root, AGG_PATH)
     if not os.path.isfile(path):
         return {}
-    tree = ast.parse(open(path, encoding="utf-8").read())
+    tree = parse_module(path).tree
     out: Dict[str, Set[str]] = {}
+    if tree is None:
+        return out
     for node in ast.walk(tree):
         if (
             isinstance(node, ast.Assign)
@@ -183,17 +184,14 @@ def check(root: str) -> List[Violation]:
         if os.path.abspath(path) == os.path.abspath(
                 os.path.join(root, AGG_PATH)):
             continue
-        src = open(path, encoding="utf-8", errors="replace").read()
-        if "aggregator" not in src:
+        mod = parse_module(path)
+        if mod.tree is None or "aggregator" not in mod.source:
             continue
-        try:
-            tree = ast.parse(src)
-        except SyntaxError:
-            continue
+        tree = mod.tree
         mod_aliases, func_aliases = _agg_aliases(tree)
         if not mod_aliases and not func_aliases:
             continue
-        supp = suppressions(read_lines(path))
+        supp = mod.supp
         rel = relpath(root, path)
         for node in ast.walk(tree):
             if not isinstance(node, ast.Call):

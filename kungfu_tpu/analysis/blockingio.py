@@ -26,15 +26,14 @@ carries ``# kflint: allow(blocking-io)`` with a comment saying why.
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from kungfu_tpu.analysis.core import (
     Violation,
     iter_py_files,
-    read_lines,
+    parse_module,
     relpath,
     suppressed,
-    suppressions,
     terminal_name as _terminal,
 )
 
@@ -109,16 +108,14 @@ def _queue_names(tree: ast.AST) -> Dict[str, bool]:
 
 
 def _scan_module(root: str, path: str) -> List[Violation]:
-    src = open(path, encoding="utf-8", errors="replace").read()
-    try:
-        tree = ast.parse(src)
-    except SyntaxError:
+    mod = parse_module(path)
+    tree = mod.tree
+    if tree is None:
         return []
     rel = relpath(root, path)
     if not _spawns_threads(tree) and rel not in EXTRA_THREAD_MODULES:
         return []
-    lines = read_lines(path)
-    supp = suppressions(lines)
+    supp = mod.supp
     queues = _queue_names(tree)
     out: List[Violation] = []
 

@@ -34,7 +34,8 @@ import os
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
-from kungfu_tpu.analysis.core import PY_SCAN_DIRS, iter_py_files, relpath
+from kungfu_tpu.analysis.core import (PY_SCAN_DIRS, iter_py_files,
+                                      parse_module, relpath)
 
 #: method names answered by the builtin containers / sync primitives —
 #: a cross-object call through one of these says nothing about WHICH
@@ -79,6 +80,11 @@ class FuncInfo:
     node: ast.AST
     lineno: int
     calls: List[CallSite] = field(default_factory=list)
+    #: enclosing function for nested defs (None at module/class level) —
+    #: lets scope-aware consumers resolve a bare name to the RIGHT
+    #: same-named nested def instead of every one in the module
+    parent: Optional["FuncInfo"] = field(default=None, compare=False,
+                                         repr=False)
 
     @property
     def qualname(self) -> str:
@@ -119,6 +125,7 @@ class _FuncVisitor(ast.NodeVisitor):
         self.funcs: List[FuncInfo] = []
         self.imports: Dict[str, str] = {}  # local name -> source module
         self._cls: List[str] = []
+        self._func_stack: List[FuncInfo] = []
 
     def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
         for alias in node.names:
@@ -137,11 +144,14 @@ class _FuncVisitor(ast.NodeVisitor):
             path=self.path,
             node=node,
             lineno=node.lineno,
+            parent=self._func_stack[-1] if self._func_stack else None,
         )
         self._collect_calls(node.body, info, ())
         self.funcs.append(info)
         # nested defs get their own FuncInfo (class context preserved)
+        self._func_stack.append(info)
         self.generic_visit(node)
+        self._func_stack.pop()
 
     visit_FunctionDef = _visit_func
     visit_AsyncFunctionDef = _visit_func
@@ -216,9 +226,10 @@ class CallGraph:
         g = cls()
         for path in iter_py_files(root, dirs):
             try:
-                src = open(path, encoding="utf-8", errors="replace").read()
-                tree = ast.parse(src)
-            except (OSError, SyntaxError):
+                tree = parse_module(path).tree
+            except OSError:
+                continue
+            if tree is None:
                 continue
             module = _module_of(root, path)
             v = _FuncVisitor(module, relpath(root, path))
@@ -296,5 +307,9 @@ def project_graph(root: str) -> CallGraph:
 
 
 def invalidate_cache() -> None:
-    """Tests that rewrite a tree between checks call this."""
+    """Tests that rewrite a tree between checks call this.  The axis
+    environment is derived from this graph and cascades with it."""
     _GRAPH_CACHE.clear()
+    from kungfu_tpu.analysis import axisenv
+
+    axisenv.invalidate_cache()

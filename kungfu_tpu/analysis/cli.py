@@ -37,7 +37,10 @@ from kungfu_tpu.analysis import (
     jitpurity,
     lockcheck,
     pylockorder,
+    recompilehazard,
     retrydiscipline,
+    shardaxis,
+    shardspec,
     tracevocab,
     wirecontract,
 )
@@ -54,6 +57,9 @@ CHECKERS: Dict[str, object] = {
     pylockorder.CHECKER: pylockorder.check,
     tracevocab.CHECKER: tracevocab.check,
     aggschema.CHECKER: aggschema.check,
+    shardaxis.CHECKER: shardaxis.check,
+    shardspec.CHECKER: shardspec.check,
+    recompilehazard.CHECKER: recompilehazard.check,
 }
 
 #: the kf-verify subset: the interprocedural rules built on the shared
@@ -61,6 +67,11 @@ CHECKERS: Dict[str, object] = {
 #: rules a baseline most plausibly covers while a tree is brought clean)
 VERIFY_CHECKERS = (collectives.CHECKER, wirecontract.CHECKER,
                    pylockorder.CHECKER)
+
+#: the kf-shard subset: the axis-environment rules (make shardcheck /
+#: the check.sh empty-baseline gate run exactly these)
+SHARD_CHECKERS = (shardaxis.CHECKER, shardspec.CHECKER,
+                  recompilehazard.CHECKER)
 
 
 def run_checkers(root: Optional[str] = None,

@@ -41,16 +41,14 @@ import ast
 from typing import Dict, List, Optional, Tuple
 
 from kungfu_tpu.analysis.callgraph import (
-    CallGraph,
     CallSite,
     FuncInfo,
     project_graph,
 )
 from kungfu_tpu.analysis.core import (
     Violation,
-    read_lines,
+    parse_module,
     suppressed,
-    suppressions,
 )
 
 CHECKER = "collective-consistency"
@@ -149,9 +147,7 @@ def check(root: str) -> List[Violation]:
         if path not in supp_cache:
             import os
 
-            supp_cache[path] = suppressions(
-                read_lines(os.path.join(root, path))
-            )
+            supp_cache[path] = parse_module(os.path.join(root, path)).supp
         return supp_cache[path]
 
     def flag(path: str, line: int, msg: str) -> None:

@@ -22,6 +22,7 @@ from kungfu_tpu.analysis.core import (
     Violation,
     iter_cpp_files,
     iter_py_files,
+    parse_module,
     read_lines,
     relpath,
     suppressed,
@@ -45,9 +46,11 @@ def _registry_tokens(root: str) -> Dict[str, int]:
 
 def _registry_constants(root: str) -> Dict[str, str]:
     """``{constant_name: token}`` for ``NAME = "KF_..."`` bindings."""
-    src = open(os.path.join(root, REGISTRY_PATH), encoding="utf-8").read()
+    tree = parse_module(os.path.join(root, REGISTRY_PATH)).tree
     out: Dict[str, str] = {}
-    for node in ast.walk(ast.parse(src)):
+    if tree is None:
+        return out
+    for node in ast.walk(tree):
         if (
             isinstance(node, ast.Assign)
             and len(node.targets) == 1
@@ -87,8 +90,8 @@ def _constant_readers(root: str, constants: Dict[str, str]) -> Set[str]:
     in envs.py's own code or in any module importing the registry."""
     used: Set[str] = set()
     # loads inside envs.py itself (parse_config_from_env etc.)
-    reg_src = open(os.path.join(root, REGISTRY_PATH), encoding="utf-8").read()
-    for node in ast.walk(ast.parse(reg_src)):
+    reg_tree = parse_module(os.path.join(root, REGISTRY_PATH)).tree
+    for node in ast.walk(reg_tree) if reg_tree is not None else ():
         if (
             isinstance(node, ast.Name)
             and isinstance(node.ctx, ast.Load)
@@ -104,7 +107,7 @@ def _constant_readers(root: str, constants: Dict[str, str]) -> Set[str]:
             os.path.join(root, REGISTRY_PATH)
         ):
             continue
-        src = open(path, encoding="utf-8", errors="replace").read()
+        src = parse_module(path).source
         if "utils.envs" not in src and "utils import envs" not in src:
             continue
         if name_re is not None:

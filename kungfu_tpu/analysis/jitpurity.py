@@ -1,14 +1,17 @@
 """jit-purity checker: no host syncs or side effects inside jitted code.
 
-Inside a function that is jit-compiled — decorated with (or passed to)
-``jax.jit`` / ``pmap`` / ``shard_map``, including the
-``functools.partial(jax.jit, ...)`` form — and inside module-local
-functions it calls (one level deep), flag the classic host-round-trip
-and side-effect calls:
+Inside any function whose body is traced into compiled code — decorated
+with (or passed to) ``jax.jit`` / ``pmap`` / ``shard_map``, including
+``functools.partial(jax.jit, ...)``, nested call forms like
+``jit(shard_map(f, ...))`` and ``jit(value_and_grad(f))`` — and inside
+every function reachable from one through the project call graph
+(resolved calls AND callback references like ``lax.scan(step, ...)``,
+to any depth, across modules), flag the classic host-round-trip and
+side-effect calls:
 
 * ``.item()`` / ``.tolist()`` / ``.block_until_ready()``
 * ``float(x)`` / ``int(x)`` on non-static values (shape/len/ndim/size
-  arithmetic is static under trace and stays legal)
+  arithmetic and env-string parsing are trace-static and stay legal)
 * ``np.asarray`` / ``np.array`` (device→host copy mid-trace)
 * ``print`` (tracer leak; use ``jax.debug.print``)
 * ``time.time()`` / ``time.monotonic()`` / ``time.perf_counter()``
@@ -16,123 +19,136 @@ and side-effect calls:
 
 Host round-trips in jitted code are exactly the cost the cross-replica
 weight-update sharding work (arXiv:2004.13336) shows dominating update
-time at pod scale; a checker keeps them out structurally.  Suppress a
-deliberate sync with ``# kflint: allow(jit-sync)`` on the line.
+time at pod scale.  Reach comes from the shared
+:mod:`~kungfu_tpu.analysis.axisenv` jit-scope map (the same fixpoint
+the kf-shard rules use), so a sync two helpers deep — the shape the old
+one-level walk missed — is attributed back to its jitted root.
+Suppress a deliberate sync with ``# kflint: allow(jit-sync)``.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Optional, Set
+import os
+from typing import Dict, List, Optional, Set, Tuple
 
+from kungfu_tpu.analysis.axisenv import axis_environment, fkey
 from kungfu_tpu.analysis.core import (
     Violation,
     iter_py_files,
-    read_lines,
+    parse_module,
     relpath,
     suppressed,
-    suppressions,
     terminal_name as _terminal_name,
 )
 
 CHECKER = "jit-sync"
 
-_JIT_NAMES = {"jit", "pmap", "shard_map"}
 _SYNC_METHODS = {"item", "tolist", "block_until_ready"}
-_STATIC_MARKERS = {"shape", "ndim", "size", "len", "dtype", "itemsize", "nbytes"}
+_STATIC_MARKERS = {"shape", "ndim", "size", "dtype", "itemsize", "nbytes"}
+#: bare-call terminals whose result is a host value, not a tracer
+_HOST_VALUE_BARE = {"len", "getenv", "axis_size"}
+#: (method, receiver terminal) pairs that are host values — receiver-
+#: qualified so `x.prod()`/`state.get()` on a TRACED x stay syncs
+_HOST_VALUE_QUALIFIED = {
+    ("get", "environ"), ("getenv", "os"),
+    ("prod", "math"), ("prod", "np"), ("prod", "numpy"),
+    ("ceil", "math"), ("floor", "math"),
+    # lax.axis_size is a static mesh-axis extent — the exact remedy the
+    # recompile-hazard messages prescribe (axis_index stays OUT: it
+    # returns a tracer)
+    ("axis_size", "lax"),
+}
 
 
-def _jit_wrapper_name(call_or_deco: ast.AST) -> Optional[str]:
-    """The jit-family name if this decorator/callee is one, unwrapping
-    ``functools.partial(jax.jit, ...)``."""
-    node = call_or_deco
-    if isinstance(node, ast.Call):
-        fname = _terminal_name(node.func)
-        if fname == "partial" and node.args:
-            inner = _terminal_name(node.args[0])
-            if inner in _JIT_NAMES:
-                return inner
-        if fname in _JIT_NAMES:
-            return fname
-        return None
-    name = _terminal_name(node)
-    return name if name in _JIT_NAMES else None
+def _host_value_call(call: ast.Call,
+                     static_names: Optional[Set[str]] = None) -> bool:
+    fn = call.func
+    name = _terminal_name(fn)
+    if isinstance(fn, ast.Name):
+        return name in _HOST_VALUE_BARE
+    if isinstance(fn, ast.Attribute):
+        if (name, _terminal_name(fn.value)) not in _HOST_VALUE_QUALIFIED:
+            return False
+        if name in ("prod", "ceil", "floor"):
+            # np.prod(x.shape) is static; np.prod(x) on a TRACED x is a
+            # host concretization — the math family qualifies only when
+            # its own arguments are static
+            return all(_is_static_expr(a, static_names)
+                       for a in call.args)
+        return True
+    return False
 
 
-class _ModuleIndex(ast.NodeVisitor):
-    """All function defs in a module + which ones enter jit scope."""
-
-    def __init__(self) -> None:
-        # name -> ALL defs carrying it: names repeat across scopes in
-        # this tree (every trainer has a `body`/`step`), and scanning
-        # only the first def would silently pass a sync in the others
-        self.funcs: Dict[str, List[ast.AST]] = {}
-        self.jitted: Set[str] = set()
-        self.np_aliases: Set[str] = set()
-        self.time_aliases: Set[str] = set()
-
-    def visit_Import(self, node: ast.Import) -> None:
-        for a in node.names:
-            if a.name == "numpy":
-                self.np_aliases.add(a.asname or "numpy")
-            if a.name == "time":
-                self.time_aliases.add(a.asname or "time")
-        self.generic_visit(node)
-
-    def _visit_func(self, node) -> None:
-        self.funcs.setdefault(node.name, []).append(node)
-        for deco in node.decorator_list:
-            if _jit_wrapper_name(deco):
-                self.jitted.add(node.name)
-        self.generic_visit(node)
-
-    visit_FunctionDef = _visit_func
-    visit_AsyncFunctionDef = _visit_func
-
-    def visit_Call(self, node: ast.Call) -> None:
-        # call form: jax.jit(fn) / shard_map(body, mesh=...) — possibly
-        # nested, jit(shard_map(fn, ...)); mark every local function
-        # threaded through a jit-family wrapper
-        if _jit_wrapper_name(node):
-            queue = list(node.args[:1])
-            while queue:
-                arg = queue.pop()
-                if isinstance(arg, ast.Call) and _jit_wrapper_name(arg):
-                    queue.extend(arg.args[:1])
-                else:
-                    name = _terminal_name(arg)
-                    if name:
-                        self.jitted.add(name)
-        self.generic_visit(node)
+def _module_aliases(tree: ast.AST) -> Tuple[Set[str], Set[str]]:
+    """(numpy aliases, time aliases) bound by this module's imports."""
+    np_aliases: Set[str] = set()
+    time_aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    np_aliases.add(a.asname or "numpy")
+                if a.name == "time":
+                    time_aliases.add(a.asname or "time")
+    return np_aliases, time_aliases
 
 
-def _is_static_expr(node: ast.AST) -> bool:
-    """Shape arithmetic and other trace-time constants: legal under jit."""
+def _is_static_expr(node: ast.AST,
+                    static_names: Optional[Set[str]] = None) -> bool:
+    """Shape arithmetic, env parsing, and other trace-time constants:
+    legal under jit.  ``static_names`` are locals the enclosing body
+    assigned from static expressions (``T = x.shape[0]``)."""
     if isinstance(node, ast.Constant):
         return True
     for sub in ast.walk(node):
         if isinstance(sub, ast.Attribute) and sub.attr in _STATIC_MARKERS:
             return True
-        if isinstance(sub, ast.Call) and _terminal_name(sub.func) == "len":
+        if isinstance(sub, ast.Call) \
+                and _host_value_call(sub, static_names):
+            return True
+        if static_names and isinstance(sub, ast.Name) \
+                and sub.id in static_names:
             return True
     return False
 
 
+def _static_locals(stmts) -> Set[str]:
+    """Names assigned from static expressions anywhere in the body —
+    one flow-insensitive pass, transitive (``T = x.shape[0]; C = T * 2``)."""
+    assigns: List[Tuple[str, ast.AST]] = []
+    for stmt in stmts:
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name):
+                assigns.append((n.targets[0].id, n.value))
+    static: Set[str] = set()
+    # to convergence, not a fixed pass count: textual order need not be
+    # topological (chains assigned inside loops arrive reversed)
+    for _ in range(len(assigns) + 1):
+        grew = False
+        for name, value in assigns:
+            if name not in static and _is_static_expr(value, static):
+                static.add(name)
+                grew = True
+        if not grew:
+            break
+    return static
+
+
 class _BodyScan(ast.NodeVisitor):
-    def __init__(self, index: _ModuleIndex, depth: int) -> None:
-        self.index = index
-        self.depth = depth  # 0 = the jitted function, 1 = direct callee
-        self.hits: List[tuple] = []  # (line, message)
-        self.callees: Set[str] = set()
+    """Sync/side-effect call sites in one function body (nested defs
+    included — they share the trace)."""
+
+    def __init__(self, np_aliases: Set[str], time_aliases: Set[str],
+                 static_names: Optional[Set[str]] = None) -> None:
+        self.np_aliases = np_aliases
+        self.time_aliases = time_aliases
+        self.static_names = static_names or set()
+        self.hits: List[Tuple[int, str]] = []
 
     def _flag(self, node: ast.AST, what: str) -> None:
         self.hits.append((node.lineno, what))
-
-    def visit_FunctionDef(self, node) -> None:
-        # nested defs share the trace; keep scanning
-        self.generic_visit(node)
-
-    visit_AsyncFunctionDef = visit_FunctionDef
 
     def visit_Call(self, node: ast.Call) -> None:
         fn = node.func
@@ -141,9 +157,10 @@ class _BodyScan(ast.NodeVisitor):
             if fn.attr in _SYNC_METHODS:
                 self._flag(node, f".{fn.attr}() forces a host sync")
             base = _terminal_name(fn.value)
-            if base in self.index.np_aliases and fn.attr in ("asarray", "array"):
-                self._flag(node, f"{base}.{fn.attr}() copies device→host mid-trace")
-            if base in self.index.time_aliases and fn.attr in (
+            if base in self.np_aliases and fn.attr in ("asarray", "array"):
+                self._flag(node,
+                           f"{base}.{fn.attr}() copies device→host mid-trace")
+            if base in self.time_aliases and fn.attr in (
                 "time", "monotonic", "perf_counter",
             ):
                 self._flag(
@@ -154,64 +171,61 @@ class _BodyScan(ast.NodeVisitor):
             if name == "print":
                 self._flag(node, "print() in jitted code (use jax.debug.print)")
             elif name in ("float", "int") and node.args:
-                if not _is_static_expr(node.args[0]):
+                if not _is_static_expr(node.args[0], self.static_names):
                     self._flag(
                         node,
                         f"{name}() on a traced value forces a host sync",
                     )
-            elif (
-                self.depth == 0
-                and name in self.index.funcs
-                and name not in self.index.jitted
-            ):
-                self.callees.add(name)
         self.generic_visit(node)
 
 
-def _scan_file(root: str, path: str) -> List[Violation]:
-    src = open(path, encoding="utf-8", errors="replace").read()
-    try:
-        tree = ast.parse(src)
-    except SyntaxError as e:
-        return [Violation(CHECKER, relpath(root, path), e.lineno or 1,
-                          f"syntax error prevents analysis: {e.msg}")]
-    index = _ModuleIndex()
-    index.visit(tree)
-    if not index.jitted:
-        return []
-    lines = read_lines(path)
-    supp = suppressions(lines)
-    out: List[Violation] = []
-    seen: Set[tuple] = set()
-
-    def run(fn_name: str, depth: int, via: Optional[str]) -> None:
-        # scan EVERY def of the name: which one the jit wrapper binds is
-        # scope-dependent, and a gate must over- rather than under-report
-        for node in index.funcs.get(fn_name, ()):
-            scan = _BodyScan(index, depth)
-            for stmt in node.body:
-                scan.visit(stmt)
-            for line, what in scan.hits:
-                key = (fn_name, line, what)
-                if key in seen or suppressed(supp, line, CHECKER):
-                    continue
-                seen.add(key)
-                ctx = f" (called from jitted {via})" if via else ""
-                out.append(Violation(
-                    CHECKER, relpath(root, path), line,
-                    f"in jit scope `{fn_name}`{ctx}: {what}",
-                ))
-            if depth == 0:
-                for callee in sorted(scan.callees):
-                    run(callee, 1, fn_name)
-
-    for fn_name in sorted(index.jitted):
-        run(fn_name, 0, None)
-    return out
-
-
 def check(root: str) -> List[Violation]:
+    env = axis_environment(root)
+    alias_cache: Dict[str, Tuple[Set[str], Set[str]]] = {}
+
+    def aliases_for(path: str) -> Tuple[Set[str], Set[str]]:
+        if path not in alias_cache:
+            tree = parse_module(os.path.join(root, path)).tree
+            alias_cache[path] = (_module_aliases(tree) if tree is not None
+                                 else (set(), set()))
+        return alias_cache[path]
+
     out: List[Violation] = []
+    seen: Set[Tuple[str, int, str]] = set()
+
+    # an unparseable file is invisible to EVERY rule — this checker owns
+    # surfacing it (as it did pre-callgraph), so the suite cannot go
+    # green on a tree it could not actually analyze
     for path in iter_py_files(root):
-        out.extend(_scan_file(root, path))
-    return out
+        err = parse_module(path).error
+        if err is not None:
+            out.append(Violation(
+                CHECKER, relpath(root, path), err.lineno or 1,
+                f"syntax error prevents analysis: {err.msg}"))
+
+    for func in env.graph.functions:
+        roots = env.jit_roots.get(fkey(func))
+        if not roots:
+            continue
+        np_aliases, time_aliases = aliases_for(func.path)
+        scan = _BodyScan(np_aliases, time_aliases,
+                         _static_locals(func.node.body))
+        for stmt in func.node.body:
+            scan.visit(stmt)
+        if not scan.hits:
+            continue
+        supp = parse_module(os.path.join(root, func.path)).supp
+        is_root = func.name in roots
+        via = "" if is_root else (
+            f" (called from jitted {sorted(roots)[0]})")
+        for line, what in scan.hits:
+            key = (func.path, line, what)
+            if key in seen or suppressed(supp, line, CHECKER):
+                continue
+            seen.add(key)
+            out.append(Violation(
+                CHECKER, func.path, line,
+                f"in jit scope `{func.name}`{via}: {what}",
+            ))
+
+    return sorted(out, key=lambda v: (v.path, v.line, v.message))

@@ -45,9 +45,8 @@ from kungfu_tpu.analysis.callgraph import (
 )
 from kungfu_tpu.analysis.core import (
     Violation,
-    read_lines,
+    parse_module,
     suppressed,
-    suppressions,
 )
 
 CHECKER = "lock-order"
@@ -124,9 +123,10 @@ def _build_lock_index(graph: CallGraph, root: str) -> _LockIndex:
     # module-level locks: re-parse top-level assigns of each module
     for module, rel in sorted(seen_modules):
         try:
-            tree = ast.parse(open(os.path.join(root, rel),
-                                  encoding="utf-8", errors="replace").read())
-        except (OSError, SyntaxError):
+            tree = parse_module(os.path.join(root, rel)).tree
+        except OSError:
+            continue
+        if tree is None:
             continue
         for node in tree.body:
             if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
@@ -280,8 +280,8 @@ def check(root: str) -> List[Violation]:
 
     def supp_for(path: str) -> Dict[int, set]:
         if path not in supp_cache:
-            supp_cache[path] = suppressions(
-                read_lines(os.path.join(root, path)))
+            supp_cache[path] = parse_module(
+                os.path.join(root, path)).supp
         return supp_cache[path]
 
     def add_edge(a: LockId, b: LockId, path: str, line: int,

@@ -41,10 +41,9 @@ from typing import Iterable, List
 from kungfu_tpu.analysis.core import (
     Violation,
     iter_py_files,
-    read_lines,
+    parse_module,
     relpath,
     suppressed,
-    suppressions,
     terminal_name as _terminal,
 )
 
@@ -158,14 +157,12 @@ def _sleep_is_constant(call: ast.Call) -> bool:
 
 
 def _scan_module(root: str, path: str) -> List[Violation]:
-    src = open(path, encoding="utf-8", errors="replace").read()
-    try:
-        tree = ast.parse(src)
-    except SyntaxError:
+    mod = parse_module(path)
+    tree = mod.tree
+    if tree is None:
         return []
     rel = relpath(root, path)
-    lines = read_lines(path)
-    supp = suppressions(lines)
+    supp = mod.supp
     out: List[Violation] = []
 
     def flag(line: int, msg: str) -> None:

@@ -30,10 +30,9 @@ from typing import Dict, List, Optional, Set
 from kungfu_tpu.analysis.core import (
     Violation,
     iter_py_files,
-    read_lines,
+    parse_module,
     relpath,
     suppressed,
-    suppressions,
 )
 
 CHECKER = "trace-vocab"
@@ -48,7 +47,9 @@ def _vocabulary(root: str) -> Set[str]:
     path = os.path.join(root, TIMELINE_PATH)
     if not os.path.isfile(path):
         return set()
-    tree = ast.parse(open(path, encoding="utf-8").read())
+    tree = parse_module(path).tree
+    if tree is None:
+        return set()
     for node in ast.walk(tree):
         if (
             isinstance(node, ast.Assign)
@@ -122,17 +123,14 @@ def check(root: str) -> List[Violation]:
         if os.path.abspath(path) == os.path.abspath(
                 os.path.join(root, TIMELINE_PATH)):
             continue
-        src = open(path, encoding="utf-8", errors="replace").read()
-        if "timeline" not in src:
+        mod = parse_module(path)
+        if mod.tree is None or "timeline" not in mod.source:
             continue
-        try:
-            tree = ast.parse(src)
-        except SyntaxError:
-            continue
+        tree = mod.tree
         mod_aliases, func_aliases = _timeline_aliases(tree)
         if not mod_aliases and not func_aliases:
             continue
-        supp = suppressions(read_lines(path))
+        supp = mod.supp
         rel = relpath(root, path)
         for node in ast.walk(tree):
             if not isinstance(node, ast.Call):

@@ -38,7 +38,7 @@ import struct
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from kungfu_tpu.analysis.core import Violation, read_lines
+from kungfu_tpu.analysis.core import Violation, parse_module, read_lines
 
 CHECKER = "wire-contract"
 
@@ -114,8 +114,9 @@ def _fmt_letters(fmt: str) -> Optional[List[str]]:
 
 def python_schema(path: str) -> Schema:
     s = Schema()
-    src = open(path, encoding="utf-8", errors="replace").read()
-    tree = ast.parse(src)
+    tree = parse_module(path).tree
+    if tree is None:
+        raise SyntaxError(f"{path}: unparseable")
 
     codec: Optional[ast.ClassDef] = None
     for node in tree.body:

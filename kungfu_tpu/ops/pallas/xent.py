@@ -261,12 +261,15 @@ def _route_fused(n: int, v: int, itemsize: int, training: bool) -> bool:
     """True = take the Pallas kernel for this (shape, dtype, phase)."""
     import os
 
+    # launch-set routing knobs, read at trace time BY DESIGN: they pick
+    # which kernel gets traced for a shape and carry no cluster-size
+    # state, so they cannot go stale on resize
     if training:
-        budget_mb = int(os.environ.get("KF_XENT_XLA_BUDGET_MB",
+        budget_mb = int(os.environ.get("KF_XENT_XLA_BUDGET_MB",  # kflint: allow(recompile-hazard)
                                        str(XENT_TRAIN_XLA_BUDGET_MB)))
         resid_bytes = n * v * (itemsize + 4)
         return resid_bytes > (budget_mb << 20)
-    min_el = int(os.environ.get("KF_XENT_FWD_MIN_ELEMENTS",
+    min_el = int(os.environ.get("KF_XENT_FWD_MIN_ELEMENTS",  # kflint: allow(recompile-hazard)
                                 str(XENT_FWD_MIN_ELEMENTS)))
     return n * v >= min_el
 
@@ -300,7 +303,9 @@ def token_nll(logits, targets, training: bool = True):
     there); the default assumes gradients will flow."""
     import os
 
-    mode = os.environ.get("KF_TPU_XENT", "auto").lower()
+    # launch-set dispatch mode, a deliberate trace-time constant (it
+    # selects the kernel being traced; no membership state to go stale)
+    mode = os.environ.get("KF_TPU_XENT", "auto").lower()  # kflint: allow(recompile-hazard)
     if mode == "xla":
         mode = "plain"  # long-standing alias
     if mode not in ("fused", "plain", "auto"):

@@ -4,12 +4,18 @@
 #
 #   scripts/check.sh
 #
-# 1. kflint        — all ten project-invariant checkers, including the
-#                    kf-verify interprocedural rules, trace-vocab, and
-#                    agg-schema (docs/lint.md).  Findings fingerprinted
+# 1. kflint        — all thirteen project-invariant checkers, including
+#                    the kf-verify interprocedural rules and the
+#                    kf-shard axis-environment rules (docs/lint.md),
+#                    over kungfu_tpu/, scripts/, benchmarks/, examples/,
+#                    and __graft_entry__.py.  Findings fingerprinted
 #                    in tests/lint_baseline.json are suppressed (legacy
 #                    debt being ratcheted down); anything NOT in the
 #                    baseline fails the gate.
+# 1b. kf-shard     — shard-axis / shard-spec / recompile-hazard rerun
+#                    WITHOUT the baseline: the sharding rules gate with
+#                    an empty baseline (a mesh-axis typo or resize
+#                    hazard can never land as "legacy debt").
 # 2. kftrace       — flight-recorder dump schema self-check (recorder
 #                    and reader must agree byte-for-byte, docs/tracing.md)
 # 3. kftop         — live-plane /cluster schema self-check (push wire
@@ -30,6 +36,13 @@ if [ -f tests/lint_baseline.json ]; then
     KFLINT_ARGS+=(--baseline tests/lint_baseline.json)
 fi
 if ! python3 scripts/kflint "${KFLINT_ARGS[@]}"; then
+    fail=1
+fi
+
+echo "== kf-shard empty-baseline gate (shard-axis, shard-spec, recompile-hazard)"
+# no --baseline on purpose: sharding/resize hazards never ratchet
+if ! python3 scripts/kflint --checker shard-axis --checker shard-spec \
+        --checker recompile-hazard; then
     fail=1
 fi
 

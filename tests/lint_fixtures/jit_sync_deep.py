@@ -1,0 +1,18 @@
+"""Fixture: a host sync two helpers deep.  The pre-callgraph jit-sync
+walked ONE level of module-local helpers and missed this; the fixpoint
+version reaches it and attributes it to the jitted root."""
+
+import jax
+
+
+@jax.jit
+def step(x):
+    return level1(x)
+
+
+def level1(x):
+    return level2(x)
+
+
+def level2(x):
+    return x.item()                # VIOLATION: depth 2 from the jit root

@@ -1,0 +1,45 @@
+"""Fixture: seeded recompile-hazard violations (never imported)."""
+
+import os
+
+import jax
+
+
+@jax.jit
+def traced(x, peers):
+    n = jax.device_count()                     # VIOLATION: world baked in
+    m = len(peers)                             # VIOLATION: peer-list length
+    mode = os.environ.get("KF_FIX_MODE", "a")  # VIOLATION: env read
+    ok = jax.device_count()  # kflint: allow(recompile-hazard) — doc'd
+    return x * n * m * len(mode) * ok
+
+
+def build_step():
+    world = jax.device_count()
+
+    @jax.jit
+    def step(x):
+        return x / world                       # VIOLATION: closure leak
+
+    return step
+
+
+def static_hazards():
+    def f(params, batch):
+        return params, batch
+
+    a = jax.jit(f, static_argnums=(1,))        # VIOLATION: batch varies
+    b = jax.jit(f, static_argnums=(7,))        # VIOLATION: out of range
+    c = jax.jit(f, static_argnames="batch")    # VIOLATION: varying name
+    return a, b, c
+
+
+def epoch_scoped(comm):
+    n = comm.size  # ok: a Communicator is an immutable mesh epoch —
+    # resize builds a new one and the step is rebuilt with it
+
+    @jax.jit
+    def step(x):
+        return x / n
+
+    return step

@@ -1,0 +1,45 @@
+"""Fixture: seeded shard-axis violations (never imported by the app)."""
+
+import jax
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+MESH_AXES = ("x", "y")
+
+
+def build():
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 2), MESH_AXES)
+
+    def body(a):
+        s = jax.lax.psum(a, "x")            # ok: bound here
+        t = jax.lax.psum(a, "z")            # VIOLATION: no mesh declares z
+        u = jax.lax.pmean(a, ("x", "y"))    # ok: tuple, both bound
+        v = jax.lax.axis_index("y")         # ok
+        w = jax.lax.psum(a, "q")  # kflint: allow(shard-axis) — doc'd waiver
+        return s + t + u + v + w
+
+    return shard_map(body, mesh=mesh, in_specs=(P("x"),), out_specs=P("x"))
+
+
+def helper(a):
+    # "y" IS a declared axis (build's mesh) but the only caller runs on
+    # the 1-D sub-mesh ("x",): flagged via the environment layer
+    return jax.lax.psum(a, "y")             # VIOLATION: not bound in ctx {x}
+
+
+def sub():
+    mesh1 = Mesh(np.array(jax.devices()[:2]), ("x",))
+
+    def body1(a):
+        return helper(a)
+
+    return shard_map(body1, mesh=mesh1, in_specs=(P("x"),), out_specs=P("x"))
+
+
+def dyn(a, axis):
+    return jax.lax.psum(a, axis)            # ok: dynamic, callers carry it
+
+
+def default_bad(a, axis="zz"):              # VIOLATION: default undeclared
+    return jax.lax.psum(a, axis)

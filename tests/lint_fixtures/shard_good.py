@@ -1,0 +1,72 @@
+"""Fixture: compliant sharding idioms — the kf-shard rules must pass
+every one of these untouched."""
+
+import functools
+
+import jax
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def partial_form():
+    """functools.partial(shard_map, mesh=...) binds the mesh too."""
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 2), ("x", "y"))
+    smap = functools.partial(shard_map, mesh=mesh, in_specs=(P("x"),),
+                             out_specs=P("x"))
+
+    def body(a):
+        return jax.lax.psum(a, "y")
+
+    return smap(body)
+
+
+def nested_sub_mesh():
+    """Inner shard_map over a sub-mesh: the OUTER axis stays live."""
+    outer = Mesh(np.array(jax.devices()), ("x",))
+    inner = Mesh(np.array(jax.devices()[:2]), ("y",))
+
+    def outer_body(a):
+        def inner_body(b):
+            s = jax.lax.psum(b, "y")       # inner axis
+            return jax.lax.psum(s, "x")    # outer axis, still bound
+
+        return shard_map(inner_body, mesh=inner, in_specs=(P("y"),),
+                         out_specs=P("y"))(a)
+
+    return shard_map(outer_body, mesh=outer, in_specs=(P("x"),),
+                     out_specs=P("x"))
+
+
+def shared(a, axis):
+    """Axis parameter: each caller supplies its own axis — dynamic,
+    checked at the call sites that pass literals."""
+    return jax.lax.psum(a, axis)
+
+
+def two_meshes():
+    """One helper reached from two meshes with DIFFERENT axis sets —
+    per-context environments must not cross-contaminate."""
+    mx = Mesh(np.array(jax.devices()), ("x",))
+    my = Mesh(np.array(jax.devices()), ("y",))
+
+    def bx(a):
+        return jax.lax.psum(shared(a, "x"), "x")
+
+    def by(a):
+        return jax.lax.psum(shared(a, "y"), "y")
+
+    fx = shard_map(bx, mesh=mx, in_specs=(P("x"),), out_specs=P())
+    fy = shard_map(by, mesh=my, in_specs=(P(None, "y"),), out_specs=P())
+    return fx, fy
+
+
+def unconstrained():
+    """PartitionSpec(None, 'x'): None dims are unconstrained and legal."""
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+
+    def body(a):
+        return a
+
+    return shard_map(body, mesh=mesh, in_specs=(P(None, "x"),),
+                     out_specs=P(None, "x"))

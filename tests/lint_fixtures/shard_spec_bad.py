@@ -1,0 +1,40 @@
+"""Fixture: seeded shard-spec violations (never imported by the app)."""
+
+import jax
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def build():
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 2), ("x", "y"))
+
+    def body(a, b):
+        return a + b
+
+    good = shard_map(body, mesh=mesh, in_specs=(P("x"), P(None, "y")),
+                     out_specs=P("x"))
+    bad_axis = shard_map(body, mesh=mesh,
+                         in_specs=(P("x"), P("w")),     # VIOLATION: no w
+                         out_specs=P("x"))
+    dup = shard_map(body, mesh=mesh,
+                    in_specs=(P("x", "x"), P(None)),    # VIOLATION: x twice
+                    out_specs=P("x"))
+    arity = shard_map(body, mesh=mesh,                  # VIOLATION: 1 vs 2
+                      in_specs=(P("x"),),
+                      out_specs=P("x"))
+
+    def pair(a):
+        return a, a
+
+    out_arity = shard_map(pair, mesh=mesh,              # VIOLATION: 3 vs 2
+                          in_specs=(P("x"),),
+                          out_specs=(P("x"), P("y"), P()))
+    ns = NamedSharding(mesh, P("x", "zz"))              # VIOLATION: no zz
+    waived = shard_map(body, mesh=mesh, out_specs=P("x"),
+                       in_specs=(P("x"), P("qq")))  # kflint: allow(shard-spec)
+    return good, bad_axis, dup, arity, out_arity, ns, waived
+
+
+def vocab_only():
+    return P(None, "nope")                              # VIOLATION: unknown

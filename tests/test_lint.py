@@ -17,11 +17,14 @@ from kungfu_tpu.analysis import (
     jitpurity,
     lockcheck,
     pylockorder,
+    recompilehazard,
     retrydiscipline,
+    shardaxis,
+    shardspec,
     tracevocab,
     wirecontract,
 )
-from kungfu_tpu.analysis.cli import main as cli_main, run_checkers
+from kungfu_tpu.analysis.cli import SHARD_CHECKERS, main as cli_main, run_checkers
 from kungfu_tpu.analysis.core import repo_root
 
 ROOT = repo_root(os.path.dirname(os.path.abspath(__file__)))
@@ -521,3 +524,610 @@ class TestEnvContract:
         got = envcheck.check(root)
         assert any("KF_SEEDED_DRIFT" in v.message for v in got), \
             [v.render() for v in got]
+
+
+def _shard_check_all(root):
+    return (shardaxis.check(root) + shardspec.check(root)
+            + recompilehazard.check(root))
+
+
+class TestShardAxis:
+    """The kf-shard axis rule: literal collective axes must be declared
+    by SOME mesh (vocabulary layer — the one-token-typo backbone) and
+    bound in EVERY statically-known calling context (environment
+    layer)."""
+
+    def test_fixture_violations_caught(self, tmp_path):
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": "shard_axis_bad.py"})
+        got = sorted((v.line, v.message) for v in shardaxis.check(root))
+        assert [line for line, _ in got] == [16, 28, 44], got
+        assert "no Mesh/pmap in the tree declares" in got[0][1]
+        # the env-layer finding names the live environment AND the entry
+        assert "not bound in the axis environment {x}" in got[1][1]
+        assert "shard_map at" in got[1][1]
+        assert "default axis 'zz'" in got[2][1]
+
+    def test_suppression_honored(self, tmp_path):
+        # the waived psum("q") on the allow() line must not surface
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": "shard_axis_bad.py"})
+        assert all(v.line != 19 for v in shardaxis.check(root))
+
+    def test_good_fixture_clean(self, tmp_path):
+        """partial(shard_map, mesh=...), nested sub-mesh, two-mesh
+        helper with parameter axes, P(None, 'x') — all compliant idioms
+        must pass all three kf-shard rules untouched."""
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": "shard_good.py"})
+        assert _shard_check_all(root) == [], \
+            [v.render() for v in _shard_check_all(root)]
+
+    def test_two_mesh_helper_no_cross_contamination(self, tmp_path):
+        """A helper with a LITERAL axis reached from two meshes with
+        different axis sets: valid under mesh A, a hang under mesh B —
+        the union of the two environments must NOT mask it."""
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/mod.py":
+                "import jax\n"
+                "import numpy as np\n"
+                "from jax.experimental.shard_map import shard_map\n"
+                "from jax.sharding import Mesh, PartitionSpec as P\n\n\n"
+                "def helper(a):\n"
+                "    return jax.lax.psum(a, 'x')\n\n\n"
+                "def build():\n"
+                "    mx = Mesh(np.array(jax.devices()), ('x',))\n"
+                "    my = Mesh(np.array(jax.devices()), ('y',))\n\n"
+                "    def bx(a):\n"
+                "        return helper(a)\n\n"
+                "    def by(a):\n"
+                "        return helper(a)\n\n"
+                "    fx = shard_map(bx, mesh=mx, in_specs=(P('x'),),\n"
+                "                   out_specs=P())\n"
+                "    fy = shard_map(by, mesh=my, in_specs=(P(None, 'y'),),\n"
+                "                   out_specs=P())\n"
+                "    return fx, fy\n",
+        })
+        got = shardaxis.check(root)
+        assert len(got) == 1, [v.render() for v in got]
+        assert got[0].line == 8
+        assert "not bound in the axis environment {y}" in got[0].message
+
+    def test_pmap_axis_name_binds_environment(self, tmp_path):
+        """pmap(f, axis_name=...) declares the axis and binds it in the
+        mapped body; other declared axes are still unbound there."""
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/mod.py":
+                "import jax\n"
+                "import numpy as np\n"
+                "from jax.sharding import Mesh\n\n"
+                "MESH = Mesh(np.array(jax.devices()), ('x',))\n\n\n"
+                "def body(g):\n"
+                "    ok = jax.lax.psum(g, 'batch')\n"
+                "    return ok + jax.lax.psum(g, 'x')\n\n\n"
+                "def build():\n"
+                "    return jax.pmap(body, axis_name='batch')\n",
+        })
+        got = shardaxis.check(root)
+        assert len(got) == 1, [v.render() for v in got]
+        assert got[0].line == 10
+        assert "'x'" in got[0].message
+        assert "not bound in the axis environment {batch}" in got[0].message
+
+    def test_vocabulary_from_constant_table(self, tmp_path):
+        """Axis constants resolve through module-level tables and
+        imports, the way parallel/mesh.py declares them."""
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/meshmod.py":
+                "import jax\nimport numpy as np\n"
+                "from jax.sharding import Mesh\n\n"
+                "AXIS_A = 'a'\nAXES = (AXIS_A, 'b')\n\n\n"
+                "def build():\n"
+                "    return Mesh(np.array(jax.devices()), AXES)\n",
+            "kungfu_tpu/user.py":
+                "import jax\n"
+                "from kungfu_tpu.meshmod import AXIS_A\n\n\n"
+                "def ok(g):\n"
+                "    return jax.lax.psum(g, AXIS_A)\n\n\n"
+                "def bad(g):\n"
+                "    return jax.lax.psum(g, 'c')\n",
+        })
+        got = shardaxis.check(root)
+        assert len(got) == 1, [v.render() for v in got]
+        assert got[0].path.endswith("user.py") and "'c'" in got[0].message
+
+
+class TestShardSpec:
+    """PartitionSpec validity: axis-vs-mesh, duplicates, and
+    in_specs/out_specs arity against the mapped function."""
+
+    def test_fixture_violations_caught(self, tmp_path):
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": "shard_spec_bad.py"})
+        got = sorted((v.line, v.message) for v in shardspec.check(root))
+        assert [line for line, _ in got] == [18, 21, 23, 30, 33, 40], got
+        assert "declares only {x, y}" in got[0][1]          # in_specs axis
+        assert "twice" in got[1][1]                          # duplicate
+        assert "takes 2 positional parameter(s)" in got[2][1]  # in arity
+        assert "returns a 2-tuple" in got[3][1]              # out arity
+        assert "NamedSharding" in got[4][1]                  # NamedSharding
+        assert "no Mesh/pmap in the tree declares" in got[5][1]  # vocab
+
+    def test_suppression_honored(self, tmp_path):
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": "shard_spec_bad.py"})
+        # the waived P("qq") (allow line) must not surface
+        assert all("qq" not in v.message for v in shardspec.check(root))
+
+    def test_unconstrained_dims_clean(self, tmp_path):
+        """PartitionSpec(None, 'x') — None is an unconstrained dim."""
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/mod.py":
+                "import jax\nimport numpy as np\n"
+                "from jax.sharding import Mesh, PartitionSpec as P\n\n\n"
+                "def build():\n"
+                "    mesh = Mesh(np.array(jax.devices()), ('x',))\n"
+                "    return P(None, 'x'), P(), P(('x',), None)\n",
+        })
+        assert shardspec.check(root) == [], \
+            [v.render() for v in shardspec.check(root)]
+
+
+class TestRecompileHazard:
+    """Resize-safety: membership constants, static-arg hazards, and
+    world-size closure leaks in compiled code."""
+
+    def test_fixture_violations_caught(self, tmp_path):
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": "recompile_bad.py"})
+        got = sorted((v.line, v.message)
+                     for v in recompilehazard.check(root))
+        assert [line for line, _ in got] == [10, 11, 12, 22, 31, 32, 33], got
+        assert "device_count()" in got[0][1]
+        assert "len(peers)" in got[1][1]
+        assert "environment read" in got[2][1]
+        assert "closes over `world`" in got[3][1]
+        assert "per-step-varying" in got[4][1]
+        assert "out of range" in got[5][1]
+        assert "static_argnames" in got[6][1]
+
+    def test_suppression_honored(self, tmp_path):
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": "recompile_bad.py"})
+        assert all(v.line != 13 for v in recompilehazard.check(root))
+
+    def test_epoch_scoped_comm_not_flagged(self, tmp_path):
+        """comm.size closed into a per-epoch step builder is the
+        SANCTIONED pattern (zero.py) — it must stay clean."""
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": "recompile_bad.py"})
+        flagged = {v.line for v in recompilehazard.check(root)}
+        assert not any(line >= 37 for line in flagged), flagged
+
+    def test_mesh_closure_not_flagged(self, tmp_path):
+        """Closing over a Mesh built from jax.devices() is THE shard_map
+        pattern — the mesh is rebuilt per epoch by construction."""
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": "shard_good.py"})
+        assert recompilehazard.check(root) == [], \
+            [v.render() for v in recompilehazard.check(root)]
+
+
+class TestShardMutationProof:
+    """The acceptance criterion: a one-token axis-name flip in
+    parallel/tp.py (or train.py) and a one-axis PartitionSpec flip in
+    parallel/zero.py must flip kflint red; the unmutated files pass all
+    three rules with no baseline."""
+
+    _FILES = ("mesh.py", "tp.py", "zero.py", "train.py", "ring.py",
+              "moe.py")
+
+    def _tree(self, tmp_path, mutate=None):
+        files = {}
+        for fn in self._FILES:
+            src = open(os.path.join(
+                ROOT, "kungfu_tpu", "parallel", fn)).read()
+            if mutate and fn in mutate:
+                mutated = mutate[fn](src)
+                assert mutated != src, f"mutation must change {fn}"
+                src = mutated
+            files[f"kungfu_tpu/parallel/{fn}"] = src
+        return _tmp_tree(tmp_path, files)
+
+    def test_unmutated_parallel_clean(self, tmp_path):
+        root = self._tree(tmp_path)
+        assert _shard_check_all(root) == [], \
+            [v.render() for v in _shard_check_all(root)]
+
+    def test_tp_axis_token_flip_caught(self, tmp_path):
+        root = self._tree(tmp_path, mutate={
+            "tp.py": lambda s: s.replace(
+                "jax.lax.psum(g, axis)", 'jax.lax.psum(g, "tq")'),
+        })
+        got = [v for v in shardaxis.check(root)
+               if v.path.endswith("tp.py")]
+        assert got and "'tq'" in got[0].message, \
+            [v.render() for v in shardaxis.check(root)]
+
+    def test_train_axis_token_flip_caught(self, tmp_path):
+        # flipping the ppermute's pipeline axis to a typo'd token
+        root = self._tree(tmp_path, mutate={
+            "train.py": lambda s: s.replace(
+                "jax.lax.ppermute(out, AXIS_PP, perm)",
+                'jax.lax.ppermute(out, "ppx", perm)'),
+        })
+        got = [v for v in shardaxis.check(root)
+               if v.path.endswith("train.py")]
+        assert got and "'ppx'" in got[0].message
+
+    def test_zero_partition_spec_flip_caught(self, tmp_path):
+        root = self._tree(tmp_path, mutate={
+            "zero.py": lambda s: s.replace(
+                "lambda s: P(axes) if s.ndim else P(), state_shapes",
+                "lambda s: P('dq') if s.ndim else P(), state_shapes"),
+        })
+        got = [v for v in shardspec.check(root)
+               if v.path.endswith("zero.py")]
+        assert got and "'dq'" in got[0].message
+
+    def test_mutations_fail_the_cli(self, tmp_path, capsys):
+        """The same flip through the kflint CLI (what check.sh runs)."""
+        root = self._tree(tmp_path, mutate={
+            "tp.py": lambda s: s.replace(
+                "jax.lax.psum(g, axis)", 'jax.lax.psum(g, "tq")'),
+        })
+        args = ["--root", root]
+        for c in SHARD_CHECKERS:
+            args += ["--checker", c]
+        assert cli_main(args) == 1
+        capsys.readouterr()
+
+
+class TestJitSyncInterprocedural:
+    """The migrated jit-sync: host syncs are found at ANY call depth
+    from the jitted root, not one module-local level."""
+
+    def test_depth_two_sync_caught(self, tmp_path):
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": "jit_sync_deep.py"})
+        got = jitpurity.check(root)
+        assert len(got) == 1, [v.render() for v in got]
+        assert got[0].line == 18
+        assert "in jit scope `level2`" in got[0].message
+        assert "called from jitted step" in got[0].message
+
+    def test_static_shape_locals_stay_legal(self, tmp_path):
+        """int() over shape-derived locals (moe.py's capacity math) is
+        trace-static and must not be flagged at interprocedural depth."""
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/mod.py":
+                "import jax\n\n\n"
+                "@jax.jit\n"
+                "def step(x):\n"
+                "    return helper(x)\n\n\n"
+                "def helper(x):\n"
+                "    t = x.shape[0]\n"
+                "    cap = int(max(1, t * 2))\n"
+                "    bad = int(x)\n"
+                "    return cap + bad\n",
+        })
+        got = jitpurity.check(root)
+        assert [v.line for v in got] == [12], [v.render() for v in got]
+
+
+class TestSingleParse:
+    """The kflint perf satellite: one full run parses each file exactly
+    once — the module cache in analysis/core.py is shared by all
+    thirteen rules AND the call graph."""
+
+    def test_each_file_parsed_once_per_run(self, tmp_path):
+        from kungfu_tpu.analysis import core
+
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/utils/envs.py": MINI_REGISTRY,
+            "kungfu_tpu/mod.py": "collective_bad.py",
+            "kungfu_tpu/mod2.py": "shard_axis_bad.py",
+            "kungfu_tpu/mod3.py": "env_bad.py",
+        })
+        core.clear_parse_cache()
+        run_checkers(root)
+        counts = {p: c for p, c in core.PARSE_COUNTS.items()
+                  if p.startswith(str(tmp_path))}
+        assert len(counts) == 4, counts
+        assert all(c == 1 for c in counts.values()), counts
+
+    def test_cache_invalidates_on_rewrite(self, tmp_path):
+        """Rewriting a file between runs re-parses it (stat-keyed cache,
+        so fixture tests that mutate trees stay correct)."""
+        import time
+
+        from kungfu_tpu.analysis import core
+
+        mod = tmp_path / "kungfu_tpu" / "mod.py"
+        _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": "env_bad.py"})
+        core.clear_parse_cache()
+        first = core.parse_module(str(mod))
+        mod.write_text("x = 1\n")
+        second = core.parse_module(str(mod))
+        assert first.source != second.source
+        assert core.PARSE_COUNTS[str(mod)] == 2
+
+
+class TestReviewRegressions:
+    """Pins for the code-review findings on the kf-shard landing."""
+
+    def test_bound_method_shard_map_arity_clean(self, tmp_path):
+        """shard_map(self._body, ...) diffs in_specs against the CALLED
+        arity — `self` must not count as a missing spec entry."""
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/mod.py":
+                "import jax\nimport numpy as np\n"
+                "from jax.experimental.shard_map import shard_map\n"
+                "from jax.sharding import Mesh, PartitionSpec as P\n\n\n"
+                "class Owner:\n"
+                "    def __init__(self):\n"
+                "        self.mesh = Mesh(np.array(jax.devices()), ('x',))\n\n"
+                "    def _body(self, a):\n"
+                "        return a\n\n"
+                "    def build(self):\n"
+                "        return shard_map(self._body, mesh=self.mesh,\n"
+                "                         in_specs=(P('x'),),\n"
+                "                         out_specs=P('x'))\n",
+        })
+        assert shardspec.check(root) == [], \
+            [v.render() for v in shardspec.check(root)]
+
+    def test_all_gather_dim_kwarg_does_not_shadow_axis(self, tmp_path):
+        """lax.all_gather(g, 'typo', axis=0): the int DIMENSION kwarg
+        must not shadow the positional axis-NAME typo."""
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/mod.py":
+                "import jax\nimport numpy as np\n"
+                "from jax.sharding import Mesh\n\n"
+                "MESH = Mesh(np.array(jax.devices()), ('x',))\n\n\n"
+                "def f(g):\n"
+                "    return jax.lax.all_gather(g, 'tq', axis=0, tiled=True)\n",
+        })
+        got = shardaxis.check(root)
+        assert len(got) == 1 and "'tq'" in got[0].message, \
+            [v.render() for v in got]
+
+    def test_traced_prod_get_still_syncs(self, tmp_path):
+        """float(x.prod()) / state.get() on traced values are host
+        syncs; int(os.environ.get(...)) is trace-static config."""
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/mod.py":
+                "import os\n\nimport jax\n\n\n"
+                "@jax.jit\n"
+                "def step(x):\n"
+                "    bad = float(x.prod())\n"
+                "    ok = int(os.environ.get('KF_K', '4'))\n"
+                "    return bad + ok\n",
+        })
+        got = jitpurity.check(root)
+        assert [v.line for v in got] == [8], [v.render() for v in got]
+
+    def test_clear_parse_cache_cascades_to_derived_caches(self, tmp_path):
+        """Rewriting a file in the SAME root + clear_parse_cache() must
+        re-derive the call graph and axis environment — stale caches
+        would silently return the pre-rewrite findings."""
+        from kungfu_tpu.analysis import core
+
+        src_ok = (
+            "import jax\nimport numpy as np\n"
+            "from jax.sharding import Mesh\n\n"
+            "MESH = Mesh(np.array(jax.devices()), ('x',))\n\n\n"
+            "def f(g):\n"
+            "    return jax.lax.psum(g, 'x')\n"
+        )
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": src_ok})
+        assert shardaxis.check(root) == []
+        (tmp_path / "kungfu_tpu" / "mod.py").write_text(
+            src_ok.replace("psum(g, 'x')", "psum(g, 'typo')"))
+        core.clear_parse_cache()
+        got = shardaxis.check(root)
+        assert len(got) == 1 and "'typo'" in got[0].message, \
+            [v.render() for v in got]
+
+    def test_syntax_error_file_fails_the_suite(self, tmp_path):
+        """An unparseable module is invisible to every rule — jit-sync
+        owns surfacing it so the suite can't go green unanalyzed."""
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/mod.py": "def broken(:\n    pass\n",
+        })
+        got = jitpurity.check(root)
+        assert len(got) == 1, [v.render() for v in got]
+        assert "syntax error prevents analysis" in got[0].message
+
+    def test_module_level_jit_wrapping_in_scope(self, tmp_path):
+        """`train_step = jax.jit(step)` at module level enters jit
+        scope — the pre-callgraph checker saw these; the axisenv map
+        must too."""
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/mod.py":
+                "import jax\n\n\n"
+                "def step(x):\n"
+                "    return x.item()\n\n\n"
+                "train_step = jax.jit(step)\n",
+        })
+        got = jitpurity.check(root)
+        assert len(got) == 1 and got[0].line == 5, \
+            [v.render() for v in got]
+
+    def test_np_prod_on_traced_value_still_syncs(self, tmp_path):
+        """float(np.prod(x)) concretizes a tracer — flagged; shape-fed
+        np.prod stays trace-static."""
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/mod.py":
+                "import jax\nimport numpy as np\n\n\n"
+                "@jax.jit\n"
+                "def step(x):\n"
+                "    bad = float(np.prod(x))\n"
+                "    ok = int(np.prod(x.shape))\n"
+                "    return bad + ok\n",
+        })
+        got = jitpurity.check(root)
+        assert [v.line for v in got] == [7], [v.render() for v in got]
+
+    def test_kwonly_static_argnames_clean(self, tmp_path):
+        """Keyword-only params are legal static_argnames targets."""
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/mod.py":
+                "import jax\n\n\n"
+                "def f(x, *, donate):\n"
+                "    return x if donate else -x\n\n\n"
+                "g = jax.jit(f, static_argnames='donate')\n",
+        })
+        assert recompilehazard.check(root) == [], \
+            [v.render() for v in recompilehazard.check(root)]
+
+    def test_restricted_dirs_exclude_scan_files(self, tmp_path):
+        """iter_py_files(dirs=('kungfu_tpu',)) must not widen to the
+        top-level scan files a deliberately-scoped rule excluded."""
+        from kungfu_tpu.analysis.core import iter_py_files
+
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/mod.py": "x = 1\n",
+            "__graft_entry__.py": "y = 2\n",
+        })
+        default = {os.path.basename(p) for p in iter_py_files(root)}
+        narrowed = {os.path.basename(p)
+                    for p in iter_py_files(root, dirs=("kungfu_tpu",))}
+        assert "__graft_entry__.py" in default
+        assert "__graft_entry__.py" not in narrowed
+
+    def test_nested_binding_definition_order_independent(self, tmp_path):
+        """The inner-mesh body defined BEFORE the function that maps the
+        outer body: the fixpoint must not freeze a stale inner-only
+        context (definition-order-dependent false positive)."""
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/mod.py":
+                "import jax\nimport numpy as np\n"
+                "from jax.experimental.shard_map import shard_map\n"
+                "from jax.sharding import Mesh, PartitionSpec as P\n\n"
+                "INNER = Mesh(np.array(jax.devices()[:2]), ('y',))\n"
+                "OUTER = Mesh(np.array(jax.devices()), ('x',))\n\n\n"
+                "def outer_body(a):\n"
+                "    def inner_body(b):\n"
+                "        s = jax.lax.psum(b, 'y')\n"
+                "        return jax.lax.psum(s, 'x')\n\n"
+                "    return shard_map(inner_body, mesh=INNER,\n"
+                "                     in_specs=(P('y'),),\n"
+                "                     out_specs=P('y'))(a)\n\n\n"
+                "def make():\n"
+                "    return shard_map(outer_body, mesh=OUTER,\n"
+                "                     in_specs=(P('x'),),\n"
+                "                     out_specs=P('x'))\n",
+        })
+        assert shardaxis.check(root) == [], \
+            [v.render() for v in shardaxis.check(root)]
+
+    def test_lax_axis_size_is_trace_static(self, tmp_path):
+        """int(lax.axis_size(...)) is the suite's own prescribed remedy
+        for membership constants — jit-sync must not flag it."""
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/mod.py":
+                "import jax\nimport numpy as np\n"
+                "from jax import lax\n"
+                "from jax.sharding import Mesh\n\n"
+                "MESH = Mesh(np.array(jax.devices()), ('dp',))\n\n\n"
+                "@jax.jit\n"
+                "def step(x):\n"
+                "    n = int(lax.axis_size('dp'))\n"
+                "    return x / n\n",
+        })
+        assert jitpurity.check(root) == [], \
+            [v.render() for v in jitpurity.check(root)]
+
+    def test_bound_method_jit_wrapping_in_scope(self, tmp_path):
+        """`train = jax.jit(t.step)` marks the same-module method as
+        traced (the pre-callgraph over-report stance for jit SCOPE)."""
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/mod.py":
+                "import jax\n\n\n"
+                "class Trainer:\n"
+                "    def step(self, x):\n"
+                "        return x.item()\n\n\n"
+                "t = Trainer()\n"
+                "train = jax.jit(t.step)\n",
+        })
+        got = jitpurity.check(root)
+        assert len(got) == 1 and got[0].line == 6, \
+            [v.render() for v in got]
+
+    def test_decorator_pmap_declares_and_binds_axis(self, tmp_path):
+        """@partial(jax.pmap, axis_name='batch') declares the axis AND
+        binds it in the decorated body; other axes stay unbound."""
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/mod.py":
+                "from functools import partial\n\n"
+                "import jax\n\n\n"
+                "@partial(jax.pmap, axis_name='batch')\n"
+                "def ok(g):\n"
+                "    return jax.lax.psum(g, 'batch')\n\n\n"
+                "@partial(jax.pmap, axis_name='batch')\n"
+                "def bad(g):\n"
+                "    return jax.lax.psum(g, 'other')\n",
+        })
+        got = shardaxis.check(root)
+        assert len(got) == 1 and got[0].line == 13, \
+            [v.render() for v in got]
+        assert "'other'" in got[0].message
+
+    def test_import_resolution_needs_dotted_boundary(self, tmp_path):
+        """`from core import f` (out-of-tree) must not suffix-match an
+        unrelated in-tree module and mark its `f` as jitted."""
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/score.py":
+                "def f(x):\n"
+                "    return x.item()\n",
+            "kungfu_tpu/user.py":
+                "import jax\n"
+                "from core import f\n\n"
+                "g = jax.jit(f)\n",
+        })
+        assert jitpurity.check(root) == [], \
+            [v.render() for v in jitpurity.check(root)]
+
+    def test_repeated_constant_references_resolve(self, tmp_path):
+        """AXES = (A, B) with A and B aliasing the same constant must
+        still evaluate (the cycle guard is a stack, not a visited set)."""
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/mod.py":
+                "import jax\nimport numpy as np\n"
+                "from jax.sharding import Mesh\n\n"
+                "AXIS_DP = 'dp'\n"
+                "A = AXIS_DP\n"
+                "B = AXIS_DP\n"
+                "AXES = (A, B)\n"
+                "MESH = Mesh(np.array(jax.devices()), AXES)\n\n\n"
+                "def f(g):\n"
+                "    return jax.lax.psum(g, 'dp')\n",
+        })
+        assert shardaxis.check(root) == [], \
+            [v.render() for v in shardaxis.check(root)]
+
+    def test_static_local_chain_in_reverse_order(self, tmp_path):
+        """A 4-link shape-derived chain assigned in reverse textual
+        order is still trace-static (closure runs to convergence)."""
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/mod.py":
+                "import jax\n\n\n"
+                "@jax.jit\n"
+                "def step(x):\n"
+                "    for _ in range(2):\n"
+                "        d = c * 2\n"
+                "        c = b * 2\n"
+                "        b = a * 2\n"
+                "        a = x.shape[0]\n"
+                "    return int(d) + x\n",
+        })
+        assert jitpurity.check(root) == [], \
+            [v.render() for v in jitpurity.check(root)]
+
+    def test_parse_cache_one_entry_per_path(self, tmp_path):
+        """A rewritten file REPLACES its cache entry (no unbounded
+        accumulation of historical parses)."""
+        import time
+
+        from kungfu_tpu.analysis import core
+
+        mod = tmp_path / "kungfu_tpu" / "mod.py"
+        _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": "x = 1\n"})
+        core.clear_parse_cache()
+        core.parse_module(str(mod))
+        for i in range(5):
+            mod.write_text(f"x = {i} + 100\n" * (i + 1))
+            core.parse_module(str(mod))
+        entries = [k for k in core._MODULE_CACHE if k == str(mod)]
+        assert len(entries) == 1, core._MODULE_CACHE.keys()
