@@ -5,7 +5,7 @@
 PY ?= python3
 BASELINE := tests/lint_baseline.json
 
-.PHONY: lint verify shardcheck check test native trace-demo help
+.PHONY: lint verify shardcheck check test native trace-demo zero-demo help
 
 ## lint: all thirteen kf-lint rules — the Python suite (env-contract,
 ## jit-sync, blocking-io, retry-discipline, collective-consistency,
@@ -56,6 +56,17 @@ trace-demo:
 	    $(PY) examples/mnist_slp.py --n-epochs 1
 	$(PY) scripts/kftrace merge -o trace-demo/trace.json trace-demo/*.jsonl
 	$(PY) scripts/kftrace report trace-demo/*.jsonl
+
+## zero-demo: 4-process host-plane ZeRO-2 run through a LIVE 4->2
+## shrink (rank 3 dies at step 3, rank 1 at step 5): reduce-scatter
+## gradient chunks, 1/n momentum per rank with ring-buddy mirrors, and
+## a leaderless optimizer-state re-carve on each death — survivors
+## finish on 2 workers and print the final params (bitwise-checkable
+## against a fixed-world numpy replay; see docs/zero.md).
+zero-demo:
+	$(PY) -m kungfu_tpu.runner.cli -np 4 -tolerate-failures \
+	    -chaos 'die:step=3,rank=3;die:step=5,rank=1' \
+	    $(PY) examples/zero_shrink.py --n-steps 8
 
 help:
 	@grep -E '^## ' Makefile | sed 's/^## //'

@@ -842,7 +842,9 @@ def payload_allreduce(args) -> dict:
         # a virtual N-device CPU mesh: the same shard_map/psum collective
         # code path the TPU runs, minus the ICI (scaling-shape artifact,
         # not a bandwidth claim).  Must precede any backend init.
-        jax.config.update("jax_num_cpu_devices", args.cpu_mesh)
+        from kungfu_tpu.utils.jaxcompat import set_cpu_device_count
+
+        set_cpu_device_count(args.cpu_mesh)
         jax.config.update("jax_platforms", "cpu")
 
     import jax.numpy as jnp
@@ -928,11 +930,169 @@ def payload_allreduce(args) -> dict:
     return out
 
 
+def payload_zero(args) -> dict:
+    """ZeRO weight-update sharding rows + the bare shard_map/psum
+    framework-tax baseline (ROADMAP #1's ``benchmark_horovod.py``
+    analog): the SAME model and chained-K harness timed four ways —
+
+    * ``bare``  — raw JAX: shard_map + per-leaf ``lax.psum`` + optax
+      apply, zero framework code in the step;
+    * ``zero1`` — all-reduce grads, sharded update (the framework's
+      measured comm baseline);
+    * ``zero2`` — bucketed reduce-scatter grads (the claim under test:
+      gradient wire bytes <= ~55% of zero1's);
+    * ``zero3`` — zero2 + parameters sharded 1/n between steps.
+
+    Comm bytes are READ FROM THE TRACED PROGRAM
+    (:func:`kungfu_tpu.ops.schedules.traced_collective_bytes`), not from
+    the motivating formula, so a silent all-reduce would show up as 2x;
+    the partitioner-inserted stage-1/2 param all-gather is reported
+    analytically (``analytic_*``).  Per-rank optimizer memory is the
+    worst-device footprint (:func:`opt_state_bytes_per_device`) — the
+    number the ZeRO memory claim is about."""
+    if args.cpu_mesh:
+        # must land before backend init (this payload runs in a fresh
+        # guarded subprocess, so the backend is still cold here)
+        from kungfu_tpu.utils.jaxcompat import set_cpu_device_count
+
+        set_cpu_device_count(args.cpu_mesh)
+
+    import jax
+
+    if args.cpu_mesh or args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from kungfu_tpu.comm.device import Communicator
+    from kungfu_tpu.ops.schedules import traced_collective_bytes
+    from kungfu_tpu.parallel.zero import (opt_state_bytes,
+                                          opt_state_bytes_per_device,
+                                          zero_train_step)
+    from kungfu_tpu.utils.jaxcompat import shard_map
+
+    devs = jax.devices()
+    n = len(devs)
+    comm = Communicator(devices=devs, local_size=n)
+    mesh, axis = comm.mesh, comm.axis
+    ax_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+
+    d = 256 if args.quick else 512
+    rng = np.random.default_rng(0)
+    params = {
+        f"w{i}": jnp.asarray(
+            rng.standard_normal((d, d)) / np.sqrt(d), jnp.float32)
+        for i in range(3)
+    }
+    xb = jnp.asarray(rng.standard_normal((2 * n, d)), jnp.float32)
+    yb = jnp.asarray(rng.standard_normal((2 * n, d)), jnp.float32)
+    batch = (xb, yb)
+
+    def loss_fn(p, b):
+        x, y = b
+        h = x
+        for i in range(3):
+            h = jnp.tanh(h @ p[f"w{i}"])
+        return jnp.mean((h - y) ** 2)
+
+    def inner():
+        return optax.adam(1e-3)
+
+    # -- bare shard_map + psum: the no-framework floor ---------------------
+    tx = inner()
+    o_bare = tx.init(params)
+
+    def bare_body(p, o, b):
+        loss, g = jax.value_and_grad(loss_fn)(p, b)
+        g = jax.tree_util.tree_map(lambda a: lax.psum(a, axis) / n, g)
+        updates, o = tx.update(g, o, p)
+        p = optax.apply_updates(p, updates)
+        return p, o, lax.pmean(loss, axis)
+
+    bare_step = jax.jit(shard_map(
+        bare_body, mesh=mesh,
+        in_specs=(P(), P(), P(axis)), out_specs=(P(), P(), P()),
+    ))
+
+    # scalar-loss carry: iteration i perturbs the (closed-over) params
+    # by 1e-8 x the previous loss, so the chain has a real data
+    # dependence and no two iterations are CSE-identical
+    contestants = {}
+
+    contestants["bare"] = lambda c: bare_step(
+        jax.tree_util.tree_map(lambda a: a + c * 1e-8, params),
+        o_bare, batch)[2]
+
+    zsteps, rows = {}, {}
+    for stage in (1, 2, 3):
+        z = zero_train_step(loss_fn, inner(), comm, stage=stage)
+        o = z.init_opt(params)
+        p0 = z.init_params(params)
+        zsteps[stage] = (z, p0, o)
+        contestants[f"zero{stage}"] = (
+            lambda c, z=z, p0=p0, o=o: z.step(
+                jax.tree_util.tree_map(lambda a: a + c * 1e-8, p0),
+                o, batch)[2])
+
+    t = measure_group(contestants, jnp.float32(0.0),
+                      rounds=1 if args.quick else 3, target_sep=0.1)
+
+    # -- comm bytes from the traced programs -------------------------------
+    traced = {"bare": traced_collective_bytes(
+        lambda p, o, b: bare_step(p, o, b), params, o_bare, batch,
+        axis_sizes=ax_sizes)}
+    for stage, (z, p0, o) in zsteps.items():
+        traced[f"zero{stage}"] = traced_collective_bytes(
+            lambda p_, o_, b_, z=z: z.step(p_, o_, b_), p0, o, batch,
+            axis_sizes=ax_sizes)
+
+    full_state = opt_state_bytes(o_bare)  # replicated: full on EVERY rank
+    for name in ("bare", "zero1", "zero2", "zero3"):
+        row = {
+            "step_ms": (None if t.get(name) is None
+                        else round(t[name] * 1e3, 4)),
+            "traced_comm_bytes_per_rank": {
+                k: round(v, 1) for k, v in traced[name].items()},
+        }
+        if name == "bare":
+            row["opt_state_bytes_per_rank"] = full_state
+        else:
+            stage = int(name[-1])
+            z, p0, o = zsteps[stage]
+            row["opt_state_bytes_per_rank"] = opt_state_bytes_per_device(o)
+            row["analytic_comm_bytes_per_rank"] = {
+                k: round(v, 1) for k, v in z.comm_bytes(params).items()}
+        rows[name] = row
+
+    grad_ratio = (sum(traced["zero2"].values())
+                  / max(sum(traced["zero1"].values()), 1e-9))
+    return {
+        "metric": "zero2_traced_comm_bytes_vs_zero1",
+        "value": round(grad_ratio, 4),
+        "unit": "x",
+        # the claim: stage 2 moves <= ~55% of the stage-1 gradient bytes
+        "vs_baseline": round(0.55 / grad_ratio, 4) if grad_ratio else 0.0,
+        "vs_baseline_meaning": "0.55 target over measured ratio (>1 = met)",
+        "platform": devs[0].platform,
+        "n_devices": n,
+        "model": f"mlp3x{d} adam ({sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params))} params)",
+        "rows": rows,
+        "framework_tax_zero1_vs_bare": (
+            None if not t.get("bare") or not t.get("zero1")
+            else round(t["zero1"] / t["bare"], 4)),
+    }
+
+
 PAYLOADS = {
     "resnet": payload_resnet,
     "kernels": payload_kernels,
     "allreduce": payload_allreduce,
     "lm": payload_lm,
+    "zero": payload_zero,
 }
 
 
@@ -956,6 +1116,8 @@ def main() -> None:
     p.add_argument("--allreduce", action="store_true", help="allreduce GiB/s")
     p.add_argument("--lm", action="store_true",
                    help="GPT-small training with the kernels in anger")
+    p.add_argument("--zero", action="store_true",
+                   help="ZeRO stage rows + bare shard_map/psum baseline")
     p.add_argument("--payload", choices=sorted(PAYLOADS), default=None,
                    help=argparse.SUPPRESS)  # internal: run in-process
     p.add_argument("--timeout", type=float, default=PAYLOAD_TIMEOUT_S)
@@ -967,7 +1129,7 @@ def main() -> None:
         return
 
     which = ("kernels" if args.kernels else "allreduce" if args.allreduce
-             else "lm" if args.lm else "resnet")
+             else "lm" if args.lm else "zero" if args.zero else "resnet")
     fwd = ["--payload", which]
     for flag, val in [
         ("--batch-size", args.batch_size), ("--image-size", args.image_size),
@@ -1038,6 +1200,7 @@ def main() -> None:
                           "tpu_allreduce_floor"),
             "lm": ("gpt_small_sync_sgd_tokens_per_sec_per_chip",
                    "tokens/sec", "tpu_lm"),
+            "zero": ("zero2_traced_comm_bytes_vs_zero1", "x", "tpu_zero"),
         }
         metric, unit, section = payload_info[which]
         out = {

@@ -137,7 +137,9 @@ def main(argv=None) -> dict:
         import jax
 
         # before any backend init; env vars are too late when jax is preloaded
-        jax.config.update("jax_num_cpu_devices", args.cpu_mesh)
+        from kungfu_tpu.utils.jaxcompat import set_cpu_device_count
+
+        set_cpu_device_count(args.cpu_mesh)
         jax.config.update("jax_platforms", "cpu")
 
     if args.backend == "device":

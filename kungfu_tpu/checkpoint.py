@@ -340,8 +340,28 @@ class StepSnapshot:
         self._meta: Optional[dict] = None
 
     def commit(self, step: int, tree, meta: Optional[dict] = None) -> None:
-        """Record ``tree`` as the committed state *after* step ``step``."""
-        host_tree = jax.tree_util.tree_map(lambda l: np.array(l), tree)
+        """Record ``tree`` as the committed state *after* step ``step``.
+
+        Leaves must be locally addressable — this snapshot is a FULL host
+        copy, the thing the shrink leader can broadcast whole.  State that
+        is deliberately 1/n-sharded across processes (ZeRO optimizer
+        shards, stage-3 parameter shards) must ride its own
+        :class:`kungfu_tpu.elastic.reshard.ZeroBoundary` instead, whose
+        re-carve is leaderless by design; commit the replicated leaves
+        (params, step counters) here and the shard there."""
+        def host_copy(l):
+            if (isinstance(l, jax.Array) and not l.is_fully_addressable
+                    and not l.is_fully_replicated):
+                raise ValueError(
+                    "StepSnapshot.commit needs fully-addressable leaves "
+                    "(it is a full host copy, broadcast whole on replay); "
+                    "ZeRO-sharded state belongs in "
+                    "kungfu_tpu.elastic.reshard.ZeroBoundary — see "
+                    "docs/zero.md"
+                )
+            return np.array(l)
+
+        host_tree = jax.tree_util.tree_map(host_copy, tree)
         with self._lock:
             self._step = step
             self._tree = host_tree

@@ -54,6 +54,7 @@ def elastic_step(
     state: ElasticState,
     schedule: Optional[str],
     params,
+    zero_boundary=None,
 ) -> Tuple[ElasticState, object, bool]:
     """Run once per completed training step.
 
@@ -75,6 +76,7 @@ def elastic_step(
     step = sync_step(peer, state.step)
     target = step_based_schedule(schedule, step) if schedule else peer.size()
     changed = False
+    old_workers = peer.cluster.workers  # pre-resize membership (recarve)
     if target != peer.size():
         log_event(f"proposing-resize-{peer.size()}->{target}-at-step-{step}")
         if peer.config.config_server:
@@ -83,6 +85,39 @@ def elastic_step(
         else:
             _log.warning("no config server; cannot resize to %d", target)
     if changed:
+        if zero_boundary is not None:
+            # ZeRO-sharded optimizer state does not ride the params
+            # broadcast (each rank holds 1/n): re-carve the committed
+            # boundary leaderlessly for the new membership.  This runs
+            # BEFORE the detach check — a planned resize's leavers are
+            # alive and must serve their segments (nobody died, so no
+            # ``dead`` set); survivors then restore the sharded state
+            # with ``zero_boundary.place(new communicator)``.
+            #
+            # The exchange is symmetric: every NEW rank must be running
+            # the same recarve.  elastic_step cannot arrange that for a
+            # pure joiner (a fresh process sees `changed=False` here; a
+            # rejoining standby adopted the cluster in await_rejoin) —
+            # its side of the wiring is ZeroBoundary.join() + recarve
+            # with the same memberships and tag, which only the
+            # application can place in the joiner's startup path.
+            # Proceeding would strand the joiner's segments in its
+            # channel queue and leave it training on init_opt zeros, so
+            # grows with unwired joiners fail loudly instead.
+            joiners = [w for w in peer.cluster.workers
+                       if old_workers.rank(w) is None]
+            if joiners:
+                raise ValueError(
+                    f"elastic_step cannot re-carve ZeRO state through a "
+                    f"grow with pure joiners ({len(joiners)} new "
+                    "worker(s)): joiners must symmetrically run "
+                    "ZeroBoundary.join() + recarve in their startup path "
+                    "(see docs/zero.md), or restore from a checkpoint")
+            zero_boundary.recarve(
+                peer.size(), peer=peer, old_workers=old_workers,
+                new_workers=peer.cluster.workers,
+                tag=f"v{peer.cluster_version}",
+            )
         if peer.detached:
             log_event("detached-stopping")
             return replace(state, detached=True), params, True

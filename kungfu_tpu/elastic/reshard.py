@@ -1,0 +1,585 @@
+"""Elastic re-sharding of ZeRO weight-update state from the step boundary.
+
+ZeRO-sharded optimizer state (``parallel/zero.py``) has *geometry*: each
+rank holds the contiguous ``ceil(total/n)`` chunk of every flat state
+vector that the mesh-major scatter assigned it.  An elastic membership
+change (schedule-driven resize, or shrink-to-survivors after a peer
+death) changes ``n`` — the state must be **re-carved**, and the existing
+machinery had two ways to do it, both wrong for the in-flight case:
+
+* ``zero1_snapshot``/``zero1_restore`` funnels the full state through
+  rank 0's host RAM (a leader gather — exactly what a shrink cannot
+  rely on, and O(state_bytes) on one host);
+* ``zero1_reshard`` re-places *live* arrays — but after a peer death the
+  live arrays of the dead rank are gone.
+
+This module generalizes the repad logic to **arbitrary old→new world
+sizes without gathering to a leader**, working directly from the
+committed step boundary (the same boundary
+:class:`kungfu_tpu.checkpoint.StepSnapshot` replays params from):
+
+* :class:`ZeroBoundary` — per-rank host copy of the ZeRO state at the
+  last committed step: the full flat vectors when they are locally
+  addressable (single-controller worlds, the CPU-mesh harness), or this
+  rank's chunk when the state is distributed (multi-controller), plus
+  the replicated scalar leaves and the geometry ``(step, total, old_n)``.
+* :meth:`ZeroBoundary.replicate_ring` — optional ring-buddy redundancy
+  for chunk-mode worlds: each rank mirrors its successor's chunk
+  (``O(total/n)`` wire bytes, off the step path), so a *single* dead
+  rank's chunk survives on its predecessor and an unplanned shrink can
+  still re-carve without any global snapshot.
+* :func:`recarve` — the segment-exchange itself, driven by the pure
+  :func:`kungfu_tpu.parallel.zero.reshard_plan` every rank computes
+  identically: each surviving old rank serves exactly the segments of
+  its chunk (or its dead successor's buddy copy) that the new geometry
+  assigns elsewhere; each new rank assembles its chunk from those
+  segments.  Per-rank traffic is ``O(total/old_n + total/new_n)``; no
+  rank ever holds more than a buddy's worth beyond its own shard.
+
+The re-carve is **bitwise**: segments move untouched (numpy slices on
+the host plane), padding is zeros by construction on both sides, so
+training after the re-carve continues exactly as a fixed-size world
+restored from the same boundary would — the property the tier-1 tests
+pin against a non-elastic run.
+"""
+
+from __future__ import annotations
+
+import io
+import math
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kungfu_tpu.monitor import timeline
+from kungfu_tpu.utils.log import get_logger
+
+_log = get_logger("reshard")
+
+
+def _vector_indices(leaves) -> List[int]:
+    return [i for i, l in enumerate(leaves) if getattr(l, "ndim", 0) >= 1]
+
+
+def _recv_or_fail(chan, addr, old_rank: int, op: str, name: str) -> bytes:
+    """Receive one reshard frame, converting a raw channel timeout into
+    the typed :class:`~kungfu_tpu.comm.faults.PeerFailureError` the
+    recovery contract promises.  The engine's ``_recv`` does exactly
+    this for step collectives; the reshard exchange runs INSIDE the
+    recovery path, where callers catch ``PeerFailureError`` to re-enter
+    recovery — a leaked ``TimeoutError`` (a second death mid-exchange)
+    would crash the survivor instead."""
+    from kungfu_tpu.comm.faults import PeerFailureError
+
+    try:
+        return chan.recv(addr, name)
+    except PeerFailureError:
+        raise
+    except (TimeoutError, OSError) as e:
+        raise PeerFailureError(old_rank, peer=addr, op=op,
+                               phase=f"recv {name!r}", cause=e) from e
+
+
+class ZeroBoundary:
+    """Host-side committed boundary of a ZeRO-sharded optimizer state.
+
+    Commit once per applied step (cheap: a host copy of this rank's
+    shard — the ``StepSnapshot`` discipline applied to sharded state).
+    After a membership change, :meth:`recarve` rebuilds the state for
+    the new world size and :meth:`place` puts it back on the new mesh
+    epoch.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._step: Optional[int] = None
+        self._treedef = None
+        self._total: Optional[int] = None
+        self._old_n: Optional[int] = None
+        self._my_old: Optional[int] = None
+        self._chunk: Optional[int] = None
+        #: vector leaves: {leaf_index: np chunk-or-full}
+        self._vec: Dict[int, np.ndarray] = {}
+        self._full_mode = True
+        #: scalar (replicated) leaves: {leaf_index: np}
+        self._scal: Dict[int, np.ndarray] = {}
+        #: ring-buddy mirror of the successor's chunks (chunk mode)
+        self._buddy: Dict[int, np.ndarray] = {}
+        self._buddy_of: Optional[int] = None
+        #: vector leaf dtypes (survives even when a joiner holds no data)
+        self._vec_dtypes: Dict[int, np.dtype] = {}
+
+    # -- commit -----------------------------------------------------------
+    def commit(self, step: int, opt_shard, params) -> None:
+        """Record the ZeRO state as of completed step ``step``.
+
+        ``params`` supplies the true (unpadded) parameter count — the
+        re-carve must not move old-geometry padding into a smaller new
+        padded total.  Leaves are host-copied: ``np.array`` (a real
+        copy) so later donated-buffer reuse cannot corrupt the boundary.
+        """
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(opt_shard)
+        total = int(
+            sum(int(np.prod(l.shape)) for l in
+                jax.tree_util.tree_leaves(params))
+        )
+        vec_idx = _vector_indices(leaves)
+        full_mode = True
+        old_n = 1
+        my_old = 0
+        chunk = total
+        for i in vec_idx:
+            leaf = leaves[i]
+            if hasattr(leaf, "is_fully_addressable") \
+                    and not leaf.is_fully_addressable:
+                full_mode = False
+            if hasattr(leaf, "sharding"):
+                old_n = max(old_n, len(leaf.sharding.device_set))
+        vec: Dict[int, np.ndarray] = {}
+        scal: Dict[int, np.ndarray] = {}
+        if full_mode:
+            chunk = math.ceil(total / old_n) if old_n else total
+            for i, l in enumerate(leaves):
+                (vec if i in vec_idx else scal)[i] = np.array(l)
+        else:
+            for i, l in enumerate(leaves):
+                if i not in vec_idx:
+                    scal[i] = np.array(l)
+                    continue
+                shards = l.addressable_shards
+                if len(shards) != 1:
+                    raise NotImplementedError(
+                        "ZeroBoundary chunk mode assumes one device per "
+                        f"process; this process holds {len(shards)} shards")
+                s = shards[0]
+                off = int(s.index[0].start or 0)
+                data = np.array(s.data)
+                chunk = data.shape[0]
+                my_old = off // chunk if chunk else 0
+                vec[i] = data
+        with self._lock:
+            self._step = int(step)
+            self._treedef = treedef
+            self._total = total
+            self._old_n = old_n
+            self._my_old = my_old
+            self._chunk = chunk
+            self._vec = vec
+            self._scal = scal
+            self._full_mode = full_mode
+            self._vec_dtypes = {i: a.dtype for i, a in vec.items()}
+            # a fresh commit invalidates any buddy mirror of older state
+            self._buddy = {}
+            self._buddy_of = None
+
+    def commit_local(self, step: int, opt_chunk_tree, total: int,
+                     old_n: int, my_old: int) -> None:
+        """Chunk-mode commit for host-plane ZeRO workers: each process
+        holds its optimizer state over its OWN ``ceil(total/old_n)``
+        chunk as host arrays (the ``engine.reduce_scatter`` training
+        path — one process per rank, no shared mesh).  Vector leaves
+        must be exactly one chunk long; scalar leaves are replicated."""
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(opt_chunk_tree)
+        chunk = math.ceil(total / old_n) if old_n else int(total)
+        vec_idx = set(_vector_indices(leaves))
+        vec, scal = {}, {}
+        for i, l in enumerate(leaves):
+            a = np.array(l)
+            if i in vec_idx:
+                if a.shape != (chunk,):
+                    raise ValueError(
+                        f"state leaf {i} has shape {a.shape}, expected one "
+                        f"({chunk},) chunk of total={total} over "
+                        f"{old_n} ranks")
+                vec[i] = a
+            else:
+                scal[i] = a
+        with self._lock:
+            self._step = int(step)
+            self._treedef = treedef
+            self._total = int(total)
+            self._old_n = int(old_n)
+            self._my_old = int(my_old)
+            self._chunk = chunk
+            self._vec = vec
+            self._scal = scal
+            self._full_mode = False
+            self._vec_dtypes = {i: a.dtype for i, a in vec.items()}
+            self._buddy = {}
+            self._buddy_of = None
+
+    def chunks(self) -> Tuple[int, Dict[int, np.ndarray], Dict[int, np.ndarray]]:
+        """(step, vector chunks, scalars) of the current carve — the
+        host-plane worker reads its re-carved state back through this
+        after :meth:`recarve` (mesh-less worlds have no :meth:`place`)."""
+        with self._lock:
+            return self._step, dict(self._vec), dict(self._scal)
+
+    def join(self, fresh_opt_shard, params, old_n: int) -> None:
+        """Joiner bootstrap: a worker entering an existing world holds no
+        committed chunk, but must still participate in the next
+        :meth:`recarve` as a pure receiver.  ``fresh_opt_shard`` (its own
+        ``init_opt(params)``) supplies the state STRUCTURE and leaf
+        dtypes; ``old_n`` is the incumbent world size the exchange will
+        re-carve from."""
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(fresh_opt_shard)
+        total = int(
+            sum(int(np.prod(l.shape)) for l in
+                jax.tree_util.tree_leaves(params))
+        )
+        vec_idx = set(_vector_indices(leaves))
+        with self._lock:
+            self._step = -1  # no local progress; adopted from the serve side
+            self._treedef = treedef
+            self._total = total
+            self._old_n = int(old_n)
+            self._my_old = None
+            self._chunk = None
+            self._vec = {}
+            self._scal = {i: np.array(l) for i, l in enumerate(leaves)
+                          if i not in vec_idx}
+            self._full_mode = False
+            self._vec_dtypes = {i: np.dtype(leaves[i].dtype)
+                                for i in vec_idx}
+            self._buddy = {}
+            self._buddy_of = None
+
+    def step(self) -> Optional[int]:
+        with self._lock:
+            return self._step
+
+    @property
+    def old_n(self) -> Optional[int]:
+        with self._lock:
+            return self._old_n
+
+    # -- ring-buddy redundancy (chunk mode) -------------------------------
+    def replicate_ring(self, chan, workers, tag: str = "0") -> None:
+        """Mirror this rank's committed chunks onto its ring predecessor
+        (rank ``(r-1) % n``) and adopt the successor's — after this, any
+        SINGLE dead rank's chunk survives on its predecessor and
+        :func:`recarve` can serve it.  ``O(total/n)`` bytes each way,
+        run at a committed step boundary (off the hot path).  ``tag``
+        must be identical on every rank (step number or cluster
+        version)."""
+        with self._lock:
+            if self._step is None:
+                raise ValueError("replicate_ring before any commit")
+            if self._full_mode:
+                return  # full vectors held locally: nothing can be lost
+            vec = dict(self._vec)
+            my_old, n = self._my_old, self._old_n
+        if n is None or n < 2:
+            return
+        pred = workers[(my_old - 1) % n]
+        succ = workers[(my_old + 1) % n]
+        bio = io.BytesIO()
+        np.savez(bio, **{f"v{i}": a for i, a in vec.items()})
+        name = f"kf.zbuddy.{tag}"
+        timeline.event("shrink", "buddy-replicate", rank=my_old,
+                       nbytes=bio.getbuffer().nbytes)
+        chan.send(pred, name, bio.getvalue())
+        with np.load(io.BytesIO(_recv_or_fail(
+                chan, succ, (my_old + 1) % n, "zero-buddy", name))) as z:
+            buddy = {int(k[1:]): z[k] for k in z.files}
+        with self._lock:
+            self._buddy = buddy
+            self._buddy_of = (my_old + 1) % n
+
+    # -- re-carve ---------------------------------------------------------
+    def recarve(self, new_n: int, peer=None, old_workers=None,
+                new_workers=None, tag: str = "0",
+                dead: Optional[Sequence[int]] = None,
+                expect_step: Optional[int] = None) -> None:
+        """Re-shard the committed state in place for a ``new_n``-rank
+        world.  Leaderless: every participant computes the same
+        :func:`~kungfu_tpu.parallel.zero.reshard_plan` and moves only
+        the ``O(total/n)`` segments it owns or will own.
+
+        Full mode (every vector locally addressable) needs no peers at
+        all.  Chunk mode exchanges segments over ``peer``'s host channel
+        between ``old_workers`` (the pre-change membership this boundary
+        was committed under) and ``new_workers`` (the agreed new
+        membership).  ``dead`` is the set of OLD ranks that provably
+        cannot serve (shrink-to-survivors passes its confirmed dead
+        set); their segments are served from the ring-buddy mirror on
+        their predecessor (see :meth:`replicate_ring`) — without a
+        mirror, a dead rank's chunk is unrecoverable and this raises.
+        Old ranks absent from ``new_workers`` but NOT in ``dead`` are
+        *leavers* of a planned resize: still alive, they serve their
+        own segments before detaching (every leaver must call
+        ``recarve`` too — :func:`kungfu_tpu.elastic.hooks.elastic_step`
+        does this before honoring the detach).  Every participant must
+        pass the same ``dead`` set: it is part of the plan.
+
+        ``expect_step`` is the cluster-AGREED committed step (the shrink
+        path passes the leader-agreed replay boundary).  Committed steps
+        can diverge by one across survivors — the dead peer may have fed
+        some of them before dying — and a chunk committed one step ahead
+        is not restorable state for a step-behind replay (its previous
+        value is gone, as is its buddy mirror's): segments from mixed
+        steps would silently blend two optimizer states.  A mismatch
+        therefore raises — escalate to the checkpoint restart, the same
+        policy as an unrecoverable dead chunk.
+        """
+        from kungfu_tpu.parallel.zero import reshard_plan
+
+        with self._lock:
+            if self._step is None:
+                raise ValueError("recarve before any commit")
+            total, old_n = self._total, self._old_n
+            full_mode = self._full_mode
+            step = self._step
+        if (expect_step is not None and step >= 0
+                and step != int(expect_step)):
+            raise ValueError(
+                f"boundary committed at step {step} but the cluster agreed "
+                f"to replay from step {expect_step} — a re-carve would "
+                "blend optimizer states from different steps; escalate to "
+                "the checkpoint restart")
+        if new_n < 1:
+            raise ValueError(f"new_n must be >= 1, got {new_n}")
+        plan = reshard_plan(total, old_n, new_n)
+        new_chunk = math.ceil(total / new_n)
+        timeline.event("shrink", "zero-recarve", old_n=old_n, new_n=new_n,
+                       total=total, segments=len(plan))
+        if full_mode:
+            # local slicing only: zero the padding, keep [0, total)
+            with self._lock:
+                for i, full in self._vec.items():
+                    if full.shape[0] < total:
+                        raise ValueError(
+                            f"state vector {i} has {full.shape[0]} elements "
+                            f"but params fuse to {total} — boundary was "
+                            "committed against a different param tree")
+                    buf = np.zeros((new_chunk * new_n,), full.dtype)
+                    buf[:total] = full[:total]
+                    self._vec[i] = buf
+                self._old_n = new_n
+                self._my_old = 0
+                self._chunk = new_chunk
+            return
+        self._recarve_channel(plan, new_n, new_chunk, peer,
+                              old_workers, new_workers, tag, dead)
+
+    def _recarve_channel(self, plan, new_n, new_chunk, peer,
+                         old_workers, new_workers, tag, dead=None):
+        if peer is None or old_workers is None or new_workers is None:
+            raise ValueError(
+                "chunk-mode recarve needs peer + old_workers + new_workers")
+        chan = peer.channel
+        with self._lock:
+            my_old, old_n = self._my_old, self._old_n
+            chunk = self._chunk
+            step = self._step
+            vec = dict(self._vec)
+            dtypes = dict(self._vec_dtypes)
+            buddy, buddy_of = dict(self._buddy), self._buddy_of
+        me = peer.config.self_id
+        # the plan is computed from the boundary's recorded epoch
+        # (old_n, my_old) while addressing uses the caller's old_workers;
+        # a stale boundary (missed commit, standby leftovers) would serve
+        # wrong bytes under matching segment names — fail upfront instead
+        if len(old_workers) != old_n:
+            raise ValueError(
+                f"boundary was committed under {old_n} ranks but "
+                f"old_workers has {len(old_workers)} members — stale "
+                "boundary or wrong membership epoch")
+        if my_old is not None and old_workers.rank(me) != my_old:
+            raise ValueError(
+                f"boundary records this rank as old rank {my_old} but "
+                f"old_workers places it at {old_workers.rank(me)} — stale "
+                "boundary or wrong membership epoch")
+        my_new = new_workers.rank(me)
+        dead = {int(d) for d in (dead or ())}
+        # serving = every old rank still able to answer: survivors AND
+        # planned-resize leavers (alive, detaching only after this)
+        alive = {r for r in range(old_n) if r not in dead}
+
+        def server_of(o: int) -> Optional[int]:
+            """Old rank whose host serves old rank ``o``'s segments."""
+            if o in alive:
+                return o
+            pred = (o - 1) % old_n
+            if pred in alive:
+                return pred  # serves from its buddy mirror
+            return None
+
+        for o in dead:
+            serv = server_of(o)
+            if serv is None:
+                raise ValueError(
+                    f"old rank {o} is dead and so is its ring predecessor "
+                    f"{(o - 1) % old_n} — chunk unrecoverable (ring-buddy "
+                    "redundancy covers single failures; escalate to the "
+                    "checkpoint restart)")
+            if serv == my_old and buddy_of != o:
+                raise ValueError(
+                    f"old rank {o} is dead and this rank holds no buddy "
+                    "mirror of its chunk (replicate_ring was never run on "
+                    "this boundary) — chunk unrecoverable")
+
+        def seg_name(i: int, s: int) -> str:
+            return f"kf.zrc.{tag}.l{i}.o{s}"
+
+        def local_source(o: int) -> Optional[Dict[int, np.ndarray]]:
+            if o == my_old:
+                return vec
+            if o == buddy_of and buddy:
+                return buddy
+            return None
+
+        # 1) serve every segment THIS host is responsible for
+        offs = {}
+        if my_old is not None:
+            offs[my_old] = my_old * chunk
+        if buddy_of is not None:
+            offs[buddy_of] = buddy_of * chunk
+        for (o, r, s, ln) in plan:
+            if my_old is None or server_of(o) != my_old:
+                continue
+            src = local_source(o)
+            if src is None:
+                raise AssertionError(
+                    f"server {my_old} has no data for old rank {o}")
+            dst = new_workers[r]
+            if dst == me:
+                continue
+            off = offs[o]
+            for i, data in src.items():
+                chan.send(dst, seg_name(i, s),
+                          np.ascontiguousarray(data[s - off:s - off + ln]))
+        # replicated scalars (and the boundary step) for pure joiners,
+        # served by the lowest surviving old rank (replicated leaves have
+        # no owner: any surviving copy is THE copy)
+        serving_scal = min(alive) if alive else None
+        if my_old is not None and my_old == serving_scal:
+            with self._lock:
+                scal = dict(self._scal)
+            bio = io.BytesIO()
+            np.savez(bio, __step__=np.int64(step),
+                     **{f"s{i}": a for i, a in scal.items()})
+            for w in new_workers:
+                if old_workers.rank(w) is None:
+                    chan.send(w, f"kf.zrc.{tag}.scalars", bio.getvalue())
+
+        if my_new is None:
+            # leaver: served its segments; drop the now-stale shard
+            with self._lock:
+                self._vec = {}
+            return
+
+        # 2) assemble my new chunk
+        if my_old is None:
+            if serving_scal is None:
+                raise ValueError("no surviving old member to receive from")
+            with np.load(io.BytesIO(_recv_or_fail(
+                    chan, old_workers[serving_scal], serving_scal,
+                    "zero-recarve", f"kf.zrc.{tag}.scalars"))) as z:
+                with self._lock:
+                    self._scal = {int(k[1:]): z[k] for k in z.files
+                                  if k != "__step__"}
+                    self._step = step = int(z["__step__"])
+        lo = my_new * new_chunk
+        new_vec = {i: np.zeros((new_chunk,), dt) for i, dt in dtypes.items()}
+        for (o, r, s, ln) in plan:
+            if r != my_new:
+                continue
+            src = (local_source(o)
+                   if my_old is not None and server_of(o) == my_old
+                   else None)
+            if src is not None:
+                off = offs[o]
+                for i, data in src.items():
+                    new_vec[i][s - lo:s - lo + ln] = \
+                        data[s - off:s - off + ln]
+                continue
+            serv = server_of(o)
+            for i in new_vec:
+                got = np.frombuffer(
+                    _recv_or_fail(chan, old_workers[serv], serv,
+                                  "zero-recarve", seg_name(i, s)),
+                    dtype=new_vec[i].dtype)
+                if got.shape[0] != ln:
+                    raise ValueError(
+                        f"recarve segment {seg_name(i, s)}: expected {ln} "
+                        f"elements, got {got.shape[0]}")
+                new_vec[i][s - lo:s - lo + ln] = got
+        with self._lock:
+            self._vec = new_vec
+            self._old_n = new_n
+            self._my_old = my_new
+            self._chunk = new_chunk
+            self._buddy = {}
+            self._buddy_of = None
+
+    # -- placement --------------------------------------------------------
+    def place(self, new_comm):
+        """Rebuild the optimizer-state pytree on ``new_comm``'s mesh from
+        the (re-carved) boundary: vector leaves sharded ``P(axes)``,
+        scalars replicated.  Call after :meth:`recarve` with
+        ``new_comm.size == new_n``."""
+        import jax
+        import jax.numpy as jnp
+
+        from kungfu_tpu.parallel.zero import _place_sharded
+
+        with self._lock:
+            if self._treedef is None:
+                raise ValueError("place before any commit")
+            if self._old_n != new_comm.size:
+                raise ValueError(
+                    f"boundary is carved for {self._old_n} ranks but the "
+                    f"communicator has {new_comm.size} — recarve first")
+            n_leaves = self._treedef.num_leaves
+            leaves = []
+            for i in range(n_leaves):
+                if i in self._vec:
+                    v = self._vec[i]
+                    if self._full_mode:
+                        leaves.append(_place_sharded(new_comm, full_np=v))
+                    else:
+                        leaves.append(_place_sharded(new_comm, my_chunk=v))
+                else:
+                    leaves.append(jax.device_put(
+                        jnp.asarray(self._scal[i]),
+                        new_comm.replicated_sharding()))
+            return jax.tree_util.tree_unflatten(self._treedef, leaves)
+
+
+#: default boundary for the one-trainer-per-process case (mirrors
+#: ``checkpoint.step_snapshot``)
+zero_boundary = ZeroBoundary()
+
+
+def recarve_after_shrink(peer, boundary: ZeroBoundary, old_workers,
+                         expect_step: Optional[int] = None) -> None:
+    """Shrink-recovery hook: re-carve ``boundary`` across the survivors.
+
+    Call AFTER :func:`kungfu_tpu.elastic.shrink.shrink_to_survivors`
+    succeeded (``peer.cluster.workers`` is already the shrunk list);
+    ``old_workers`` is the pre-shrink membership the boundary was
+    committed under.  ``expect_step`` is the leader-agreed replay step
+    (``recover_from_peer_failure`` passes it when a params snapshot was
+    synced) — a survivor whose boundary committed a different step
+    raises rather than blending optimizer states.  The subsequent mesh
+    epoch then restores sharded state via :meth:`ZeroBoundary.place`.
+    """
+    new_workers = peer.cluster.workers
+    # survivors ARE the new membership: every old rank absent from it is
+    # confirmed dead (ping-confirmed by the exclusion consensus), not a
+    # leaver — its chunks must come from ring-buddy mirrors
+    dead = [r for r, w in enumerate(old_workers)
+            if new_workers.rank(w) is None]
+    boundary.recarve(
+        len(new_workers), peer=peer, old_workers=old_workers,
+        new_workers=new_workers, tag=f"v{peer.cluster_version}",
+        dead=dead, expect_step=expect_step,
+    )

@@ -217,6 +217,7 @@ def recover_from_peer_failure(
     peer,
     failure: Optional[BaseException] = None,
     snapshot=None,
+    zero_boundary=None,
 ) -> Tuple[bool, Optional[Tuple[int, object, dict]]]:
     """The full survivor-side driver: confirm the dead set, shrink, and
     hand back the replay point.
@@ -231,12 +232,29 @@ def recover_from_peer_failure(
     on every surviving rank or on none (the broadcast must be
     symmetric).
 
+    ``zero_boundary`` (a :class:`kungfu_tpu.elastic.reshard.ZeroBoundary`,
+    same all-or-none symmetry) carries ZeRO-sharded optimizer state,
+    which cannot ride the leader-broadcast ``snapshot`` (each rank holds
+    only its 1/n chunk): after the shrink it is re-carved **leaderlessly**
+    across the survivors — each rank exchanging only the O(total/n)
+    segments the new geometry moves, dead ranks' chunks served from
+    their ring-buddy mirrors — and the caller restores the sharded state
+    for the shrunk epoch with ``zero_boundary.place(new_comm)``.
+
     ``shrunk=False`` means nothing provably died (a transient — the
     caller may simply retry the collective).  On quorum loss this
     signals the failure detector (``otherdown`` → the MonitoredRun
     relaunch, the pre-existing last resort) and re-raises
     :class:`QuorumLostError`.
     """
+    if zero_boundary is not None and snapshot is None:
+        # checked before anything destructive: the recarve must be gated
+        # on the leader-agreed replay step (survivors' boundaries can
+        # diverge by one), and that step only exists via the snapshot
+        raise ValueError(
+            "zero_boundary needs a StepSnapshot alongside it — the "
+            "leader-agreed replay step gates the re-carve against "
+            "survivors whose boundaries committed different steps")
     suspects = []
     if isinstance(failure, PeerFailureError) and failure.rank is not None:
         suspects.append(failure.rank)
@@ -247,6 +265,7 @@ def recover_from_peer_failure(
             "not shrinking", failure,
         )
         return False, None
+    old_workers = peer.cluster.workers  # pre-shrink membership, for recarve
     try:
         shrunk = shrink_to_survivors(peer, dead)
     except QuorumLostError:
@@ -261,6 +280,26 @@ def recover_from_peer_failure(
     replay = None
     if shrunk and snapshot is not None:
         replay = _sync_replay_point(peer, snapshot)
+    if shrunk and zero_boundary is not None:
+        from kungfu_tpu.elastic.reshard import recarve_after_shrink
+
+        # the leader-agreed replay step gates the recarve: a survivor
+        # whose boundary committed one step ahead (the dead peer fed it
+        # before dying) holds state the step-behind replay cannot use —
+        # recarve raises loudly instead of blending two steps.  A
+        # snapshot was passed (entry check) but the replay sync itself
+        # can degrade (broadcast timeout, nothing committed yet): with
+        # no agreed step there is nothing to gate on, and an ungated
+        # exchange would blend divergent boundaries SILENTLY — fail the
+        # recovery toward the checkpoint restart instead.
+        if replay is None:
+            raise RuntimeError(
+                "replay-point sync yielded no agreed step (broadcast "
+                "failed or no boundary was committed): the zero_boundary "
+                "re-carve cannot be step-gated and survivors' boundaries "
+                "may diverge — escalate to the checkpoint restart")
+        recarve_after_shrink(peer, zero_boundary, old_workers,
+                             expect_step=replay[0])
     return shrunk, replay
 
 

@@ -186,6 +186,9 @@ METRIC_HELP: Dict[str, str] = {
     "kf_detector_down_total": "failure-detector down verdicts",
     "kf_shrink_events_total": "shrink-to-survivors phase events, by phase",
     "kf_timeline_dropped_total": "flight-recorder ring evictions",
+    "kf_opt_state_bytes":
+        "per-rank optimizer-state footprint (worst device; ZeRO shards "
+        "count one chunk, replicated state counts fully)",
     "kf_net_egress_bytes":
         "aggregate egress bytes (mirrored from NetMonitor)",
     "kf_net_ingress_bytes":

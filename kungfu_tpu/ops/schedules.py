@@ -28,10 +28,11 @@ handled by the same driver machinery as the host plane
 from __future__ import annotations
 
 import math
-from typing import Tuple, Union
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from kungfu_tpu.utils.jaxcompat import axis_size
@@ -132,6 +133,167 @@ def _two_stage_all_reduce_leaf(a, axis_name: str, op: str):
 
 
 _PSUM_FOLD = {"sum": lax.psum, "min": lax.pmin, "max": lax.pmax}
+
+
+# -- bucketed reduce-scatter / all-gather (ZeRO weight-update sharding) ----
+#
+# The gradient-bucket fusion above (one flat buffer, one collective) folded
+# into reduce-scatter-sized pieces: the flat [n*chunk] buffer is viewed as
+# [n, chunk] in mesh-major device order and bucketed along the CHUNK
+# dimension, so every bucket's scatter lands each device a contiguous slice
+# of its own chunk and the concatenation over buckets reproduces the
+# exact contiguous per-device chunk layout of the un-bucketed scatter.
+# That invariant is what keeps the ZeRO optimizer-state geometry (and its
+# elastic re-shard/snapshot machinery) identical whether the step ran one
+# collective or B of them.  B explicit collectives in the program also give
+# XLA independent program points to overlap with neighboring compute — the
+# same reason `two_stage` exists (docstring above).
+
+def bucket_widths(chunk: int, n: int, itemsize: int,
+                  bucket_bytes: int) -> List[int]:
+    """Per-bucket column widths partitioning ``chunk`` so each bucket's
+    collective operand ([n, width] flattened) is ~``bucket_bytes``.
+    Always at least one bucket; the last takes the remainder."""
+    if chunk <= 0:
+        return [chunk] if chunk else []
+    per_bucket = max(1, bucket_bytes // max(1, n * itemsize))
+    widths = []
+    off = 0
+    while off < chunk:
+        w = min(per_bucket, chunk - off)
+        widths.append(w)
+        off += w
+    return widths
+
+
+def reduce_scatter_flat(g, axes: Sequence[str], chunk: int,
+                        widths: Optional[Sequence[int]] = None):
+    """Bucketed reduce-scatter of a flat mesh-major buffer.
+
+    ``g``: per-device ``[n*chunk]`` (the full fused gradient, VMA-varying
+    inside shard_map); returns this device's reduced ``[chunk]`` slice,
+    where the device's flat index is mesh-major over ``axes`` (outer axis
+    first — the same order :mod:`kungfu_tpu.parallel.zero` scatters in).
+    ``axes`` must already be filtered to the non-trivial mesh axes; empty
+    ``axes`` means a 1-device world and the buffer IS the chunk."""
+    if not axes:
+        return g[:chunk]
+    n = 1
+    for ax in axes:
+        n *= axis_size(ax)
+    widths = list(widths) if widths else [chunk]
+    g2 = g.reshape(n, chunk)
+    parts = []
+    off = 0
+    for w in widths:
+        slab = g2[:, off:off + w].reshape(-1)
+        for ax in axes:
+            slab = lax.psum_scatter(slab, ax, scatter_dimension=0, tiled=True)
+        parts.append(slab)
+        off += w
+    out = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+    return out
+
+
+def all_gather_flat(shard, axes: Sequence[str],
+                    widths: Optional[Sequence[int]] = None):
+    """Bucketed all-gather: inverse layout of :func:`reduce_scatter_flat`.
+
+    ``shard``: this device's ``[chunk]`` slice; returns the mesh-major
+    ``[n*chunk]`` full buffer on every device.  Differentiable — the
+    transpose of each bucket's tiled all-gather is the matching tiled
+    psum-scatter, so ``grad(loss(all_gather_flat(p)))`` arrives already
+    reduce-scattered (the ZeRO-3 gradient path costs no extra collective)."""
+    if not axes:
+        return shard
+    n = 1
+    for ax in axes:
+        n *= axis_size(ax)
+    chunk = shard.shape[0]
+    widths = list(widths) if widths else [chunk]
+    slabs = []
+    off = 0
+    for w in widths:
+        piece = shard[off:off + w]
+        for ax in reversed(axes):
+            piece = lax.all_gather(piece, ax, axis=0, tiled=True)
+        slabs.append(piece.reshape(n, w))
+        off += w
+    full = slabs[0] if len(slabs) == 1 else jnp.concatenate(slabs, axis=1)
+    return full.reshape(-1)
+
+
+#: jaxpr primitives that move bytes between devices, with the per-rank
+#: ring-convention wire cost as a multiple of the per-device operand size
+#: (s = operand bytes, k = axis size): all-reduce moves 2(k-1)/k*s, a
+#: scatter/gather half of that, a permute exactly s.
+_COLLECTIVE_COST = {
+    "psum": lambda s, k: 2.0 * (k - 1) / k * s,
+    "pmin": lambda s, k: 2.0 * (k - 1) / k * s,
+    "pmax": lambda s, k: 2.0 * (k - 1) / k * s,
+    "reduce_scatter": lambda s, k: (k - 1) / k * s,
+    "all_gather": lambda s, k: (k - 1) * s,  # s = the shard being gathered
+    "ppermute": lambda s, k: float(s),
+    "all_to_all": lambda s, k: (k - 1) / k * s,
+}
+
+
+def traced_collective_bytes(fn, *args, axis_sizes: Dict[str, int]):
+    """Per-rank wire bytes per call of ``fn``, measured from its traced
+    jaxpr: every cross-device collective primitive actually present in
+    the program is costed with the standard ring convention (table
+    above).  This is a measurement of the *program XLA compiles* — not an
+    estimate from a formula about what the program ought to do — so a
+    step that silently all-reduces where it claims to reduce-scatter
+    shows up as 2x in the bench row.  ``axis_sizes`` maps mesh axis names
+    to sizes (``dict(zip(mesh.axis_names, mesh.devices.shape))``) — the
+    walk runs outside any trace, where ``lax.axis_size`` is unavailable.
+    Partitioner-inserted transfers (the all-gather a replicated
+    ``with_sharding_constraint`` compiles to) happen after tracing and
+    are NOT counted; account those analytically
+    (:func:`kungfu_tpu.parallel.zero.zero_comm_bytes`).
+
+    Returns ``{primitive_name: bytes}`` (floats, summed over every call
+    site reached; scan/fori bodies count once per trace occurrence, not
+    per trip)."""
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    out: Dict[str, float] = {}
+
+    def axis_total(axis_name) -> int:
+        axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+        k = 1
+        for ax in axes:
+            k *= int(axis_sizes.get(ax, 1))
+        return max(k, 1)
+
+    def walk(jp):
+        for eqn in jp.eqns:
+            prim = eqn.primitive.name
+            cost = _COLLECTIVE_COST.get(prim)
+            if cost is not None:
+                k = axis_total(eqn.params.get("axes")
+                               or eqn.params.get("axis_name") or ())
+                if k > 1:
+                    s = sum(
+                        int(np.prod(v.aval.shape)) * v.aval.dtype.itemsize
+                        for v in eqn.invars if hasattr(v, "aval")
+                        and hasattr(v.aval, "shape")
+                    )
+                    out[prim] = out.get(prim, 0.0) + cost(s, k)
+            for sub in eqn.params.values():
+                if hasattr(sub, "eqns"):
+                    walk(sub)
+                elif hasattr(sub, "jaxpr") and hasattr(sub.jaxpr, "eqns"):
+                    walk(sub.jaxpr)
+                elif isinstance(sub, (list, tuple)):
+                    for s2 in sub:
+                        if hasattr(s2, "eqns"):
+                            walk(s2)
+                        elif hasattr(s2, "jaxpr") and hasattr(s2.jaxpr, "eqns"):
+                            walk(s2.jaxpr)
+
+    walk(jaxpr.jaxpr)
+    return out
 
 
 def all_reduce_scheduled(x, axis: Axis, op: str = "sum",

@@ -445,9 +445,17 @@ def dp_train_step(
     replicated_params: bool = True,
     has_aux: bool = False,
     donate: bool = False,
+    zero_stage: Optional[int] = None,
 ):
     """Pure data-parallel training step over a
     :class:`~kungfu_tpu.comm.device.Communicator` mesh.
+
+    ``zero_stage`` (1/2/3) routes to the weight-update-sharded family
+    (:func:`kungfu_tpu.parallel.zero.zero_train_step`): ``tx`` is then
+    the **inner elementwise** optax transform (the ZeRO step owns the
+    gradient collective itself — do not wrap in ``synchronous_sgd``) and
+    the return value is a :class:`~kungfu_tpu.parallel.zero.ZeroStep`,
+    which still unpacks as ``step, init_opt = ...`` for stages 1/2.
 
     The DP-only analog of :class:`ShardedTrainer` (and of the reference's
     whole training model — S-SGD over gradient buffers): ``loss_fn(params,
@@ -474,6 +482,16 @@ def dp_train_step(
     opt_state, loss)`` jitted over the mesh; ``batch`` leading axis must
     be divisible by ``comm.size``.
     """
+    if zero_stage is not None:
+        if has_aux or not replicated_params:
+            raise ValueError(
+                "zero_stage composes with the plain replicated-params, "
+                "no-aux step only (the sharded update is elementwise over "
+                "the fused flat buffer)")
+        from kungfu_tpu.parallel.zero import zero_train_step
+
+        return zero_train_step(loss_fn, tx, comm, stage=zero_stage,
+                               donate=donate)
     mesh, axis = comm.mesh, comm.axis
     pspec = P() if replicated_params else P(axis)
 

@@ -728,7 +728,7 @@ class Peer:
 
     # -- in-flight fault tolerance (elastic.shrink) ------------------------
     def recover_from_failure(self, failure: Optional[BaseException] = None,
-                             snapshot=None):
+                             snapshot=None, zero_boundary=None):
         """Survivor-side in-flight recovery after a collective raised
         :class:`~kungfu_tpu.comm.faults.PeerFailureError`: confirm the
         dead set by ping, run the exclusion consensus, apply the shrunk
@@ -737,10 +737,17 @@ class Peer:
         recover_from_peer_failure`.  Raises ``QuorumLostError`` (after
         signaling the failure detector) when the survivors are not a
         strict majority — the detector-driven relaunch is the last
-        resort, no longer the only mechanism."""
+        resort, no longer the only mechanism.
+
+        ``zero_boundary`` (a :class:`kungfu_tpu.elastic.reshard.
+        ZeroBoundary`) carries ZeRO-sharded optimizer state through the
+        shrink: it is re-carved leaderlessly across the survivors (dead
+        ranks' chunks served from ring-buddy mirrors) — see
+        docs/zero.md."""
         from kungfu_tpu.elastic.shrink import recover_from_peer_failure
 
-        return recover_from_peer_failure(self, failure, snapshot)
+        return recover_from_peer_failure(self, failure, snapshot,
+                                         zero_boundary=zero_boundary)
 
     # -- monitoring / adaptation (reference peer.hpp GetPeerLatencies /
     # CheckInterference / GetEgressRates / SetTree) ----------------------

@@ -80,3 +80,25 @@ def pcast_varying(x, axes):
     if pcast is None or not axes:
         return x
     return pcast(x, tuple(axes), to="varying")
+
+
+def set_cpu_device_count(n: int) -> None:
+    """Force an ``n``-device virtual CPU platform across jax versions.
+
+    Newer jax exposes ``jax_num_cpu_devices``; older versions only take
+    the XLA flag.  Either way the setting must land BEFORE backend init
+    (the first ``jax.devices()`` locks the platform in) — callers are
+    the CPU-mesh benchmark/example harnesses, which run in fresh
+    processes."""
+    import os
+
+    try:
+        jax.config.update("jax_num_cpu_devices", int(n))
+    except (AttributeError, KeyError):
+        # this jax predates the option; the XLA flag is the only knob.
+        # A RuntimeError (option exists but the backend is already
+        # initialized) must propagate: the flag fallback would be a
+        # silent no-op and the caller would run on 1 device.
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={int(n)}")

@@ -507,3 +507,25 @@ class TestPeerWiring:
             for p in peers:
                 p.close()
         assert all(p._reporter is None for p in peers)
+
+
+class TestOptStateBytesGauge:
+    """The ZeRO memory column: kf_opt_state_bytes set by
+    record_opt_state_gauge must ride a reporter snapshot into the
+    aggregator's per-rank view (kftop / /metrics see it live)."""
+
+    def test_gauge_flows_through_snapshot(self):
+        from kungfu_tpu.parallel.zero import record_opt_state_gauge
+
+        nbytes = record_opt_state_gauge(
+            {"mu": __import__("numpy").zeros(1024, dtype="float32")})
+        assert nbytes == 4096
+        rep = RankReporter(3, "http://127.0.0.1:1/push", period=0.1)
+        snap = rep.snapshot_once()
+        assert field(snap, "gauges")["kf_opt_state_bytes"] == 4096.0
+
+        agg = ClusterAggregator(stale_after=10.0)
+        agg.ingest(snap)
+        rows = {field(r, "rank"): r for r in
+                field(agg.cluster_view(), "ranks")}
+        assert rows[3]["gauges"]["kf_opt_state_bytes"] == 4096.0

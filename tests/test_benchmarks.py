@@ -200,3 +200,26 @@ class TestBenchPayloads:
                         subdir="")
         assert out["metric"] == "allreduce_bus_bandwidth"
         assert out["value"] > 0 and out["n_devices"] in (1, 8)
+
+
+class TestZeroPayload:
+    def test_zero_rows_and_comm_claim(self):
+        """bench.py --zero on the CPU-mesh harness: all four rows
+        present, and the measured ZeRO-2 wire bytes hold the <=55%
+        claim against the ZeRO-1 all-reduce path."""
+        out = run_bench("bench.py", "--payload", "zero", "--cpu-mesh", "4",
+                        subdir="")
+        assert out["metric"] == "zero2_traced_comm_bytes_vs_zero1"
+        assert 0 < out["value"] <= 0.55
+        rows = out["rows"]
+        assert set(rows) == {"bare", "zero1", "zero2", "zero3"}
+        # the bare baseline all-reduces (psum), zero2 reduce-scatters
+        assert "psum" in rows["bare"]["traced_comm_bytes_per_rank"]
+        assert "reduce_scatter" in rows["zero2"]["traced_comm_bytes_per_rank"]
+        assert "all_gather" in rows["zero3"]["traced_comm_bytes_per_rank"]
+        # replicated optimizer state is ~n x the sharded per-rank shard
+        n = out["n_devices"]
+        assert rows["bare"]["opt_state_bytes_per_rank"] > (
+            (n - 1) * rows["zero2"]["opt_state_bytes_per_rank"])
+        for r in rows.values():
+            assert r["step_ms"] is None or r["step_ms"] > 0

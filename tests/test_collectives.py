@@ -378,3 +378,59 @@ class TestBroadcastValue:
         assert comm.first_slot_of_process(0) == 0
         with pytest.raises(ValueError):
             comm.first_slot_of_process(99)
+
+
+class TestReduceScatterDevice:
+    """Communicator.reduce_scatter / all_gather_shard — the device-plane
+    ZeRO collective pair (stacked eager convention)."""
+
+    def test_sum_chunks(self, comm):
+        x = stacked((3, 4))
+        out = np.asarray(comm.reduce_scatter(x))
+        flat = x.sum(0).reshape(-1)  # 12 elements over 8 ranks: chunk 2
+        chunk = -(-12 // N)
+        padded = np.zeros(chunk * N, np.float32)
+        padded[:12] = flat
+        assert out.shape == (N, chunk)
+        for r in range(N):
+            np.testing.assert_allclose(
+                out[r], padded[r * chunk:(r + 1) * chunk], rtol=1e-5)
+
+    def test_mean(self, comm):
+        x = stacked((5,))
+        out = np.asarray(comm.reduce_scatter(x, op="mean"))
+        want = np.asarray(comm.reduce_scatter(x)) / N
+        np.testing.assert_allclose(out, want, rtol=1e-6)
+
+    def test_gather_inverts(self, comm):
+        x = stacked((5,))
+        rs = comm.reduce_scatter(x)
+        ag = np.asarray(comm.all_gather_shard(rs))
+        chunk = -(-5 // N)
+        padded = np.zeros(chunk * N, np.float32)
+        padded[:5] = x.sum(0)
+        assert ag.shape == (N, chunk * N)
+        for r in range(N):
+            np.testing.assert_allclose(ag[r], padded, rtol=1e-5)
+
+    def test_bucketed_bitwise(self, comm):
+        x = stacked((7,), seed=3)
+        a = np.asarray(comm.reduce_scatter(x))
+        b = np.asarray(comm.reduce_scatter(x, bucket_bytes=4))
+        np.testing.assert_array_equal(a, b)
+
+    def test_pytree(self, comm):
+        x = {"a": stacked((4,)), "b": stacked((6,), seed=1)}
+        out = comm.reduce_scatter(x)
+        assert set(out) == {"a", "b"}
+        np.testing.assert_allclose(
+            np.asarray(out["a"]),
+            np.asarray(comm.reduce_scatter(x["a"])), rtol=1e-6)
+
+    def test_bad_op(self, comm):
+        with pytest.raises(ValueError, match="sum/mean"):
+            comm.reduce_scatter(stacked((4,)), op="max")
+
+    def test_bad_leading_axis(self, comm):
+        with pytest.raises(ValueError):
+            comm.reduce_scatter(np.ones((N + 1, 4), np.float32))

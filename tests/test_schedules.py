@@ -402,3 +402,155 @@ class TestCommunicatorStrategy:
         x = jnp.ones((N_DEV, 4), jnp.float32)
         np.testing.assert_allclose(np.asarray(comm.all_reduce(x)),
                                    np.full((N_DEV, 4), 8.0))
+
+
+class TestBucketedScatterGather:
+    """reduce_scatter_flat / all_gather_flat: the ZeRO collective pair.
+    Bucketing is pure program structure — results must be bit-identical
+    across bucket layouts, and the pair must round-trip the mesh-major
+    chunk geometry exactly."""
+
+    def _mesh(self, n=8):
+        return Mesh(np.array(jax.devices()[:n]), ("d",))
+
+    def test_reduce_scatter_matches_psum_slice(self):
+        from kungfu_tpu.ops.schedules import reduce_scatter_flat
+
+        n, chunk = 8, 5
+        mesh = self._mesh(n)
+        rng = np.random.RandomState(0)
+        x = rng.randn(n, n * chunk).astype(np.float32)  # per-device rows
+
+        def body(row):
+            return reduce_scatter_flat(row[0], ["d"], chunk)
+
+        out = shard_map(body, mesh=mesh, in_specs=P("d"),
+                        out_specs=P("d"))(x)
+        want = x.sum(0)  # the reduced flat buffer
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5)
+
+    @pytest.mark.parametrize("widths", [None, [1] * 5, [2, 3], [4, 1]])
+    def test_bucketing_is_bitwise_invariant(self, widths):
+        from kungfu_tpu.ops.schedules import reduce_scatter_flat
+
+        n, chunk = 8, 5
+        mesh = self._mesh(n)
+        rng = np.random.RandomState(1)
+        x = rng.randn(n, n * chunk).astype(np.float32)
+
+        def run(w):
+            body = lambda row: reduce_scatter_flat(row[0], ["d"], chunk, w)
+            return np.asarray(shard_map(
+                body, mesh=mesh, in_specs=P("d"), out_specs=P("d"))(x))
+
+        np.testing.assert_array_equal(run(widths), run(None))
+
+    def test_gather_inverts_scatter(self):
+        from kungfu_tpu.ops.schedules import (all_gather_flat,
+                                              reduce_scatter_flat)
+
+        n, chunk = 8, 3
+        mesh = self._mesh(n)
+        rng = np.random.RandomState(2)
+        x = rng.randn(n, n * chunk).astype(np.float32)
+
+        def body(row):
+            shard = reduce_scatter_flat(row[0], ["d"], chunk, [2, 1])
+            return all_gather_flat(shard, ["d"], [2, 1])[None]
+
+        out = np.asarray(shard_map(body, mesh=mesh, in_specs=P("d"),
+                                   out_specs=P("d"))(x))
+        want = x.sum(0)
+        for r in range(n):  # every device sees the full reduced buffer
+            np.testing.assert_allclose(out[r], want, rtol=1e-5)
+
+    def test_empty_axes_is_identity(self):
+        from kungfu_tpu.ops.schedules import (all_gather_flat,
+                                              reduce_scatter_flat)
+
+        x = jnp.arange(6, dtype=jnp.float32)
+        np.testing.assert_array_equal(
+            np.asarray(reduce_scatter_flat(x, [], 6)), np.asarray(x))
+        np.testing.assert_array_equal(
+            np.asarray(all_gather_flat(x, [])), np.asarray(x))
+
+    def test_gather_transpose_is_reduce_scatter(self):
+        """grad(loss(all_gather_flat(shard))) must arrive already
+        reduce-scattered — the ZeRO-3 gradient path costs no extra
+        collective.  Witnessed structurally: the traced backward program
+        contains a reduce_scatter, not a psum + slice."""
+        from kungfu_tpu.ops.schedules import (all_gather_flat,
+                                              traced_collective_bytes)
+
+        n, chunk = 8, 4
+        mesh = self._mesh(n)
+
+        def body(shard):
+            def loss(s):
+                return jnp.sum(all_gather_flat(s, ["d"]) ** 2)
+
+            return jax.grad(loss)(shard)
+
+        fn = shard_map(body, mesh=mesh, in_specs=P("d"), out_specs=P("d"))
+        x = jnp.ones((n * chunk,), jnp.float32)
+        got = traced_collective_bytes(fn, x, axis_sizes={"d": n})
+        assert "reduce_scatter" in got, got
+
+
+class TestBucketWidths:
+    def test_partitions_chunk(self):
+        from kungfu_tpu.ops.schedules import bucket_widths
+
+        for chunk, n, item, bb in [(100, 8, 4, 64), (5, 2, 4, 1 << 20),
+                                   (7, 3, 2, 12), (1, 8, 4, 1)]:
+            w = bucket_widths(chunk, n, item, bb)
+            assert sum(w) == chunk and all(x > 0 for x in w)
+            per = max(1, bb // (n * item))
+            assert all(x <= per for x in w)
+
+    def test_degenerate(self):
+        from kungfu_tpu.ops.schedules import bucket_widths
+
+        assert bucket_widths(0, 8, 4, 64) == []
+        assert bucket_widths(10, 1, 4, 1 << 30) == [10]
+
+
+class TestTracedCollectiveBytes:
+    """The bench measurement primitive: wire bytes read from the traced
+    program, ring convention."""
+
+    def test_psum_cost_exact(self):
+        from kungfu_tpu.ops.schedules import traced_collective_bytes
+
+        n = 8
+        mesh = Mesh(np.array(jax.devices()[:n]), ("d",))
+        m = 16
+
+        def body(row):
+            return jax.lax.psum(row[0], "d")[None]
+
+        fn = shard_map(body, mesh=mesh, in_specs=P("d"), out_specs=P("d"))
+        x = jnp.ones((n, m), jnp.float32)
+        got = traced_collective_bytes(fn, x, axis_sizes={"d": n})
+        want = 2.0 * (n - 1) / n * m * 4
+        assert got == {"psum": want}, (got, want)
+
+    def test_single_axis_world_costs_nothing(self):
+        from kungfu_tpu.ops.schedules import traced_collective_bytes
+
+        mesh = Mesh(np.array(jax.devices()[:1]), ("d",))
+
+        def body(row):
+            return jax.lax.psum(row[0], "d")[None]
+
+        fn = shard_map(body, mesh=mesh, in_specs=P("d"), out_specs=P("d"))
+        got = traced_collective_bytes(
+            fn, jnp.ones((1, 4), jnp.float32), axis_sizes={"d": 1})
+        assert got == {}
+
+    def test_non_collective_program_is_empty(self):
+        from kungfu_tpu.ops.schedules import traced_collective_bytes
+
+        got = traced_collective_bytes(
+            lambda x: x * 2 + 1, jnp.ones((8,)), axis_sizes={"d": 8})
+        assert got == {}
