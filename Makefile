@@ -5,7 +5,8 @@
 PY ?= python3
 BASELINE := tests/lint_baseline.json
 
-.PHONY: lint verify shardcheck check test native trace-demo zero-demo help
+.PHONY: lint verify shardcheck check test native trace-demo zero-demo \
+    multislice-demo help
 
 ## lint: all thirteen kf-lint rules — the Python suite (env-contract,
 ## jit-sync, blocking-io, retry-discipline, collective-consistency,
@@ -67,6 +68,19 @@ zero-demo:
 	$(PY) -m kungfu_tpu.runner.cli -np 4 -tolerate-failures \
 	    -chaos 'die:step=3,rank=3;die:step=5,rank=1' \
 	    $(PY) examples/zero_shrink.py --n-steps 8
+
+## multislice-demo: emulated 2-slice pod (4 workers, slice-major) losing
+## a WHOLE slice in flight: chaos kills both ranks of slice 1 at step 3;
+## the surviving slice widens the dead set to the slice, passes the
+## slice-granular quorum (1 of 2 + lowest-slice tie-break — rank-level
+## strict majority would have refused 2-of-4), agrees over slice
+## leaders, re-carves the mesh + the ZeRO momentum from CROSS-SLICE
+## buddy mirrors, and finishes — final params bitwise vs a fixed-world
+## replay (docs/multislice.md).
+multislice-demo:
+	$(PY) -m kungfu_tpu.runner.cli -np 4 -num-slices 2 \
+	    -tolerate-failures -chaos 'die_slice:slice=1,step=3' \
+	    $(PY) examples/multislice_shrink.py --n-steps 8
 
 help:
 	@grep -E '^## ' Makefile | sed 's/^## //'

@@ -1087,12 +1087,174 @@ def payload_zero(args) -> dict:
     }
 
 
+def payload_multislice(args) -> dict:
+    """Emulated 2-slice hierarchical all-reduce vs flat, with DCN
+    wire-latency injection — the ``BENCH_extra.json`` gossip technique
+    (a wrapper adds fixed one-way latency to every CROSS-SLICE send,
+    intra-slice sends stay fast), so the row measures exactly what the
+    hierarchy buys: cross-slice hops leave the critical path.
+
+    Pure host-plane CPU (4 in-process HostChannels in threads, 2 slices
+    x 2 ranks): it cannot be zeroed by a wedged TPU tunnel.  ``flat`` is
+    the chunked ring all-reduce over all 4 ranks — 2(n-1) synchronized
+    steps, each gated by its slowest (cross-slice) link; ``hier`` is the
+    two-stage shape the multislice communicator compiles (reduce to the
+    slice leader over "ICI", one leader exchange over "DCN", broadcast
+    back).  Both reduce to identical sums (asserted)."""
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from kungfu_tpu.comm.host import PyHostChannel
+    from kungfu_tpu.plan import PeerID, PeerList
+
+    n_slices, rps = 2, 2
+    n = n_slices * rps
+    wire_ms = 30.0  # injected one-way DCN latency per cross-slice send
+    elems = 16384 if args.quick else 65536  # 64/256 KiB float32
+    rounds = 3 if args.quick else 5
+    base = 23400
+    peers = PeerList.of(*(PeerID("127.0.0.1", base + i) for i in range(n)))
+    chans = [PyHostChannel(p, token=0, bind_host="127.0.0.1")
+             for p in peers]
+
+    def slice_of(r):
+        return r // rps
+
+    cross_hops = [0] * n
+
+    class LatChan:
+        """The gossip wire proxy, channel-shaped: cross-slice sends pay
+        the DCN latency before hitting the real loopback socket."""
+
+        def __init__(self, chan, rank):
+            self.chan, self.rank = chan, rank
+
+        def send(self, dst, name, buf):
+            if slice_of(dst) != slice_of(self.rank):
+                cross_hops[self.rank] += 1
+                _time.sleep(wire_ms / 1e3)
+            self.chan.send(peers[dst], name, buf)
+
+        def recv(self, src, name):
+            return self.chan.recv(peers[src], name)
+
+    wrapped = [LatChan(c, i) for i, c in enumerate(chans)]
+
+    def flat_ring(rank, x, tag):
+        """Chunked ring all-reduce over ALL ranks, slice-blind: every
+        one of the 2(n-1) steps crosses the slice boundary somewhere,
+        so every step pays the injected DCN latency."""
+        ch = wrapped[rank]
+        chunk = (x.size + n - 1) // n
+        padded = np.zeros(chunk * n, np.float32)
+        padded[:x.size] = x
+        parts = padded.reshape(n, chunk).copy()
+        nxt, prv = (rank + 1) % n, (rank - 1) % n
+        for s in range(n - 1):
+            si, ri = (rank - s) % n, (rank - s - 1) % n
+            ch.send(nxt, f"{tag}.rs{s}", parts[si].tobytes())
+            parts[ri] += np.frombuffer(
+                ch.recv(prv, f"{tag}.rs{s}"), np.float32)
+        for s in range(n - 1):
+            si, ri = (rank + 1 - s) % n, (rank - s) % n
+            ch.send(nxt, f"{tag}.ag{s}", parts[si].tobytes())
+            parts[ri] = np.frombuffer(
+                ch.recv(prv, f"{tag}.ag{s}"), np.float32)
+        return parts.reshape(-1)[:x.size]
+
+    def hier(rank, x, tag):
+        """The two-stage multislice shape: ICI reduce to the slice
+        leader, ONE DCN exchange among leaders, ICI broadcast back —
+        cross-slice latency is paid once, not per ring step."""
+        ch = wrapped[rank]
+        leader = slice_of(rank) * rps
+        if rank != leader:
+            ch.send(leader, f"{tag}.up{rank}", x.tobytes())
+            return np.frombuffer(
+                ch.recv(leader, f"{tag}.dn{rank}"), np.float32).copy()
+        acc = x.copy()
+        for m in range(leader + 1, leader + rps):
+            acc += np.frombuffer(ch.recv(m, f"{tag}.up{m}"), np.float32)
+        others = [l for l in range(0, n, rps) if l != leader]
+        for o in others:
+            ch.send(o, f"{tag}.x{leader}", acc.tobytes())
+        total = acc.copy()
+        for o in others:
+            total += np.frombuffer(ch.recv(o, f"{tag}.x{o}"), np.float32)
+        for m in range(leader + 1, leader + rps):
+            ch.send(m, f"{tag}.dn{m}", total.tobytes())
+        return total
+
+    data = [np.full(elems, float(r + 1), np.float32) for r in range(n)]
+    want = sum(data)
+
+    def run_world(fn, tag):
+        outs = [None] * n
+
+        def one(r):
+            outs[r] = fn(r, data[r], tag)
+
+        ts = [threading.Thread(target=one, args=(r,), daemon=True)
+              for r in range(n)]
+        t0 = _time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(120)
+        if any(t.is_alive() for t in ts):
+            raise TimeoutError(f"{tag} hung")
+        dt = _time.perf_counter() - t0
+        for o in outs:
+            assert np.array_equal(o, want), "allreduce result mismatch"
+        return dt
+
+    try:
+        results = {}
+        hops = {}
+        for name, fn in (("flat", flat_ring), ("hier", hier)):
+            run_world(fn, f"warm.{name}")  # warm sockets + caches
+            for r in range(n):
+                cross_hops[r] = 0
+            best = min(run_world(fn, f"{name}.{i}") for i in range(rounds))
+            results[name] = best
+            hops[name] = max(cross_hops)  # critical-path cross sends/rank
+            for r in range(n):
+                cross_hops[r] = 0
+    finally:
+        for c in chans:
+            c.close()
+
+    speedup = results["flat"] / max(results["hier"], 1e-9)
+    return {
+        "metric": "multislice_hier_allreduce_speedup_vs_flat",
+        "value": round(speedup, 4),
+        "unit": "x",
+        # the claim: the hierarchy strips cross-slice hops off the
+        # critical path; under any real DCN latency that must beat flat
+        "vs_baseline": round(speedup, 4),
+        "vs_baseline_meaning": "flat ring time over hierarchical (>1 = hierarchy wins)",
+        "platform": "cpu-hostplane",
+        "n_devices": n,
+        "model": (f"{n_slices} slices x {rps} ranks, {elems * 4 >> 10} KiB "
+                  f"fp32, {wire_ms:.0f} ms injected DCN latency"),
+        "rows": {
+            name: {
+                "allreduce_s": round(results[name], 4),
+                "cross_slice_sends_per_round": hops[name] // rounds,
+            } for name in results
+        },
+    }
+
+
 PAYLOADS = {
     "resnet": payload_resnet,
     "kernels": payload_kernels,
     "allreduce": payload_allreduce,
     "lm": payload_lm,
     "zero": payload_zero,
+    "multislice": payload_multislice,
 }
 
 
@@ -1118,6 +1280,10 @@ def main() -> None:
                    help="GPT-small training with the kernels in anger")
     p.add_argument("--zero", action="store_true",
                    help="ZeRO stage rows + bare shard_map/psum baseline")
+    p.add_argument("--multislice", action="store_true",
+                   help="emulated 2-slice hierarchical vs flat all-reduce "
+                        "with injected DCN wire latency (host-plane CPU; "
+                        "tunnel-proof)")
     p.add_argument("--payload", choices=sorted(PAYLOADS), default=None,
                    help=argparse.SUPPRESS)  # internal: run in-process
     p.add_argument("--timeout", type=float, default=PAYLOAD_TIMEOUT_S)
@@ -1129,7 +1295,8 @@ def main() -> None:
         return
 
     which = ("kernels" if args.kernels else "allreduce" if args.allreduce
-             else "lm" if args.lm else "zero" if args.zero else "resnet")
+             else "lm" if args.lm else "zero" if args.zero
+             else "multislice" if args.multislice else "resnet")
     fwd = ["--payload", which]
     for flag, val in [
         ("--batch-size", args.batch_size), ("--image-size", args.image_size),
@@ -1150,7 +1317,8 @@ def main() -> None:
     # raised --timeout expecting slowness) still gets ONE payload attempt
     # — the preflight exists to avoid 3 x 900 s on a dead tunnel, not to
     # veto measurements.
-    pre_err = backend_preflight(cpu=args.cpu or bool(args.cpu_mesh))
+    pre_err = backend_preflight(
+        cpu=args.cpu or bool(args.cpu_mesh) or which == "multislice")
     if pre_err is None:
         out = run_guarded(fwd, timeout=args.timeout)
         if "metric" not in out and not (args.quick or args.cpu):
@@ -1201,6 +1369,8 @@ def main() -> None:
             "lm": ("gpt_small_sync_sgd_tokens_per_sec_per_chip",
                    "tokens/sec", "tpu_lm"),
             "zero": ("zero2_traced_comm_bytes_vs_zero1", "x", "tpu_zero"),
+            "multislice": ("multislice_hier_allreduce_speedup_vs_flat", "x",
+                           "multislice_cpu_mesh"),
         }
         metric, unit, section = payload_info[which]
         out = {

@@ -1,0 +1,153 @@
+"""Slice loss as a survivable failure — the multislice recovery ladder
+end to end (docs/multislice.md).
+
+An emulated 2-slice pod (``kfrun -num-slices 2``: 4 workers, slice-major
+— ranks 0,1 are slice 0, ranks 2,3 slice 1) trains the same host-plane
+ZeRO-2 toy step as ``examples/zero_shrink.py``: ``engine.reduce_scatter``
+hands each rank its 1/n gradient chunk, momentum lives 1/n per rank, and
+``engine.all_gather`` re-assembles the parameters.  Two things are
+slice-aware:
+
+* the buddy mirrors use ``stride = ranks_per_slice``, so every rank's
+  momentum chunk is mirrored into the NEXT slice — a whole slice dying
+  at once (the multislice failure grain) leaves all of its chunks
+  recoverable, where adjacent same-slice mirrors would die together;
+* recovery runs the slice ladder: chaos (``die_slice:slice=1,step=3``)
+  kills BOTH ranks of slice 1 at the same step boundary, survivors get
+  the typed ``PeerFailureError``, and ``recover_from_failure`` widens
+  the ping-confirmed dead set to the whole slice, counts quorum in
+  slices (1 of 2 surviving + the lowest-slice tie-break — note that
+  rank-granular strict majority would have REFUSED 2-of-4 and thrown
+  the job to the detector relaunch), reaches exclusion consensus over
+  the surviving slice leaders, re-carves the DCN mesh epoch, and
+  re-carves the momentum from the cross-slice buddy mirrors.
+
+Training then continues on the surviving slice with state bitwise-equal
+to a fixed-world run from the same committed step (the slow e2e test
+replays it in plain numpy and asserts equality).
+
+Run (slice 1 — ranks 2 and 3 — dies at step 3 of 8)::
+
+    python -m kungfu_tpu.runner.cli -np 4 -num-slices 2 \
+        -tolerate-failures -chaos 'die_slice:slice=1,step=3' \
+        python3 examples/multislice_shrink.py --n-steps 8
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
+import json
+import math
+
+import numpy as np
+
+TOTAL = 32  # parameter count; not divisible by 4 x 3 — padding stays live
+LR, MOMENTUM = 0.125, 0.5  # exact binary fractions: bitwise-replayable
+
+
+def grad_at(params: np.ndarray, step: int) -> np.ndarray:
+    """Deterministic per-rank gradient, IDENTICAL on every rank — the
+    mean over ranks is then world-size-invariant, so an elastic run is
+    directly comparable to a fixed-size numpy replay."""
+    target = np.full(TOTAL, step * 0.125, np.float32)
+    return (params - target).astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n-steps", type=int, default=8)
+    args = ap.parse_args()
+
+    os.environ.setdefault("KF_CONFIG_PEER_DEADLINE", "5")
+
+    import kungfu_tpu as kf
+    from kungfu_tpu import chaos
+    from kungfu_tpu.checkpoint import StepSnapshot
+    from kungfu_tpu.comm.faults import (PeerFailureError, QuorumLostError,
+                                        SliceExcludedError)
+    from kungfu_tpu.elastic.reshard import ZeroBoundary
+
+    peer = kf.init()
+    n, rank = kf.cluster_size(), peer.rank()
+    topo = peer.slice_topology()
+    assert topo is not None, "run under kfrun -num-slices (docs/multislice.md)"
+    print(f"multislice worker {rank}/{n} up "
+          f"(slice {peer.slice_id()}/{topo.num_slices})", flush=True)
+
+    params = (np.arange(TOTAL, dtype=np.float32) / TOTAL)
+    chunk = math.ceil(TOTAL / n)
+    m_chunk = np.zeros(chunk, np.float32)  # momentum: 1/n per rank
+    snap = StepSnapshot()
+    boundary = ZeroBoundary()
+    step = 0
+    while step < args.n_steps:
+        chaos.note_step(peer.chaos_rank(), step)
+        grad = grad_at(params, step)
+        try:
+            engine = peer.engine()
+            g_chunk = engine.reduce_scatter(grad, op="mean", name=f"g{step}")
+            m_chunk = MOMENTUM * m_chunk + g_chunk
+            padded = np.zeros(chunk * n, np.float32)
+            padded[:TOTAL] = params
+            p_chunk = padded[rank * chunk:(rank + 1) * chunk] - LR * m_chunk
+            full = engine.all_gather(p_chunk, name=f"p{step}")
+            params = full.reshape(-1)[:TOTAL].copy()
+        except PeerFailureError as err:
+            print(f"rank {peer.rank()}: peer failure ({err})", flush=True)
+            try:
+                shrunk, replay = peer.recover_from_failure(
+                    err, snapshot=snap, zero_boundary=boundary)
+            except SliceExcludedError as exc:
+                # alive, but the slice is not: stand down cleanly
+                print(f"excluded with degraded slice: {exc}", flush=True)
+                kf.finalize()
+                return
+            except QuorumLostError:
+                print("slice quorum lost; deferring to the detector restart",
+                      flush=True)
+                raise
+            if shrunk and replay is not None:
+                step, tree, _ = replay
+                params = tree["params"]
+                n, rank = kf.cluster_size(), peer.rank()
+                topo = peer.slice_topology()
+                chunk = math.ceil(TOTAL / n)
+                # momentum was re-carved for the surviving slices, the
+                # dead slice's chunks served from cross-slice buddies
+                bstep, vec, _ = boundary.chunks()
+                assert bstep == step, (bstep, step)
+                m_chunk = vec[0]
+                step += 1
+                print(f"slice-shrunk to {n} workers "
+                      f"({topo.num_slices} slice(s)); momentum re-carved, "
+                      f"replaying from step {step}", flush=True)
+            continue  # transient: retry; shrunk: replay
+        # committed boundary: params whole, momentum sharded + mirrored
+        snap.commit(step, {"params": params})
+        boundary.commit_local(step, {"m": m_chunk}, total=TOTAL,
+                              old_n=n, my_old=rank)
+        if n > 1:
+            # cross-slice buddies: the mirror must survive ITS OWNER'S
+            # whole slice dying, so it lives ranks_per_slice away; once
+            # a single slice remains the failure grain is back to ranks
+            # and the classic adjacent ring applies
+            stride = (topo.ranks_per_slice if topo.num_slices > 1 else 1)
+            boundary.replicate_ring(peer.channel, peer.cluster.workers,
+                                    tag=f"s{step}", stride=stride)
+        step += 1
+
+    print(f"multislice survived to step {step} on {kf.cluster_size()} "
+          f"workers ({peer.slice_topology().num_slices} slice(s))",
+          flush=True)
+    if peer.rank() == 0:
+        print("FINAL " + json.dumps([float(x) for x in params]), flush=True)
+    kf.finalize()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,9 +1,10 @@
 """env-contract checker: the ``KF_*`` env-var registry cannot drift.
 
-Direction 1 (unregistered read): every ``KF_[A-Z0-9_]+`` token that
-appears in Python under ``kungfu_tpu``/``scripts``/``benchmarks`` or in
-``native/*.cpp`` must appear in :mod:`kungfu_tpu.utils.envs` (docstring
-table or constant).  Direction 2 (dead registry entry): every ``KF_*``
+Direction 1 (unregistered read): every ``KF_[A-Z0-9_]+`` token (and
+every ``MEGASCALE_[A-Z0-9_]+`` token — the TPU multislice contract the
+platform adapter and slice topology read) that appears in Python under
+``kungfu_tpu``/``scripts``/``benchmarks`` or in ``native/*.cpp`` must
+appear in :mod:`kungfu_tpu.utils.envs` (docstring table or constant).  Direction 2 (dead registry entry): every ``KF_*``
 token in the registry must have at least one reader — either the literal
 elsewhere in the tree, or a reference to the envs.py constant bound to
 it (``envs.SELF_SPEC`` style), including inside envs.py's own parsing
@@ -30,7 +31,7 @@ from kungfu_tpu.analysis.core import (
 )
 
 CHECKER = "env-contract"
-_TOKEN_RE = re.compile(r"\bKF_[A-Z0-9_]+\b")
+_TOKEN_RE = re.compile(r"\b(?:KF|MEGASCALE)_[A-Z0-9_]+\b")
 
 REGISTRY_PATH = os.path.join("kungfu_tpu", "utils", "envs.py")
 
@@ -57,7 +58,7 @@ def _registry_constants(root: str) -> Dict[str, str]:
             and isinstance(node.targets[0], ast.Name)
             and isinstance(node.value, ast.Constant)
             and isinstance(node.value.value, str)
-            and node.value.value.startswith("KF_")
+            and node.value.value.startswith(("KF_", "MEGASCALE_"))
         ):
             out[node.targets[0].id] = node.value.value
     return out

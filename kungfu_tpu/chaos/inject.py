@@ -85,20 +85,44 @@ class ChaosController:
             raise InjectedDeath(why)
         os._exit(DIE_EXIT_CODE)
 
+    def _slice_matches(self, clause: Clause) -> bool:
+        """Does THIS controller's process/rank live in the clause's
+        target slice?  Slice identity comes from ``MEGASCALE_SLICE_ID``
+        (one process per worker: the launcher's emulation contract, or
+        the real pod host env) and falls back to ``rank // rps`` for
+        in-process multi-rank clusters that share one environment."""
+        want = clause.get("slice")
+        if want is None:
+            return False
+        sid = (os.environ.get(envs.MEGASCALE_SLICE_ID, "") or "").strip()
+        if sid:
+            return int(sid) == want
+        rps = clause.get("rps")
+        if rps and self.rank is not None:
+            return self.rank // rps == want
+        return False
+
     def on_step(self, step: int) -> None:
-        """Training loop announced step ``step`` (``die:step=N``)."""
+        """Training loop announced step ``step`` (``die[_slice]:step=N``)."""
         for c in self._clauses:
             if c.kind == "die" and c.get("step") == step:
                 self._die(c, f"step={step}")
+            elif (c.kind == "die_slice" and c.get("step") == step
+                    and self._slice_matches(c)):
+                self._die(c, f"slice={c.get('slice')} step={step}")
 
     def on_collective(self, tag: str) -> None:
-        """Engine is starting a collective (``die:coll=N``, 1-based)."""
+        """Engine is starting a collective (``die[_slice]:coll=N``,
+        1-based)."""
         with self._lock:
             self._colls += 1
             n = self._colls
         for c in self._clauses:
             if c.kind == "die" and c.get("coll") == n:
                 self._die(c, f"coll={n} ({tag!r})")
+            elif (c.kind == "die_slice" and c.get("coll") == n
+                    and self._slice_matches(c)):
+                self._die(c, f"slice={c.get('slice')} coll={n} ({tag!r})")
 
     # -- data-path perturbation -------------------------------------------
     def on_send(self, to_rank: int, name: str, payload, channel=None,

@@ -26,6 +26,17 @@ without scoping a clause applies everywhere):
     :class:`~kungfu_tpu.chaos.inject.InjectedDeath` in the collective;
     for in-process test clusters where ``_exit`` would take the whole
     interpreter down).
+``die_slice``
+    Kill every rank of TPU slice ``slice=S`` — the multislice failure
+    grain (a slice loses DCN/power as a unit; docs/multislice.md).  Each
+    rank's controller evaluates its OWN slice id against ``S``:
+    ``MEGASCALE_SLICE_ID`` when the launcher set it (kfrun emulation /
+    real pod env), else ``rank // rps`` when ``rps=K`` (ranks per slice)
+    is given — in-process multi-rank test clusters have one env, so they
+    pass ``rps``.  Triggers and ``mode`` as for ``die``; all matching
+    ranks fire at the same step/collective boundary, so the whole slice
+    goes down "at once", deterministically under ``KF_CHAOS_SEED``
+    (death needs no randomness — the seed only ever feeds delay jitter).
 ``reset``
     Connection reset mid-chunk: on this rank's Nth engine send
     (``send=N``), transmit a frame header promising the full chunk,
@@ -57,16 +68,17 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-KINDS = ("die", "reset", "delay", "drop_fanout", "config_down")
+KINDS = ("die", "die_slice", "reset", "delay", "drop_fanout", "config_down")
 
 _INT_PARAMS = {
     "rank", "step", "coll", "send", "peer", "every", "count", "after",
-    "ms", "jitter",
+    "ms", "jitter", "slice", "rps",
 }
 _STR_PARAMS = {"mode", "host", "on"}
 
 _ALLOWED = {
     "die": {"rank", "step", "coll", "mode"},
+    "die_slice": {"slice", "step", "coll", "mode", "rps"},
     "reset": {"rank", "send", "peer"},
     "delay": {"rank", "ms", "jitter", "peer", "every", "on"},
     "drop_fanout": {"host", "count"},
@@ -121,8 +133,10 @@ def _parse_clause(text: str) -> Clause:
             else:
                 params[key] = val
     mode = params.get("mode")
-    if kind == "die" and mode not in (None, "exit", "raise"):
-        raise ValueError(f"die mode must be exit|raise, got {mode!r}")
+    if kind in ("die", "die_slice") and mode not in (None, "exit", "raise"):
+        raise ValueError(f"{kind} mode must be exit|raise, got {mode!r}")
+    if kind == "die_slice" and params.get("slice") is None:
+        raise ValueError("die_slice needs slice=S (the slice to kill)")
     if kind == "delay" and params.get("on") not in (None, "send", "recv"):
         raise ValueError(f"delay on= must be send|recv, got {params.get('on')!r}")
     return Clause(kind, tuple(sorted(params.items())))

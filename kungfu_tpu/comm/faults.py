@@ -51,6 +51,25 @@ class PeerFailureError(ConnectionError):
         )
 
 
+class SliceExcludedError(RuntimeError):
+    """This worker is ALIVE but its slice is not: the ping-confirmed
+    dead set covers part of its slice, and a half-dead slice has no
+    within-slice (ICI) mesh left — it must not silently keep training
+    (:mod:`kungfu_tpu.elastic.slices`).  The surviving slices exclude
+    the whole slice; a worker catching this should stop cleanly (its
+    runner sees an orderly exit, not a crash) and wait for redeployment
+    of the repaired slice."""
+
+    def __init__(self, slice_id: int, dead_ranks):
+        self.slice_id = slice_id
+        self.dead_ranks = sorted(dead_ranks)
+        super().__init__(
+            f"slice {slice_id} is degraded (dead ranks {self.dead_ranks}); "
+            "this surviving member is excluded with it — a half-dead "
+            "slice must not keep training"
+        )
+
+
 class QuorumLostError(RuntimeError):
     """Shrink-to-survivors cannot proceed: the surviving set is not a
     strict majority of the current membership.  The caller's last resort

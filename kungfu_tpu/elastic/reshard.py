@@ -107,6 +107,10 @@ class ZeroBoundary:
         #: ring-buddy mirror of the successor's chunks (chunk mode)
         self._buddy: Dict[int, np.ndarray] = {}
         self._buddy_of: Optional[int] = None
+        #: ring distance of the buddy exchange (1 = adjacent successor;
+        #: multislice runs use ranks_per_slice so every mirror lands in
+        #: a DIFFERENT slice and a whole-slice death stays recoverable)
+        self._buddy_stride: int = 1
         #: vector leaf dtypes (survives even when a joiner holds no data)
         self._vec_dtypes: Dict[int, np.dtype] = {}
 
@@ -174,6 +178,7 @@ class ZeroBoundary:
             # a fresh commit invalidates any buddy mirror of older state
             self._buddy = {}
             self._buddy_of = None
+            self._buddy_stride = 1
 
     def commit_local(self, step: int, opt_chunk_tree, total: int,
                      old_n: int, my_old: int) -> None:
@@ -212,6 +217,7 @@ class ZeroBoundary:
             self._vec_dtypes = {i: a.dtype for i, a in vec.items()}
             self._buddy = {}
             self._buddy_of = None
+            self._buddy_stride = 1
 
     def chunks(self) -> Tuple[int, Dict[int, np.ndarray], Dict[int, np.ndarray]]:
         """(step, vector chunks, scalars) of the current carve — the
@@ -250,6 +256,7 @@ class ZeroBoundary:
                                 for i in vec_idx}
             self._buddy = {}
             self._buddy_of = None
+            self._buddy_stride = 1
 
     def step(self) -> Optional[int]:
         with self._lock:
@@ -261,14 +268,24 @@ class ZeroBoundary:
             return self._old_n
 
     # -- ring-buddy redundancy (chunk mode) -------------------------------
-    def replicate_ring(self, chan, workers, tag: str = "0") -> None:
-        """Mirror this rank's committed chunks onto its ring predecessor
-        (rank ``(r-1) % n``) and adopt the successor's — after this, any
-        SINGLE dead rank's chunk survives on its predecessor and
+    def replicate_ring(self, chan, workers, tag: str = "0",
+                       stride: int = 1) -> None:
+        """Mirror this rank's committed chunks onto the rank ``stride``
+        ring positions behind it (``(r - stride) % n``) and adopt the
+        chunks of the rank ``stride`` ahead — after this, any single
+        dead rank's chunk survives ``stride`` positions away and
         :func:`recarve` can serve it.  ``O(total/n)`` bytes each way,
         run at a committed step boundary (off the hot path).  ``tag``
         must be identical on every rank (step number or cluster
-        version)."""
+        version), and so must ``stride`` — it is part of the exchange
+        geometry.
+
+        ``stride=1`` is the classic adjacent-successor ring.  Multislice
+        jobs pass ``stride = ranks_per_slice``: every mirror then lands
+        in the NEXT slice, so a whole slice dying at once (the
+        multislice failure grain) leaves every one of its chunks alive
+        on the predecessor slice — adjacent same-slice mirrors would all
+        die together."""
         with self._lock:
             if self._step is None:
                 raise ValueError("replicate_ring before any commit")
@@ -278,20 +295,26 @@ class ZeroBoundary:
             my_old, n = self._my_old, self._old_n
         if n is None or n < 2:
             return
-        pred = workers[(my_old - 1) % n]
-        succ = workers[(my_old + 1) % n]
+        stride = int(stride)
+        if not 1 <= stride < n:
+            raise ValueError(
+                f"buddy stride {stride} must be in [1, {n}) — a stride "
+                "of the whole ring mirrors a rank onto itself")
+        pred = workers[(my_old - stride) % n]
+        succ = workers[(my_old + stride) % n]
         bio = io.BytesIO()
         np.savez(bio, **{f"v{i}": a for i, a in vec.items()})
         name = f"kf.zbuddy.{tag}"
         timeline.event("shrink", "buddy-replicate", rank=my_old,
-                       nbytes=bio.getbuffer().nbytes)
+                       nbytes=bio.getbuffer().nbytes, stride=stride)
         chan.send(pred, name, bio.getvalue())
         with np.load(io.BytesIO(_recv_or_fail(
-                chan, succ, (my_old + 1) % n, "zero-buddy", name))) as z:
+                chan, succ, (my_old + stride) % n, "zero-buddy", name))) as z:
             buddy = {int(k[1:]): z[k] for k in z.files}
         with self._lock:
             self._buddy = buddy
-            self._buddy_of = (my_old + 1) % n
+            self._buddy_of = (my_old + stride) % n
+            self._buddy_stride = stride
 
     # -- re-carve ---------------------------------------------------------
     def recarve(self, new_n: int, peer=None, old_workers=None,
@@ -382,6 +405,7 @@ class ZeroBoundary:
             vec = dict(self._vec)
             dtypes = dict(self._vec_dtypes)
             buddy, buddy_of = dict(self._buddy), self._buddy_of
+            stride = self._buddy_stride
         me = peer.config.self_id
         # the plan is computed from the boundary's recorded epoch
         # (old_n, my_old) while addressing uses the caller's old_workers;
@@ -407,7 +431,7 @@ class ZeroBoundary:
             """Old rank whose host serves old rank ``o``'s segments."""
             if o in alive:
                 return o
-            pred = (o - 1) % old_n
+            pred = (o - stride) % old_n
             if pred in alive:
                 return pred  # serves from its buddy mirror
             return None
@@ -416,10 +440,10 @@ class ZeroBoundary:
             serv = server_of(o)
             if serv is None:
                 raise ValueError(
-                    f"old rank {o} is dead and so is its ring predecessor "
-                    f"{(o - 1) % old_n} — chunk unrecoverable (ring-buddy "
-                    "redundancy covers single failures; escalate to the "
-                    "checkpoint restart)")
+                    f"old rank {o} is dead and so is its buddy predecessor "
+                    f"{(o - stride) % old_n} (stride {stride}) — chunk "
+                    "unrecoverable (buddy redundancy covers one failure "
+                    "domain; escalate to the checkpoint restart)")
             if serv == my_old and buddy_of != o:
                 raise ValueError(
                     f"old rank {o} is dead and this rank holds no buddy "
@@ -519,6 +543,7 @@ class ZeroBoundary:
             self._chunk = new_chunk
             self._buddy = {}
             self._buddy_of = None
+            self._buddy_stride = 1
 
     # -- placement --------------------------------------------------------
     def place(self, new_comm):

@@ -33,6 +33,26 @@ FETCH_RETRY_CAP_S = 2.0
 DEFAULT_TIMEOUT_S = 120.0
 
 
+def slice_aligned_size(peer, new_size: int) -> int:
+    """Clamp a proposed worker count to whole slices on a multislice
+    pod (``Peer.propose_new_size`` calls this before the PUT): planned
+    elasticity grows/shrinks by slices — a worker count that splits a
+    slice would leave chips with no within-slice mesh.  Single-slice
+    jobs pass through untouched."""
+    topo = peer.slice_topology()
+    if topo is None:
+        return new_size
+    from kungfu_tpu.elastic.slices import align_to_slices
+
+    aligned = align_to_slices(new_size, topo)
+    if aligned != new_size:
+        _log.warning(
+            "proposed size %d is not whole slices (%d ranks/slice) — "
+            "aligning to %d", new_size, topo.ranks_per_slice, aligned,
+        )
+    return aligned
+
+
 def fetch_cluster(url: str, chaos=None) -> Tuple[Cluster, int]:
     if chaos is not None and chaos.config_unavailable():
         raise urllib.error.URLError("chaos: config-server unavailability window")

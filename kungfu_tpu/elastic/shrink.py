@@ -29,13 +29,23 @@ with ``DEFAULT_COMPILE_GRACE_S``.  This module makes the restart the
 Survivors that were blocked on the victim converge here within one
 per-peer deadline of each other, so the consensus collective rendezvouses
 without extra coordination.
+
+**Multislice pods** (``MEGASCALE_NUM_SLICES`` > 1) run the same ladder at
+*slice* granularity (docs/multislice.md): the ping-confirmed dead set is
+widened to whole slices (a partially-dead slice is excluded whole — its
+live members get :class:`~kungfu_tpu.comm.faults.SliceExcludedError`),
+quorum is counted in slices with a lowest-slice tie-break at exactly
+half, and the exclusion consensus runs over the surviving slices'
+leaders with an ICI-local relay to their members.  Single-slice jobs
+never touch any of it.
 """
 
 from __future__ import annotations
 
 from typing import Iterable, List, Optional, Sequence, Tuple
 
-from kungfu_tpu.comm.faults import PeerFailureError, QuorumLostError
+from kungfu_tpu.comm.faults import (PeerFailureError, QuorumLostError,
+                                    SliceExcludedError)
 from kungfu_tpu.monitor import timeline
 from kungfu_tpu.plan.cluster import Cluster
 from kungfu_tpu.utils.log import get_logger, log_event
@@ -95,6 +105,100 @@ def find_dead_ranks(peer, suspects: Iterable[int] = (),
     return sorted(set(dead))
 
 
+def _peer_slice_topology(peer):
+    """The peer's current slice topology (None = single slice).  Guarded
+    with ``getattr`` so hand-rolled peer doubles in tests — and any
+    driver predating the multislice wiring — keep the rank-granular
+    path unchanged."""
+    fn = getattr(peer, "slice_topology", None)
+    return fn() if callable(fn) else None
+
+
+def expand_dead_to_slices(peer, topo, dead: Sequence[int]) -> List[int]:
+    """Slice-granular death verdict: widen a ping-confirmed dead rank
+    set to WHOLE slices.  A slice with every member dead is dead; a
+    slice with some members dead is *degraded* — its survivors answer
+    ping but have no within-slice mesh left, so the protocol excludes
+    the whole slice rather than let a half-dead slice silently keep
+    training.  Raises :class:`SliceExcludedError` when THIS peer's own
+    slice is among them (the caller is alive but must stand down)."""
+    from kungfu_tpu.elastic.slices import slice_verdict
+
+    workers = peer.cluster.workers
+    me = workers.rank(peer.config.self_id)
+    dead_slices, degraded = slice_verdict(dead, topo)
+    excluded = dead_slices | degraded
+    timeline.event("slice", "verdict", rank=me,
+                   dead_slices=sorted(dead_slices),
+                   degraded=sorted(degraded))
+    if not excluded:
+        return sorted(set(dead))
+    if degraded:
+        _log.warning(
+            "slice(s) %s are PARTIALLY dead — degrading to excluded "
+            "(a half-dead slice must not keep training)", sorted(degraded),
+        )
+    my_slice = topo.slice_of(me)
+    if my_slice in excluded:
+        timeline.event("slice", "self-excluded", rank=me, slice=my_slice)
+        raise SliceExcludedError(
+            my_slice, [r for r in dead if topo.slice_of(r) == my_slice])
+    return sorted({r for s in excluded for r in topo.ranks_in(s)})
+
+
+def _slice_consensus(peer, topo, payload: bytes, digest: str,
+                     survivor_ranks: Sequence[int]) -> bool:
+    """Exclusion consensus at slice granularity: one vote among the
+    surviving slices' LEADERS over the DCN control plane, then each
+    leader relays the verdict to its own slice members (ICI-local).
+    Slice members of a surviving slice are all alive by construction
+    (any death degrades the slice to excluded), so the leader is always
+    the slice's lowest rank."""
+    workers = peer.cluster.workers
+    me = workers.rank(peer.config.self_id)
+    my_slice = topo.slice_of(me)
+    surv_slices = sorted({topo.slice_of(r) for r in survivor_ranks})
+    leader_ranks = [topo.leader_of(s) for s in surv_slices]
+    leaders = workers.select(leader_ranks)
+    timeline.event("slice", "leader-consensus", rank=me,
+                   slices=surv_slices, digest=digest)
+    ok = False
+    if me in leader_ranks:
+        try:
+            # subgroup collective, not SPMD divergence: the participant
+            # list IS `leaders`, and the guard admits exactly its
+            # members — non-leaders rendezvous on the relay below
+            ok = peer.channel.consensus_bytes(  # kflint: allow(collective-consistency)
+                payload, leaders, name=f"kf.slice.{digest}",
+                send_retries=_RECOVERY_SEND_RETRIES,
+            )
+        except (TimeoutError, ConnectionError, OSError) as e:
+            _log.warning("slice-leader consensus did not converge: %s", e)
+            ok = False
+    if topo.ranks_per_slice == 1:
+        return ok
+    # relay: the leader broadcasts (verdict, payload) to its slice; a
+    # member checks the payload against its OWN computed proposal so a
+    # leader that agreed to a DIFFERENT shrunk cluster cannot drag its
+    # slice along silently.  Name is digest- and slice-keyed: divergent
+    # proposals and neighboring slices cannot cross-talk.
+    members = workers.select(topo.ranks_in(my_slice))
+    name = f"kf.slice.{digest}.s{my_slice}"
+    verdict = (b"\x01" if ok else b"\x00") + payload
+    try:
+        if me == topo.leader_of(my_slice):
+            peer.channel.broadcast_bytes(
+                verdict, members, name,
+                send_retries=_RECOVERY_SEND_RETRIES,
+            )
+            return ok
+        blob = peer.channel.broadcast_bytes(None, members, name)
+        return bool(blob) and blob[:1] == b"\x01" and blob[1:] == payload
+    except (TimeoutError, ConnectionError, OSError) as e:
+        _log.warning("slice verdict relay failed: %s", e)
+        return False
+
+
 def shrink_to_survivors(peer, dead_ranks: Sequence[int]) -> bool:
     """Evict ``dead_ranks`` by exclusion consensus among the survivors
     and apply the shrunk membership through the elastic propose path.
@@ -110,14 +214,45 @@ def shrink_to_survivors(peer, dead_ranks: Sequence[int]) -> bool:
     dead = sorted({r for r in dead_ranks if 0 <= r < len(workers)})
     if not dead:
         return False
-    survivor_ranks = [r for r in range(len(workers)) if r not in dead]
     me = workers.rank(peer.config.self_id)
     if me is None or me in dead:
         raise ValueError("shrink_to_survivors must run on a surviving member")
+    topo = _peer_slice_topology(peer)
+    if topo is not None and topo.num_slices <= 1:
+        # a job shrunk down to ONE surviving slice has its failure grain
+        # back at ranks (there is no cross-slice mesh left to protect,
+        # and treating the lone slice as excludable-whole would turn any
+        # single death into a full stop) — run the classic rank ladder
+        topo = None
+    if topo is not None:
+        # slice-granular: whole slices die together (partial death
+        # degrades the slice to excluded; raises SliceExcludedError on
+        # a surviving member of a degraded slice)
+        dead = expand_dead_to_slices(peer, topo, dead)
+    survivor_ranks = [r for r in range(len(workers)) if r not in dead]
+    if topo is not None:
+        # quorum is counted in SLICES: strict majority, or exactly half
+        # holding the lowest slice id (the deterministic tie-break only
+        # one partition side can satisfy) — the rule that makes the
+        # canonical 2-slice pod's slice loss survivable at all
+        from kungfu_tpu.elastic.slices import slice_quorum_ok
+
+        surv_slices = sorted({topo.slice_of(r) for r in survivor_ranks})
+        if not slice_quorum_ok(surv_slices, topo):
+            timeline.event("slice", "quorum-lost", rank=me,
+                           survivors=len(surv_slices),
+                           total=topo.num_slices)
+            if me == min(survivor_ranks):
+                from kungfu_tpu.monitor.aggregator import \
+                    post_control_if_enabled
+
+                post_control_if_enabled(peer, "quorum-lost", dead=dead,
+                                        survivors=len(surv_slices))
+            raise QuorumLostError(len(surv_slices), topo.num_slices)
     # strict majority: a minority partition must NOT shrink-and-continue
     # (two half-clusters training independently is silent divergence,
     # worse than a restart) — it falls back to the detector instead
-    if 2 * len(survivor_ranks) <= len(workers):
+    elif 2 * len(survivor_ranks) <= len(workers):
         timeline.event("shrink", "quorum-lost", rank=me,
                        survivors=len(survivor_ranks), total=len(workers))
         if me == min(survivor_ranks):
@@ -150,18 +285,23 @@ def shrink_to_survivors(peer, dead_ranks: Sequence[int]) -> bool:
     digest = hashlib.blake2b(payload, digest_size=8).hexdigest()
     timeline.event("shrink", "consensus", rank=me, dead=dead,
                    version=version, digest=digest)
-    try:
-        # send_retries is SHORT: this collective runs exactly when peers
-        # are dying, and a consensus root that died after the ping sweep
-        # must surface as ConnectionError in seconds, not after the
-        # channel's 500-rung bring-up ladder
-        ok = peer.channel.consensus_bytes(
-            payload, survivors, name=f"kf.shrink.{digest}",
-            send_retries=_RECOVERY_SEND_RETRIES,
-        )
-    except (TimeoutError, ConnectionError, OSError) as e:
-        _log.warning("exclusion consensus did not converge: %s", e)
-        ok = False
+    if topo is not None:
+        # cross-slice agreement runs over slice LEADERS only (one DCN
+        # round-trip per surviving slice), relayed ICI-locally
+        ok = _slice_consensus(peer, topo, payload, digest, survivor_ranks)
+    else:
+        try:
+            # send_retries is SHORT: this collective runs exactly when
+            # peers are dying, and a consensus root that died after the
+            # ping sweep must surface as ConnectionError in seconds, not
+            # after the channel's 500-rung bring-up ladder
+            ok = peer.channel.consensus_bytes(
+                payload, survivors, name=f"kf.shrink.{digest}",
+                send_retries=_RECOVERY_SEND_RETRIES,
+            )
+        except (TimeoutError, ConnectionError, OSError) as e:
+            _log.warning("exclusion consensus did not converge: %s", e)
+            ok = False
     if not ok:
         _log.warning(
             "survivors disagree on the dead set (mine: %s) — not shrinking",
@@ -174,6 +314,10 @@ def shrink_to_survivors(peer, dead_ranks: Sequence[int]) -> bool:
     )
     timeline.event("shrink", "propose", rank=me, dead=dead,
                    version=version, survivors=len(survivors))
+    if topo is not None:
+        timeline.event("slice", "propose", rank=me,
+                       dead_slices=sorted({topo.slice_of(r) for r in dead}),
+                       version=version)
     _publish_shrunk_cluster(peer, new_cluster, survivors)
     peer._propose(new_cluster, version)
     log_event(f"shrunk-to-survivors-v{version}-n{len(survivors)}")
@@ -184,8 +328,11 @@ def shrink_to_survivors(peer, dead_ranks: Sequence[int]) -> bool:
     if survivors.rank(peer.config.self_id) == 0:
         from kungfu_tpu.monitor.aggregator import post_control_if_enabled
 
+        extra = {}
+        if topo is not None:
+            extra["slices"] = sorted({topo.slice_of(r) for r in dead})
         post_control_if_enabled(peer, "shrink", dead=dead, version=version,
-                                survivors=len(survivors))
+                                survivors=len(survivors), **extra)
     return True
 
 

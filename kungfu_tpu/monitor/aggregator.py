@@ -86,6 +86,7 @@ WIRE_VERSION = 1
 SNAPSHOT_FIELDS = frozenset({
     "kfmon",         # wire version (int)
     "rank",          # stable process identity (bootstrap rank)
+    "slice",         # TPU slice id (None on single-slice jobs)
     "pid",           # sender pid
     "wall",          # sender wall-clock at build time
     "step",          # current training step (-1 before the first)
@@ -103,11 +104,15 @@ SNAPSHOT_FIELDS = frozenset({
 VIEW_FIELDS = frozenset({
     "kfmon", "wall", "stale_after_s", "cluster", "ranks", "stale",
     "skew", "slowest_per_step", "straggler", "controls",
+    # slice grouping (multislice jobs; empty on single-slice)
+    "slices", "stale_slices",
     # cluster-health subfields
     "version", "size", "workers", "quorum_margin", "last_control",
     # per-rank row subfields (snapshot fields age_s/stale are computed)
-    "rank", "pid", "step", "step_time_s", "age_s", "counters", "gauges",
-    "latency", "net", "strategy",
+    "rank", "slice", "pid", "step", "step_time_s", "age_s", "counters",
+    "gauges", "latency", "net", "strategy",
+    # per-slice group subfields ("slice"/"ranks"/"stale" shared above)
+    "all_stale",
     # control-event subfields
     "kind", "attrs",
     # skew-row subfields (monitor/skew.py row dicts)
@@ -325,6 +330,7 @@ class ClusterAggregator:
                 stale.append(rank)
             rows.append({
                 "rank": rank,
+                "slice": snap.get("slice"),
                 "pid": snap.get("pid"),
                 "step": snap.get("step"),
                 "step_time_s": snap.get("step_time_s"),
@@ -336,6 +342,28 @@ class ClusterAggregator:
                 "net": snap.get("net") or {},
                 "strategy": snap.get("strategy") or "",
             })
+        # slice grouping (multislice jobs): a WHOLE-stale slice is a
+        # different animal than a stale rank — it is the slice-loss
+        # signature (DCN partition / power), the event the slice-shrink
+        # protocol exists for, so /cluster and kftop flag it distinctly
+        by_slice: Dict[int, dict] = {}
+        for row in rows:
+            s = row["slice"]
+            if s is None:
+                continue
+            g = by_slice.setdefault(
+                int(s), {"slice": int(s), "ranks": [], "stale": []})
+            g["ranks"].append(row["rank"])
+            if row["stale"]:
+                g["stale"].append(row["rank"])
+        slice_groups = []
+        stale_slices = []
+        for s in sorted(by_slice):
+            g = by_slice[s]
+            g["all_stale"] = bool(g["ranks"]) and g["stale"] == g["ranks"]
+            if g["all_stale"]:
+                stale_slices.append(s)
+            slice_groups.append(g)
         health = dict(cluster_info or {})
         size = health.get("size")
         if isinstance(size, int) and size > 0:
@@ -351,6 +379,8 @@ class ClusterAggregator:
             "cluster": health,
             "ranks": rows,
             "stale": stale,
+            "slices": slice_groups,
+            "stale_slices": stale_slices,
             "skew": skewlib.skew_rows(events)[:top],
             "slowest_per_step": skewlib.slowest_rank_per_step(events)[-top:],
             "straggler": skewlib.straggler_verdict(events),
@@ -371,6 +401,13 @@ class ClusterAggregator:
             "# TYPE kf_cluster_stale_ranks gauge",
             f"kf_cluster_stale_ranks {len(view['stale'])}",
         ]
+        if view["slices"]:
+            lines += [
+                "# HELP kf_cluster_stale_slices slices whose EVERY rank "
+                "is stale (slice-loss signature)",
+                "# TYPE kf_cluster_stale_slices gauge",
+                f"kf_cluster_stale_slices {len(view['stale_slices'])}",
+            ]
         version = (view["cluster"] or {}).get("version")
         if version is not None:
             lines += [
@@ -431,6 +468,11 @@ REPORT_KINDS = frozenset(skewlib.COLLECTIVE_KINDS) | frozenset(skewlib.FAULT_KIN
 _STEP_EMA_ALPHA = 0.2
 
 
+#: RankReporter slice_id default: "derive from the MEGASCALE env" —
+#: distinct from an explicit None ("no slice", authoritative)
+_SLICE_FROM_ENV = object()
+
+
 class RankReporter:
     """Per-rank snapshot pusher: one daemon thread, one HTTP POST per
     ``KF_CONFIG_MONITOR_PUSH_PERIOD``.  Delivery failures are swallowed
@@ -441,8 +483,31 @@ class RankReporter:
                  period: Optional[float] = None,
                  strategy_fn: Optional[Callable[[], str]] = None,
                  net_totals_fn: Optional[Callable[[], Dict[str, int]]] = None,
-                 events_fn: Optional[Callable[[], List[dict]]] = None):
+                 events_fn: Optional[Callable[[], List[dict]]] = None,
+                 slice_id=_SLICE_FROM_ENV):
         self.rank = rank
+        # slice identity, like the rank, is the STABLE bootstrap value
+        # (a slice-shrink renumbers live topologies but must not alias
+        # this process's row onto another slice's).  An explicit
+        # slice_id — int or None — is authoritative: a Peer that
+        # REJECTED an incoherent MEGASCALE contract and fell back to
+        # flat passes None, and the env must not resurrect slice rows
+        # (a false kftop SLICE LOSS alarm on a job that will never
+        # slice-shrink).  Default (standalone reporters): the
+        # per-process MEGASCALE_SLICE_ID the launcher stamped; env read
+        # is direct — this module stays importable in the stubbed
+        # kftop/CI context where kungfu_tpu.utils.envs cannot load —
+        # and malformed values mean no slice, not a crash.
+        if slice_id is _SLICE_FROM_ENV:
+            sid = (os.environ.get("MEGASCALE_SLICE_ID", "") or "").strip()
+            num = (os.environ.get("MEGASCALE_NUM_SLICES", "") or "").strip()
+            slice_id = None
+            if sid and num:
+                try:
+                    slice_id = int(sid) if int(num) > 1 else None
+                except ValueError:
+                    slice_id = None
+        self.slice_id = slice_id
         self.period = max(MIN_PUSH_PERIOD_S,
                           push_period_from_env() if period is None else period)
         self._push_url = server_base(server_url) + "/push"
@@ -558,6 +623,7 @@ class RankReporter:
                 _log.debug("strategy_fn unavailable: %s", e)
         return make_snapshot(
             rank=self.rank,
+            slice=self.slice_id,
             pid=os.getpid(),
             wall=now,
             step=step,

@@ -107,6 +107,23 @@ def render_view(view: dict, top: int = 10) -> str:
     if straggler is not None:
         head += f" | straggler: rank {straggler}"
     lines.append(head)
+    slices = field(view, "slices") or []
+    if slices:
+        # a WHOLE-stale slice is the slice-loss signature (DCN/power),
+        # not a straggling rank — render it as its own alarm line
+        stale_slices = field(view, "stale_slices") or []
+        parts = []
+        for g in slices:
+            n_ranks = len(field(g, "ranks") or [])
+            n_stale = len(field(g, "stale") or [])
+            mark = ("LOST" if field(g, "all_stale")
+                    else f"{n_stale}/{n_ranks} stale" if n_stale else "ok")
+            parts.append(f"slice {field(g, 'slice')}: {mark}")
+        lines.append("slices: " + " | ".join(parts))
+        if stale_slices:
+            lines.append(
+                f"!! SLICE LOSS: slice(s) {stale_slices} fully stale — "
+                "expect slice-shrink (docs/multislice.md)")
     last = field(cluster, "last_control")
     if last:
         age = (wall or time.time()) - (field(last, "wall") or 0)
@@ -115,7 +132,9 @@ def render_view(view: dict, top: int = 10) -> str:
             f"({_fmt_s(age)} ago, rank {field(last, 'rank')}) "
             f"{field(last, 'attrs') or ''}")
     lines.append("")
-    hdr = (f"{'rank':>4} {'state':<6} {'age':>7} {'step':>7} "
+    show_slice = any(field(r, "slice") is not None for r in rows)
+    hdr = (f"{'rank':>4} " + (f"{'slice':>5} " if show_slice else "")
+           + f"{'state':<6} {'age':>7} {'step':>7} "
            f"{'step-time':>10} {'coll-lat':>9} {'retries':>8} "
            f"{'faults':>7} {'chaos':>6} "
            f"{'egress':>9} {'ingress':>9}  strategy")
@@ -126,8 +145,11 @@ def render_view(view: dict, top: int = 10) -> str:
         faults = (_counter(row, "kf_peer_faults_total")
                   + _counter(row, "kf_detector_down_total"))
         lat = _window_latency_s(row)
+        sl = field(row, "slice")
         lines.append(
-            f"{field(row, 'rank'):>4} {state:<6} "
+            f"{field(row, 'rank'):>4} "
+            + (f"{sl if sl is not None else '-':>5} " if show_slice else "")
+            + f"{state:<6} "
             f"{_fmt_s(field(row, 'age_s')):>7} "
             f"{field(row, 'step') if field(row, 'step') is not None else '-':>7} "
             f"{_fmt_s(field(row, 'step_time_s')):>10} "
@@ -178,6 +200,7 @@ def self_check() -> int:
         agg.ingest(make_snapshot(
             rank=rank, pid=100 + rank, wall=999.5, step=3,
             step_time_s=0.25,
+            slice=rank // 2,  # 2-rank slice 0 + 1-rank slice 1
             counters={"kf_engine_retries_total": rank},
             gauges={"kf_stat_gns": 1.5},
             latency={"kf_collective_latency_seconds": {"count": 2, "sum": dur}},
@@ -202,9 +225,15 @@ def self_check() -> int:
         == "shrink"
     )
     ok = ok and bool(field(field(view, "ranks")[0], "latency"))
+    # slice grouping: every rank is stale, so both canned slices must be
+    # flagged as whole-stale (the slice-loss signature)
+    ok = (ok
+          and [field(g, "slice") for g in field(view, "slices")] == [0, 1]
+          and field(field(view, "slices")[0], "all_stale")
+          and field(view, "stale_slices") == [0, 1])
     text = render_view(view)
     ok = (ok and "STALE" in text and "all_reduce/grad3" in text
-          and "coll-lat" in text)
+          and "coll-lat" in text and "SLICE LOSS" in text)
     if not ok:
         print("kftop: self-check FAILED (view schema/round-trip mismatch)",
               file=sys.stderr)

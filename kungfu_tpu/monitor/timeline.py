@@ -71,6 +71,9 @@ EVENT_KINDS = frozenset({
     "signal",      # detector heartbeat intake (begin/end/epoch/...)
     "down",        # detector down verdict / local down report
     "shrink",      # shrink-to-survivors phase boundary
+    "slice",       # slice-granular recovery phase (elastic/shrink.py:
+                   # verdict / self-excluded / leader-consensus /
+                   # propose / quorum-lost at the multislice grain)
     "chaos",       # fault injection fired (chaos/inject.py)
     "step",        # training-step mark
     "mark",        # generic one-shot annotation
@@ -86,8 +89,9 @@ _COUNTED_KINDS = {
     "chaos": "kf_chaos_injections_total",
     "down": "kf_detector_down_total",
     "shrink": "kf_shrink_events_total",
+    "slice": "kf_slice_events_total",
 }
-_LABELED_KINDS = ("chaos", "shrink")
+_LABELED_KINDS = ("chaos", "shrink", "slice")
 
 _lock = threading.Lock()
 _ring: collections.deque = collections.deque()

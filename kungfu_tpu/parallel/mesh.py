@@ -77,11 +77,30 @@ class MeshPlan:
         return cls(dp=sizes[AXIS_DP], pp=sizes[AXIS_PP], sp=sizes[AXIS_SP], tp=sizes[AXIS_TP])
 
     def build_mesh(self, devices: Optional[Sequence] = None) -> Mesh:
-        devs = list(devices) if devices is not None else list(jax.devices())
+        devs = list(devices) if devices is not None else self._default_devices()
         if len(devs) < self.size:
             raise ValueError(f"plan needs {self.size} devices, have {len(devs)}")
         grid = np.asarray(devs[: self.size]).reshape(self.dp, self.pp, self.sp, self.tp)
         return Mesh(grid, AXES)
+
+    def _default_devices(self):
+        """The device order the mesh is carved from.  On a multislice pod
+        (``MEGASCALE_NUM_SLICES`` > 1) devices are re-ordered slice-major
+        so the OUTERMOST plan axis — dp, the gradient-allreduce axis the
+        two-stage schedule decomposes hierarchically — spans slices in
+        contiguous blocks: within-slice neighbors stay ICI neighbors and
+        only the dp reduction crosses the DCN, instead of every axis
+        straddling slices in jax's arbitrary enumeration order."""
+        import os as _os
+
+        from kungfu_tpu.utils import envs as _envs
+
+        if int(_os.environ.get(_envs.MEGASCALE_NUM_SLICES, "0") or 0) > 1:
+            from kungfu_tpu.platforms.tpu_pod import slice_mesh_layout
+
+            flat, _ = slice_mesh_layout()
+            return flat
+        return list(jax.devices())
 
     def __str__(self):
         return f"MeshPlan(dp={self.dp}, pp={self.pp}, sp={self.sp}, tp={self.tp})"

@@ -50,9 +50,32 @@ class Peer:
         self._channel: Optional[HostChannel] = None
         self._comm: Optional[Communicator] = None
         self._comm_version = -1
+        #: bootstrap slice topology (None = single slice, the byte-
+        #: identical legacy path); the CURRENT topology is derived per
+        #: membership via slice_topology() — whole-slice elasticity
+        #: keeps ranks_per_slice invariant
+        from kungfu_tpu.elastic.slices import bootstrap_topology
+
+        try:
+            self._slice_boot = bootstrap_topology(
+                len(self.config.cluster.workers))
+        except ValueError as e:
+            # a pod host's inherited MEGASCALE_NUM_SLICES with a worker
+            # world that does not tile it (e.g. -np 3 on a 2-slice pod's
+            # env): before the multislice wiring this trained flat —
+            # keep doing that, loudly, instead of crashing kf.init()
+            _log.warning("incoherent multislice contract (%s) — "
+                         "running single-slice (flat)", e)
+            self._slice_boot = None
         #: carried across mesh epochs — the resize paths retire the old
-        #: communicator object, not the user's strategy decision
-        self._comm_strategy = self.config.device_strategy or "psum"
+        #: communicator object, not the user's strategy decision.
+        #: Multislice default is two_stage: the hierarchical mesh's
+        #: outer (DCN) stage then compiles as an explicit reduce-scatter
+        #: + all-gather over slice representatives after the inner ICI
+        #: psum (ops/schedules.all_reduce_scheduled), instead of one
+        #: flat collective XLA must route across the slow axis blind.
+        self._comm_strategy = self.config.device_strategy or (
+            "two_stage" if self._slice_boot is not None else "psum")
         self._engine = None
         self._engine_version = -1
         self._lock = threading.RLock()
@@ -157,11 +180,18 @@ class Peer:
                 if rank is not None:
                     from kungfu_tpu.monitor.aggregator import RankReporter
 
+                    # slice identity rides the same stable bootstrap
+                    # frame as the rank: kftop's per-slice grouping
+                    # must not re-home a row when a shrink renumbers
+                    # the live topology
+                    slice_id = (self._slice_boot.slice_of(rank)
+                                if self._slice_boot is not None else None)
                     self._reporter = RankReporter(
                         rank, self.config.config_server,
                         strategy_fn=self._active_strategy,
                         net_totals_fn=(self._net_totals
                                        if monitor is not None else None),
+                        slice_id=slice_id,
                     ).start()
             log_event("peer-started")
 
@@ -354,6 +384,30 @@ class Peer:
     def channel(self) -> Optional[HostChannel]:
         return self._channel
 
+    # -- slice identity (multislice pods) ---------------------------------
+    def slice_topology(self):
+        """The CURRENT membership's :class:`~kungfu_tpu.elastic.slices.
+        SliceTopology`, or ``None`` on a single-slice job.  Ranks-per-
+        slice is the bootstrap invariant; the slice count follows the
+        membership (slice-granular elasticity keeps it whole).  A
+        membership that no longer tiles is the rank-granular tail — a
+        job shrunk to its last slice keeps surviving RANK deaths
+        (elastic/shrink.py falls back to the classic ladder there), and
+        from then on slice semantics are over: ``None``."""
+        if self._slice_boot is None:
+            return None
+        try:
+            return self._slice_boot.for_size(self.size())
+        except ValueError:
+            return None
+
+    def slice_id(self) -> Optional[int]:
+        """This worker's slice in the CURRENT membership (``None`` on a
+        single-slice job; raises for detached/standby peers, like
+        :meth:`rank`)."""
+        topo = self.slice_topology()
+        return None if topo is None else topo.slice_of(self.rank())
+
     def chaos_rank(self) -> Optional[int]:
         """Stable fault-injection identity: this process's rank in its
         BOOTSTRAP worker list.  Elastic reshuffles change :meth:`rank`
@@ -445,6 +499,25 @@ class Peer:
                 devices = local_size = None
                 if self._jax_initialized:
                     devices, local_size = self._carve_active_devices()
+                if self._slice_boot is not None and devices is not None:
+                    # multislice: the mesh epoch is hierarchical — outer
+                    # axis = slice (DCN), inner = within-slice (ICI).
+                    # slice_mesh_layout re-groups the carved devices by
+                    # slice (the emulation contract groups by process)
+                    # and validates the federation against the CURRENT
+                    # topology: after a slice-shrink the surviving
+                    # devices regroup into fewer slices — the DCN mesh
+                    # re-carve (docs/multislice.md).  Without a booted
+                    # jax.distributed world (devices=None: the host-
+                    # plane emulation) this lone process's local devices
+                    # cannot show the federation — the legacy local
+                    # Communicator stands
+                    from kungfu_tpu.platforms.tpu_pod import \
+                        slice_mesh_layout
+
+                    topo = self.slice_topology()
+                    devices, local_size = slice_mesh_layout(
+                        topo.num_slices, devices)
                 # an installed schedule (set_strategy / autotune)
                 # survives the mesh epoch swap — the resize rebuilds the
                 # mesh, not the user's strategy decision — and the epoch
@@ -508,6 +581,11 @@ class Peer:
             raise RuntimeError("propose_new_size requires KF_CONFIG_SERVER")
         if self.rank() != 0:
             return
+        from kungfu_tpu.elastic.resize import slice_aligned_size
+
+        # multislice: planned elasticity moves whole slices (a fractional
+        # slice has no within-slice mesh to join) — no-op on single-slice
+        new_size = slice_aligned_size(self, new_size)
         world = self.config.world_peers
         if world is not None and new_size > len(world):
             # a phantom worker (valid PeerID, no process) would wedge every

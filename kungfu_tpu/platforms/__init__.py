@@ -1,1 +1,7 @@
-from kungfu_tpu.platforms.tpu_pod import PodInfo, parse_tpu_pod_env  # noqa: F401
+from kungfu_tpu.platforms.tpu_pod import (  # noqa: F401
+    PodInfo,
+    multislice_communicator,
+    parse_tpu_pod_env,
+    slice_device_groups,
+    slice_mesh_layout,
+)

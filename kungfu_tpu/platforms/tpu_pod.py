@@ -29,15 +29,19 @@ from dataclasses import dataclass
 from typing import Optional
 
 from kungfu_tpu.plan.hostspec import HostList
+from kungfu_tpu.utils import envs
 from kungfu_tpu.utils.log import get_logger
 
 _log = get_logger("tpu-pod")
 
 WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
 WORKER_ID = "TPU_WORKER_ID"
-MEGASCALE_COORDINATOR = "MEGASCALE_COORDINATOR_ADDRESS"
-MEGASCALE_SLICE_ID = "MEGASCALE_SLICE_ID"
-MEGASCALE_NUM_SLICES = "MEGASCALE_NUM_SLICES"
+# the MEGASCALE_* contract is anchored in the env registry
+# (utils/envs.py) like every other env this framework reads; these are
+# aliases for the module's historical public names
+MEGASCALE_COORDINATOR = envs.MEGASCALE_COORDINATOR
+MEGASCALE_SLICE_ID = envs.MEGASCALE_SLICE_ID
+MEGASCALE_NUM_SLICES = envs.MEGASCALE_NUM_SLICES
 
 
 @dataclass(frozen=True)
@@ -129,24 +133,25 @@ def slice_device_groups(devices=None, by: str = "slice"):
     return [groups[k] for k in sorted(groups)]
 
 
-def multislice_communicator(num_slices: Optional[int] = None, devices=None,
-                            version: int = 0):
-    """Build a hierarchical Communicator whose OUTER mesh axis is the
-    slice (DCN) and inner axis the within-slice chips (ICI) — the
-    two-level topology the ``two_stage`` schedule decomposes over:
-    reduce within each slice over ICI, exchange once across slices over
-    DCN, broadcast back (SURVEY §5.8; reference local/cross split,
-    ``session/strategy.go:176-210``).
+def slice_mesh_layout(num_slices: Optional[int] = None, devices=None):
+    """``(devices_slice_major, per_slice)`` for a hierarchical mesh whose
+    OUTER axis is the slice (DCN) and inner axis the within-slice chips
+    (ICI).  Shared validation core of :func:`multislice_communicator`
+    and :meth:`kungfu_tpu.peer.Peer.communicator`'s multislice path:
 
-    ``num_slices`` defaults to the ``MEGASCALE_NUM_SLICES`` contract and
-    is validated against the devices actually visible; raises when the
-    federation does not show the expected slice count (a half-joined
-    multislice job must fail loudly, not silently train one slice).
+    * ``num_slices`` defaults to the ``MEGASCALE_NUM_SLICES`` contract
+      and is validated against the devices actually visible; a mismatch
+      raises (a half-joined multislice job must fail loudly, not
+      silently train one slice);
+    * when the contract disagrees with the ``slice_index`` grouping but
+      matches the per-process grouping, the emulation contract applies
+      (one jax process per "slice", ``MEGASCALE_SLICE_ID`` = process
+      id — the CPU-mesh harness);
+    * uneven slice sizes raise: multislice meshes need identical slices.
     """
-    from kungfu_tpu.comm.device import Communicator
-
     if num_slices is None:
-        num_slices = int(os.environ.get(MEGASCALE_NUM_SLICES, "0") or 0) or None
+        num_slices = int(
+            os.environ.get(envs.MEGASCALE_NUM_SLICES, "0") or 0) or None
     groups = slice_device_groups(devices)
     if num_slices is not None and len(groups) != num_slices:
         # emulation: one jax process per slice (CPU devices report a
@@ -156,7 +161,7 @@ def multislice_communicator(num_slices: Optional[int] = None, devices=None,
             groups = by_proc
         else:
             raise ValueError(
-                f"{MEGASCALE_NUM_SLICES}={num_slices} but the device "
+                f"{envs.MEGASCALE_NUM_SLICES}={num_slices} but the device "
                 f"world shows {len(groups)} slice group(s) "
                 f"({len(by_proc)} process group(s))"
             )
@@ -166,5 +171,23 @@ def multislice_communicator(num_slices: Optional[int] = None, devices=None,
             f"uneven slice sizes {[len(g) for g in groups]} — multislice "
             "meshes need identical slices"
         )
-    flat = [d for g in groups for d in g]
-    return Communicator(devices=flat, local_size=per, version=version)
+    return [d for g in groups for d in g], per
+
+
+def multislice_communicator(num_slices: Optional[int] = None, devices=None,
+                            version: int = 0, **comm_kwargs):
+    """Build a hierarchical Communicator whose OUTER mesh axis is the
+    slice (DCN) and inner axis the within-slice chips (ICI) — the
+    two-level topology the ``two_stage`` schedule decomposes over:
+    reduce within each slice over ICI, exchange once across slices over
+    DCN, broadcast back (SURVEY §5.8; reference local/cross split,
+    ``session/strategy.go:176-210``).  Validation lives in
+    :func:`slice_mesh_layout`; extra ``comm_kwargs`` (``cluster``,
+    ``strategy``, ``on_strategy_change``) pass through so the Peer's
+    mesh-epoch machinery builds slice-aware epochs through the same
+    door."""
+    from kungfu_tpu.comm.device import Communicator
+
+    flat, per = slice_mesh_layout(num_slices, devices)
+    return Communicator(devices=flat, local_size=per, version=version,
+                        **comm_kwargs)

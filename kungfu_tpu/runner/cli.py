@@ -92,6 +92,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "e.g. 'die:step=5,rank=1' kills rank 1 at step 5)")
     p.add_argument("-chaos-seed", dest="chaos_seed", type=int, default=None,
                    help="KF_CHAOS_SEED for the workers (delay jitter)")
+    p.add_argument("-num-slices", dest="num_slices", type=int, default=0,
+                   help="partition the workers into this many TPU slices "
+                        "(slice-major contiguous).  Each worker's env gets "
+                        "its MEGASCALE_SLICE_ID (+ NUM_SLICES and "
+                        "KF_SLICE_RANKS), switching the peers to the "
+                        "hierarchical ICI-within/DCN-across communicator "
+                        "and slice-granular elasticity.  This is the CPU "
+                        "emulation contract (docs/multislice.md); a real "
+                        "pod's hosts already carry their MEGASCALE_* "
+                        "identity and must not be re-stamped")
     p.add_argument("-monitor", dest="monitor", action="store_true",
                    help="live cluster observability plane: mount the "
                         "aggregator on the (builtin) config server, make "
@@ -211,13 +221,21 @@ def apply_platform(ns) -> None:
         # None) keeps its single worker
         ns.np = info.num_hosts
     if info.num_slices > 1:
-        # cross-slice (DCN) device coordination is libtpu's: the
-        # MEGASCALE_* envs pass through to the workers via the inherited
-        # environment; this launcher only handles the per-slice topology
+        # cross-slice (DCN) device coordination is libtpu's, and on a
+        # real pod TPU_WORKER_HOSTNAMES lists THIS slice's hosts only —
+        # so the launcher must NOT partition them into synthetic slices.
+        # Each worker inherits its host's true MEGASCALE_* identity from
+        # the environment; `-num-slices` (the explicit flag) exists for
+        # the emulation contract, where there is no env to inherit.
+        if ns.num_slices > 0:
+            raise SystemExit(
+                "kfrun: -num-slices on a detected multislice pod would "
+                "overwrite the hosts' real MEGASCALE_SLICE_ID — the pod "
+                "env already carries slice identity (drop the flag)")
         _log.info(
-            "multislice pod (slice %d/%d, coordinator %s): MEGASCALE envs "
-            "pass through to workers", info.slice_id, info.num_slices,
-            info.coordinator or "?",
+            "multislice pod (slice %d/%d, coordinator %s): MEGASCALE "
+            "envs pass through to workers", info.slice_id,
+            info.num_slices, info.coordinator or "?",
         )
     _log.info(
         "platform tpu-pod: -H %s -self %s (np=%d)",
@@ -298,6 +316,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         hl = build_hostlist(ns)
         world = hl.gen_peer_list(hl.cap(), parse_port_range(ns.port_range))
 
+    if ns.num_slices and ns.num_slices > 1:
+        spawn_total = len(world) if world is not None else cluster.size()
+        if spawn_total % ns.num_slices:
+            raise SystemExit(
+                f"kfrun: -num-slices {ns.num_slices} does not tile "
+                f"{spawn_total} worker slot(s) — slices need identical "
+                "worker counts")
+        _log.info(
+            "multislice: %d slice(s) x %d worker(s) (slice-major)",
+            ns.num_slices, spawn_total // ns.num_slices,
+        )
+
     if ns.tolerate_failures and (ns.auto_recover or ns.watch):
         # the monitored/watch runners have their own worker-death policy
         # (relaunch / respawn); silently ignoring the flag would promise
@@ -364,6 +394,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         parent=PeerID(ns.self_host, DEFAULT_RUNNER_PORT),
         backend=ns.backend,
         world=world,
+        slices=max(ns.num_slices, 0),
         extra_envs=chaos_envs,
     )
     try:

@@ -41,6 +41,18 @@ class Job:
     #: jax.distributed world is booted once over ALL slots; resize re-carves
     #: the mesh over the active subset (see Peer._carve_active_devices)
     world: Optional[PeerList] = None
+    #: multislice worker partitioning (``kfrun -num-slices``): > 1 stamps
+    #: each worker's env with its slice identity (slice-major contiguous,
+    #: ``MEGASCALE_SLICE_ID = rank // ranks_per_slice`` — the tpu_pod
+    #: emulation contract) plus ``MEGASCALE_NUM_SLICES``/``KF_SLICE_RANKS``
+    #: so the peers build the hierarchical communicator and slice-granular
+    #: elasticity with no user code change
+    slices: int = 0
+    #: ranks per slice, pinned at the FIRST spawn (0 = derive then): a
+    #: watch-mode respawn after a resize passes the CURRENT cluster, and
+    #: re-deriving from its size would stamp joiners with a different
+    #: slice geometry than the incumbents hold
+    slice_rps: int = 0
     job_start: float = field(default_factory=time.time)
 
     def new_proc(self, worker: PeerID, cluster: Cluster, version: int = 0) -> Proc:
@@ -58,6 +70,31 @@ class Job:
         }
         if self.parent is not None:
             env[envs.PARENT_ID] = str(self.parent)
+        if self.slices and self.slices > 1:
+            # slice identity rides the STABLE spawn rank (world-slot index
+            # in device-world mode): elastic reshuffles re-rank workers
+            # but never move a process between slices
+            spawn_list = self.world if self.world is not None else cluster.workers
+            base_rank = (self.world.rank(worker) if self.world is not None
+                         else rank)
+            if self.slice_rps <= 0:
+                # first spawn pins the geometry; later calls (watch-mode
+                # respawns over a RESIZED cluster) reuse it — the slice
+                # count follows the membership, ranks-per-slice never
+                # changes (the elastic layer's whole-slice invariant)
+                if len(spawn_list) % self.slices:
+                    raise ValueError(
+                        f"{len(spawn_list)} worker slot(s) cannot "
+                        f"partition into {self.slices} slices")
+                self.slice_rps = len(spawn_list) // self.slices
+            rps = self.slice_rps
+            if base_rank is None or len(spawn_list) % rps:
+                raise ValueError(
+                    f"{len(spawn_list)} worker slot(s) do not tile "
+                    f"{rps}-rank slices")
+            env[envs.MEGASCALE_NUM_SLICES] = str(len(spawn_list) // rps)
+            env[envs.MEGASCALE_SLICE_ID] = str(base_rank // rps)
+            env[envs.SLICE_RANKS] = str(rps)
         if self.config_server:
             env[envs.CONFIG_SERVER] = self.config_server
         if self.world is not None:

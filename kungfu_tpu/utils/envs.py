@@ -114,6 +114,24 @@ Transport / native-runtime envs:
                                (monitor/signals.py; set by the runner)
 =============================  ================================================
 
+Multislice (TPU pod) envs — the ``MEGASCALE_*`` names are the TPU
+runtime's contract, read by :mod:`kungfu_tpu.platforms.tpu_pod` and the
+slice topology layer (:mod:`kungfu_tpu.elastic.slices`):
+
+=================================  ============================================
+``MEGASCALE_COORDINATOR_ADDRESS``  multislice DCN coordinator (slice 0 host 0)
+``MEGASCALE_SLICE_ID``             this host's slice index; in the CPU-mesh
+                                   emulation contract the launcher sets it
+                                   per worker (= worker rank // ranks/slice)
+``MEGASCALE_NUM_SLICES``           total slice count; >1 switches the peer to
+                                   the hierarchical ICI-within / DCN-across
+                                   communicator and slice-granular elasticity
+``KF_SLICE_RANKS``                 worker ranks per slice, pinned by the
+                                   launcher (``kfrun -num-slices``); without
+                                   it the topology derives ranks/slice from
+                                   the bootstrap worker count
+=================================  ============================================
+
 Fault-injection envs (the chaos layer, :mod:`kungfu_tpu.chaos`; see
 docs/fault_tolerance.md for the full matrix):
 
@@ -210,6 +228,18 @@ TIMELINE_CAP = "KF_CONFIG_TIMELINE_CAP"
 ENABLE_CLUSTER_MONITOR = "KF_CONFIG_ENABLE_CLUSTER_MONITOR"
 MONITOR_PUSH_PERIOD = "KF_CONFIG_MONITOR_PUSH_PERIOD"
 MONITOR_STALE_AFTER = "KF_CONFIG_MONITOR_STALE_AFTER"
+
+# multislice envs.  The MEGASCALE_* names are the TPU runtime's own
+# contract (libtpu/GKE publish them on every pod host; the emulation
+# contract sets them per worker process — platforms/tpu_pod.py);
+# KF_SLICE_RANKS is this framework's addition: the launcher pins the
+# ranks-per-slice so elastic membership changes cannot break the
+# bootstrap-derived slice mapping.  Registered here so the env-contract
+# scan anchors them instead of module-local constants drifting.
+MEGASCALE_COORDINATOR = "MEGASCALE_COORDINATOR_ADDRESS"
+MEGASCALE_SLICE_ID = "MEGASCALE_SLICE_ID"
+MEGASCALE_NUM_SLICES = "MEGASCALE_NUM_SLICES"
+SLICE_RANKS = "KF_SLICE_RANKS"
 
 # fault-injection envs (read by kungfu_tpu/chaos/inject.py at controller
 # creation; registered here so the env-contract scan anchors them to the

@@ -56,6 +56,24 @@ if ! python3 scripts/kftop --self-check; then
     fail=1
 fi
 
+echo "== multislice-demo (emulated 2-slice slice-kill e2e)"
+# the slice-loss recovery ladder, end to end: 2 emulated slices, chaos
+# kills slice 1 whole at step 3, the surviving slice shrinks around it
+# and finishes (docs/multislice.md).  Bounded: a wedged recovery must
+# fail the gate, not hang it.
+rm -f /tmp/_kf_multislice_demo.log
+if ! timeout -k 10 240 python3 -m kungfu_tpu.runner.cli -np 4 \
+        -num-slices 2 -tolerate-failures \
+        -chaos 'die_slice:slice=1,step=3' \
+        python3 examples/multislice_shrink.py --n-steps 8 \
+        > /tmp/_kf_multislice_demo.log 2>&1 \
+        || ! grep -q "multislice survived to step 8 on 2 workers" \
+        /tmp/_kf_multislice_demo.log; then
+    echo "ERROR: multislice demo did not survive the slice kill"
+    tail -40 /tmp/_kf_multislice_demo.log || true
+    fail=1
+fi
+
 echo "== compileall"
 if ! python3 -m compileall -q kungfu_tpu scripts benchmarks examples tests; then
     fail=1

@@ -127,6 +127,171 @@ class TestCliWiring:
         assert ns.np == 2  # default expands to the pod
 
 
+class _Dev:
+    """Stand-in device: slice_device_groups touches only these attrs."""
+
+    def __init__(self, slice_index=None, process_index=0):
+        if slice_index is not None:
+            self.slice_index = slice_index
+        self.process_index = process_index
+
+    def __repr__(self):
+        return (f"Dev(s={getattr(self, 'slice_index', None)},"
+                f"p={self.process_index})")
+
+
+class TestSliceLayoutEdgeCases:
+    """slice_device_groups / slice_mesh_layout edge cases on synthetic
+    device worlds (satellite: uneven slices, contract disagreement,
+    by='process' emulation fallback, single-slice passthrough)."""
+
+    def test_groups_by_slice_index_outer_sorted(self):
+        from kungfu_tpu.platforms.tpu_pod import slice_device_groups
+
+        devs = [_Dev(slice_index=s, process_index=p)
+                for s, p in ((1, 3), (0, 1), (1, 2), (0, 0))]
+        groups = slice_device_groups(devs)
+        assert [len(g) for g in groups] == [2, 2]
+        assert {d.slice_index for d in groups[0]} == {0}
+        assert {d.slice_index for d in groups[1]} == {1}
+
+    def test_by_process_emulation_fallback(self):
+        """CPU devices report no usable slice_index; the emulation
+        contract regroups by process (MEGASCALE_SLICE_ID = process id)
+        when the declared slice count matches THAT grouping."""
+        from kungfu_tpu.platforms.tpu_pod import slice_mesh_layout
+
+        # constant slice_index 0 (what CPU backends report) but two
+        # processes: the by-slice grouping shows ONE group, the
+        # process grouping shows the declared two
+        devs = [_Dev(slice_index=0, process_index=p) for p in (0, 0, 1, 1)]
+        flat, per = slice_mesh_layout(num_slices=2, devices=devs)
+        assert per == 2 and len(flat) == 4
+        assert [d.process_index for d in flat] == [0, 0, 1, 1]
+
+    def test_contract_disagreement_fails_loudly(self):
+        from kungfu_tpu.platforms.tpu_pod import slice_mesh_layout
+
+        devs = [_Dev(slice_index=0, process_index=0) for _ in range(4)]
+        with pytest.raises(ValueError, match="slice group"):
+            slice_mesh_layout(num_slices=3, devices=devs)
+
+    def test_uneven_slices_fail_loudly(self):
+        from kungfu_tpu.platforms.tpu_pod import slice_mesh_layout
+
+        devs = ([_Dev(slice_index=0)] * 3) + ([_Dev(slice_index=1)] * 1)
+        with pytest.raises(ValueError, match="uneven slice sizes"):
+            slice_mesh_layout(num_slices=2, devices=devs)
+
+    def test_single_slice_passthrough(self):
+        """num_slices=1 (or the env unset): one group, devices
+        untouched — the byte-identical legacy path."""
+        from kungfu_tpu.platforms.tpu_pod import slice_mesh_layout
+
+        devs = [_Dev(slice_index=0, process_index=0) for _ in range(4)]
+        flat, per = slice_mesh_layout(num_slices=1, devices=devs)
+        assert flat == devs and per == 4
+
+    def test_env_contract_default(self, monkeypatch):
+        from kungfu_tpu.platforms.tpu_pod import slice_mesh_layout
+
+        monkeypatch.setenv("MEGASCALE_NUM_SLICES", "2")
+        devs = [_Dev(slice_index=s) for s in (0, 0, 1, 1)]
+        flat, per = slice_mesh_layout(devices=devs)  # env supplies 2
+        assert per == 2
+        monkeypatch.setenv("MEGASCALE_NUM_SLICES", "4")
+        with pytest.raises(ValueError, match="slice group"):
+            slice_mesh_layout(devices=devs)
+
+
+class TestKfrunSlicePropagation:
+    """kfrun propagates slice identity to workers instead of logging it:
+    per-worker MEGASCALE_SLICE_ID / MEGASCALE_NUM_SLICES / KF_SLICE_RANKS
+    (slice-major, the emulation contract)."""
+
+    def _job_envs(self, argv):
+        from kungfu_tpu.runner.cli import build_cluster, build_parser
+        from kungfu_tpu.runner.job import Job
+        from kungfu_tpu.plan import parse_strategy
+        from kungfu_tpu.plan.peer import PeerID
+
+        ns = build_parser().parse_args(argv)
+        cluster = build_cluster(ns)
+        job = Job(prog="prog", args=[], strategy=parse_strategy("AUTO"),
+                  parent=PeerID(ns.self_host, 38080),
+                  slices=max(ns.num_slices, 0))
+        return [job.new_proc(w, cluster).envs for w in cluster.workers]
+
+    def test_worker_envs_carry_slice_identity(self):
+        envs_per_worker = self._job_envs(
+            ["-np", "4", "-num-slices", "2", "prog"])
+        assert [e["MEGASCALE_SLICE_ID"] for e in envs_per_worker] == \
+            ["0", "0", "1", "1"]
+        assert all(e["MEGASCALE_NUM_SLICES"] == "2" for e in envs_per_worker)
+        assert all(e["KF_SLICE_RANKS"] == "2" for e in envs_per_worker)
+
+    def test_no_slices_no_envs(self):
+        envs_per_worker = self._job_envs(["-np", "2", "prog"])
+        assert all("MEGASCALE_SLICE_ID" not in e for e in envs_per_worker)
+
+    def test_respawn_after_resize_keeps_slice_geometry(self):
+        """Ranks-per-slice is pinned at the FIRST spawn: a watch-mode
+        respawn over a RESIZED cluster must stamp joiners with the same
+        geometry the incumbents hold (slice count follows membership,
+        rps never moves) — re-deriving rps from the grown size would
+        split the world into divergent rank→slice maps."""
+        from kungfu_tpu.plan import Cluster, PeerID, PeerList
+        from kungfu_tpu.plan import parse_strategy
+        from kungfu_tpu.plan.peer import PeerID as PID
+        from kungfu_tpu.runner.job import Job
+
+        def mk_cluster(n):
+            return Cluster(
+                PeerList.parse("127.0.0.1:38089"),
+                PeerList.of(*(PeerID("127.0.0.1", 23800 + i)
+                              for i in range(n))))
+
+        job = Job(prog="prog", args=[], strategy=parse_strategy("AUTO"),
+                  parent=PID("127.0.0.1", 38080), slices=2)
+        c4 = mk_cluster(4)
+        first = [job.new_proc(w, c4).envs for w in c4.workers]
+        assert [e["MEGASCALE_SLICE_ID"] for e in first] == \
+            ["0", "0", "1", "1"]
+        c6 = mk_cluster(6)
+        grown = [job.new_proc(w, c6).envs for w in c6.workers]
+        # rps stays 2; the grown world is 3 slices of 2, not 2 of 3
+        assert all(e["KF_SLICE_RANKS"] == "2" for e in grown)
+        assert all(e["MEGASCALE_NUM_SLICES"] == "3" for e in grown)
+        assert [e["MEGASCALE_SLICE_ID"] for e in grown] == \
+            ["0", "0", "1", "1", "2", "2"]
+
+    def test_non_tiling_np_exits(self):
+        from kungfu_tpu.runner.cli import main
+
+        with pytest.raises(SystemExit, match="does not tile"):
+            main(["-np", "3", "-num-slices", "2", "prog"])
+
+    def test_real_pod_rejects_num_slices(self, monkeypatch):
+        """On a detected multislice pod, TPU_WORKER_HOSTNAMES is THIS
+        slice's host list — `-num-slices` would carve one slice into
+        synthetic slices and overwrite each host's true
+        MEGASCALE_SLICE_ID, so it is a launch error; without the flag,
+        identity passes through via the inherited env (no stamping)."""
+        from kungfu_tpu.runner.cli import apply_platform, build_parser
+
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+        monkeypatch.setenv("TPU_WORKER_ID", "0")
+        monkeypatch.setenv("MEGASCALE_SLICE_ID", "1")
+        monkeypatch.setenv("MEGASCALE_NUM_SLICES", "2")
+        ns = build_parser().parse_args(
+            ["-platform", "tpu-pod", "-num-slices", "2", "prog"])
+        with pytest.raises(SystemExit, match="MEGASCALE_SLICE_ID"):
+            apply_platform(ns)
+        ns = build_parser().parse_args(["-platform", "tpu-pod", "prog"])
+        apply_platform(ns)
+        assert ns.num_slices == 0  # not auto-armed: env identity wins
+
+
 class TestMultislice:
     def test_single_slice_groups_and_validation(self):
         import jax
@@ -167,8 +332,9 @@ class TestMultislice:
             "import sys, os, numpy as np\n"
             f"sys.path.insert(0, {repo!r})\n"
             "import jax\n"
+            "from kungfu_tpu.utils.jaxcompat import set_cpu_device_count\n"
             "jax.config.update('jax_platforms', 'cpu')\n"
-            "jax.config.update('jax_num_cpu_devices', 2)\n"
+            "set_cpu_device_count(2)\n"
             "jax.config.update('jax_cpu_collectives_implementation', 'gloo')\n"
             "rank, port = int(sys.argv[1]), int(sys.argv[2])\n"
             "jax.distributed.initialize(f'127.0.0.1:{port}', 2, rank)\n"

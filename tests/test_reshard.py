@@ -453,6 +453,46 @@ class TestZeroBoundaryChunkMode:
             b.commit_local(0, {"mu": np.zeros(5, np.float32)},
                            total=10, old_n=4, my_old=0)
 
+    def test_cross_slice_stride_survives_whole_slice_death(self):
+        """Multislice buddies: stride = ranks_per_slice puts every
+        mirror in the NEXT slice, so the demo scenario — slice 1
+        (ranks 2 AND 3, adjacent) dying at once — stays recoverable.
+        The same double death is exactly what
+        test_dead_rank_and_dead_predecessor_unrecoverable proves fatal
+        under the stride-1 adjacent ring."""
+        vecs = self._vectors()
+        peers, chans, fakes = _mk_world(4)
+        bs = self._boundaries(vecs, 4)
+        try:
+            run_all([
+                lambda b=b, f=f: b.replicate_ring(
+                    f.channel, peers, tag="xs", stride=2)
+                for b, f in zip(bs, fakes)
+            ], timeout=60)
+            new_workers = type(peers).of(peers[0], peers[1])
+            run_all([
+                lambda b=b, f=f: b.recarve(
+                    2, peer=f, old_workers=peers, new_workers=new_workers,
+                    tag="txs", dead=(2, 3))
+                for b, f in ((bs[0], fakes[0]), (bs[1], fakes[1]))
+            ], timeout=60)
+        finally:
+            for c in chans:
+                c.close()
+        want_mu = _chunks_of(vecs["mu"], self.TOTAL, 2)
+        want_nu = _chunks_of(vecs["nu"], self.TOTAL, 2)
+        for r in range(2):
+            _, vec, _ = bs[r].chunks()
+            np.testing.assert_array_equal(vec[1], want_mu[r])
+            np.testing.assert_array_equal(vec[2], want_nu[r])
+
+    def test_stride_bounds_validated(self):
+        vecs = self._vectors()
+        bs = self._boundaries(vecs, 4)
+        for bad in (0, 4, -1):
+            with pytest.raises(ValueError, match="stride"):
+                bs[0].replicate_ring(None, None, tag="bad", stride=bad)
+
 
 # ==========================================================================
 # loud-failure gates on the exchange: step agreement, epoch agreement,

@@ -1,0 +1,466 @@
+"""Slice-aware fault tolerance: topology, verdict/quorum, and the
+in-process slice-granular shrink ladder (docs/multislice.md).
+
+The in-process cluster trick is the same as tests/test_chaos.py: real
+``Peer`` objects on loopback with the python transport, multislice
+armed through the env contract (``MEGASCALE_NUM_SLICES`` +
+``KF_SLICE_RANKS``), chaos ``die_slice`` in ``mode=raise`` standing in
+for whole-slice process death."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from kungfu_tpu import chaos
+from kungfu_tpu.checkpoint import StepSnapshot
+from kungfu_tpu.comm.faults import (PeerFailureError, QuorumLostError,
+                                    SliceExcludedError)
+from kungfu_tpu.elastic.slices import (SliceTopology, align_to_slices,
+                                       bootstrap_topology, slice_quorum_ok,
+                                       slice_verdict)
+from kungfu_tpu.plan import Cluster, PeerID, PeerList, Strategy
+
+from tests._util import run_all
+
+
+@pytest.fixture(autouse=True)
+def _fresh_chaos():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def make_slice_peers(n, num_slices, base_port, monkeypatch):
+    """n real Peers on loopback with the multislice env contract armed
+    (slice-major: rank r lives in slice r // (n / num_slices))."""
+    from kungfu_tpu.peer import Peer
+    from kungfu_tpu.utils.envs import Config
+
+    monkeypatch.setenv("KF_TPU_HOST_TRANSPORT", "python")
+    monkeypatch.setenv("MEGASCALE_NUM_SLICES", str(num_slices))
+    monkeypatch.setenv("KF_SLICE_RANKS", str(n // num_slices))
+    workers = PeerList.of(
+        *(PeerID("127.0.0.1", base_port + i) for i in range(n)))
+    runners = PeerList.parse("127.0.0.1:38089")
+    cluster = Cluster(runners, workers)
+    peers = [
+        Peer(Config(self_id=workers[i], cluster=cluster,
+                    strategy=Strategy.STAR))
+        for i in range(n)
+    ]
+    for p in peers:
+        p.start()
+    return workers, peers
+
+
+class TestTopology:
+    def test_mapping_and_leaders(self):
+        t = SliceTopology(3, 2)
+        assert [t.slice_of(r) for r in range(6)] == [0, 0, 1, 1, 2, 2]
+        assert t.ranks_in(1) == [2, 3]
+        assert t.leader_of(2) == 4
+        assert t.size == 6
+
+    def test_for_size_keeps_rps_and_rejects_fractions(self):
+        t = SliceTopology(2, 2)
+        assert t.for_size(2) == SliceTopology(1, 2)
+        with pytest.raises(ValueError, match="whole slices"):
+            t.for_size(3)
+
+    def test_bootstrap_from_env(self, monkeypatch):
+        monkeypatch.delenv("MEGASCALE_NUM_SLICES", raising=False)
+        monkeypatch.delenv("KF_SLICE_RANKS", raising=False)
+        assert bootstrap_topology(4) is None  # single slice: legacy path
+        monkeypatch.setenv("MEGASCALE_NUM_SLICES", "2")
+        assert bootstrap_topology(4) == SliceTopology(2, 2)
+        # the pinned launcher value wins over derivation
+        monkeypatch.setenv("KF_SLICE_RANKS", "3")
+        assert bootstrap_topology(4) == SliceTopology(2, 3)
+        # without the pin, a non-tiling worker count fails loudly
+        monkeypatch.delenv("KF_SLICE_RANKS")
+        with pytest.raises(ValueError, match="tile"):
+            bootstrap_topology(5)
+
+    def test_align_to_slices(self):
+        t = SliceTopology(4, 2)
+        assert align_to_slices(5, t) == 6
+        assert align_to_slices(6, t) == 6
+        assert align_to_slices(0, t) == 2  # never below one slice
+
+
+class TestVerdictAndQuorum:
+    def test_verdict_splits_dead_and_degraded(self):
+        t = SliceTopology(3, 2)
+        dead, degraded = slice_verdict([2, 3, 4], t)
+        assert dead == {1} and degraded == {2}
+
+    def test_quorum_strict_majority(self):
+        t = SliceTopology(3, 1)
+        assert slice_quorum_ok([0, 2], t)
+        assert not slice_quorum_ok([2], t)
+
+    def test_quorum_half_tiebreak_on_lowest_slice(self):
+        """Exactly half survives: ONLY the side holding slice 0 may
+        continue — a partition's two halves are disjoint, so both
+        cannot.  This is what makes a 2-slice pod's slice loss
+        survivable where rank-granular strict majority refuses."""
+        t = SliceTopology(2, 2)
+        assert slice_quorum_ok([0], t)
+        assert not slice_quorum_ok([1], t)
+        t4 = SliceTopology(4, 1)
+        assert slice_quorum_ok([0, 3], t4)
+        assert not slice_quorum_ok([1, 2], t4)
+
+
+class TestPeerWiring:
+    def test_single_slice_is_byte_identical(self, monkeypatch):
+        """No MEGASCALE contract -> no topology, psum default strategy:
+        today's behavior, untouched."""
+        from kungfu_tpu.peer import Peer
+        from kungfu_tpu.utils.envs import Config
+
+        monkeypatch.delenv("MEGASCALE_NUM_SLICES", raising=False)
+        workers = PeerList.parse("127.0.0.1:24990")
+        p = Peer(Config(self_id=workers[0],
+                        cluster=Cluster(PeerList.parse("127.0.0.1:38089"),
+                                        workers)))
+        assert p.slice_topology() is None
+        assert p._comm_strategy == "psum"
+
+    def test_multislice_defaults_to_two_stage(self, monkeypatch):
+        from kungfu_tpu.peer import Peer
+        from kungfu_tpu.utils.envs import Config
+
+        monkeypatch.setenv("MEGASCALE_NUM_SLICES", "2")
+        monkeypatch.setenv("KF_SLICE_RANKS", "1")
+        workers = PeerList.parse("127.0.0.1:24991,127.0.0.1:24992")
+        p = Peer(Config(self_id=workers[0],
+                        cluster=Cluster(PeerList.parse("127.0.0.1:38089"),
+                                        workers)))
+        topo = p.slice_topology()
+        assert topo == SliceTopology(2, 1)
+        assert p.slice_id() == 0
+        assert p._comm_strategy == "two_stage"
+        # an explicit user choice still wins over the multislice default
+        p2 = Peer(Config(self_id=workers[0],
+                         cluster=Cluster(PeerList.parse("127.0.0.1:38089"),
+                                         workers),
+                         device_strategy="ring"))
+        assert p2._comm_strategy == "ring"
+
+    def test_incoherent_inherited_contract_runs_flat(self, monkeypatch):
+        """A pod host's inherited MEGASCALE_NUM_SLICES with a worker
+        world that does not tile it (and no launcher-pinned
+        KF_SLICE_RANKS) must not crash kf.init() — it logs and runs
+        single-slice, the pre-multislice behavior."""
+        from kungfu_tpu.peer import Peer
+        from kungfu_tpu.utils.envs import Config
+
+        monkeypatch.setenv("MEGASCALE_NUM_SLICES", "2")
+        monkeypatch.delenv("KF_SLICE_RANKS", raising=False)
+        workers = PeerList.parse(
+            "127.0.0.1:24993,127.0.0.1:24994,127.0.0.1:24995")
+        p = Peer(Config(self_id=workers[0],
+                        cluster=Cluster(PeerList.parse("127.0.0.1:38089"),
+                                        workers)))
+        assert p.slice_topology() is None
+        assert p._comm_strategy == "psum"
+
+    def test_resize_alignment(self, monkeypatch):
+        from kungfu_tpu.elastic.resize import slice_aligned_size
+
+        class _P:
+            def slice_topology(self):
+                return SliceTopology(2, 2)
+
+        assert slice_aligned_size(_P(), 3) == 4
+        assert slice_aligned_size(_P(), 1) == 2
+        assert slice_aligned_size(_P(), 4) == 4
+
+        class _Single:
+            def slice_topology(self):
+                return None
+
+        assert slice_aligned_size(_Single(), 3) == 3
+
+
+class TestSliceShrink:
+    """The tentpole ladder, in-process: 2 slices x 2 ranks, slice 1
+    dies whole, slice 0 survives the slice-granular quorum that
+    rank-granular strict majority (2*2 <= 4) would have refused."""
+
+    def test_whole_slice_death_shrinks_to_surviving_slice(self, monkeypatch):
+        monkeypatch.setenv("KF_CHAOS_SPEC",
+                           "die_slice:slice=1,coll=2,mode=raise,rps=2")
+        monkeypatch.setenv("KF_CONFIG_PEER_DEADLINE", "2")
+        workers, peers = make_slice_peers(4, 2, 26700, monkeypatch)
+        data = [np.arange(16, dtype=np.float32) * (i + 1) for i in range(4)]
+        snaps = [StepSnapshot() for _ in range(4)]
+        try:
+            outs = run_all([
+                lambda p=p, d=d: p.engine().all_reduce(d, name="s1")
+                for p, d in zip(peers, data)
+            ])
+            for i, o in enumerate(outs):
+                assert np.array_equal(o, sum(data))
+                snaps[i].commit(1, {"w": o})
+
+            results = [None] * 4
+
+            def victim(i):
+                try:
+                    peers[i].engine().all_reduce(data[i], name="s2")
+                    results[i] = ("no-death", None)
+                except chaos.InjectedDeath:
+                    peers[i].close()
+                    results[i] = ("died", None)
+
+            def survivor(i):
+                try:
+                    out = peers[i].engine().all_reduce(data[i], name="s2")
+                    results[i] = ("clean", out)
+                    return
+                except PeerFailureError as err:
+                    shrunk, replay = peers[i].recover_from_failure(
+                        err, snapshot=snaps[i])
+                    assert shrunk, "surviving slice must agree to shrink"
+                    assert replay is not None and replay[0] == 1
+                    out = peers[i].engine().all_reduce(data[i], name="s2r")
+                    results[i] = ("recovered", out)
+
+            ts = ([threading.Thread(target=victim, args=(i,), daemon=True)
+                   for i in (2, 3)]
+                  + [threading.Thread(target=survivor, args=(i,), daemon=True)
+                     for i in (0, 1)])
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(60)
+            assert not any(t.is_alive() for t in ts), "recovery hung"
+
+            assert results[2][0] == "died" and results[3][0] == "died"
+            want = data[0] + data[1]
+            for i in (0, 1):
+                status, out = results[i]
+                assert status == "recovered", results[i]
+                assert np.array_equal(out, want)
+                assert peers[i].size() == 2
+                # the DCN topology re-carved: one slice remains
+                assert peers[i].slice_topology() == SliceTopology(1, 2)
+                assert not peers[i].detached
+        finally:
+            for i in (0, 1):
+                peers[i].close()
+
+    def test_partial_slice_death_excludes_the_whole_slice(self, monkeypatch):
+        """Only rank 2 dies: its slice-mate rank 3 is ALIVE, answers
+        ping — and must stand down (SliceExcludedError), while slice 0
+        excludes the whole slice."""
+        monkeypatch.setenv("KF_CHAOS_SPEC", "die:coll=2,rank=2,mode=raise")
+        monkeypatch.setenv("KF_CONFIG_PEER_DEADLINE", "2")
+        workers, peers = make_slice_peers(4, 2, 26720, monkeypatch)
+        data = [np.ones(8, np.float32) * (i + 1) for i in range(4)]
+        snaps = [StepSnapshot() for _ in range(4)]
+        try:
+            outs = run_all([
+                lambda p=p, d=d: p.engine().all_reduce(d, name="s1")
+                for p, d in zip(peers, data)
+            ])
+            for i, o in enumerate(outs):
+                snaps[i].commit(1, {"w": o})
+            results = [None] * 4
+
+            def victim():
+                try:
+                    peers[2].engine().all_reduce(data[2], name="s2")
+                except chaos.InjectedDeath:
+                    peers[2].close()
+                    results[2] = ("died", None)
+
+            def excluded():
+                try:
+                    peers[3].engine().all_reduce(data[3], name="s2")
+                    results[3] = ("clean", None)
+                except PeerFailureError as err:
+                    try:
+                        peers[3].recover_from_failure(err, snapshot=snaps[3])
+                        results[3] = ("shrunk", None)
+                    except SliceExcludedError as exc:
+                        assert exc.slice_id == 1
+                        results[3] = ("excluded", exc)
+
+            def survivor(i):
+                try:
+                    peers[i].engine().all_reduce(data[i], name="s2")
+                    results[i] = ("clean", None)
+                except PeerFailureError as err:
+                    shrunk, replay = peers[i].recover_from_failure(
+                        err, snapshot=snaps[i])
+                    assert shrunk and replay[0] == 1
+                    results[i] = ("recovered", None)
+
+            ts = ([threading.Thread(target=victim, daemon=True),
+                   threading.Thread(target=excluded, daemon=True)]
+                  + [threading.Thread(target=survivor, args=(i,), daemon=True)
+                     for i in (0, 1)])
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(60)
+            assert not any(t.is_alive() for t in ts), "recovery hung"
+
+            assert results[2][0] == "died"
+            assert results[3][0] == "excluded", results[3]
+            for i in (0, 1):
+                assert results[i][0] == "recovered", results[i]
+                assert peers[i].size() == 2
+                # the ALIVE rank 3 was excluded along with its dead mate
+                assert peers[i].cluster.workers.rank(workers[3]) is None
+        finally:
+            for i in (0, 1, 3):
+                peers[i].close()
+
+    def test_losing_slice_zero_loses_quorum(self, monkeypatch):
+        """The other half of the tie-break: survivors WITHOUT slice 0
+        must refuse (exactly-half, no lowest slice) and escalate."""
+        monkeypatch.setenv("KF_CHAOS_SPEC",
+                           "die_slice:slice=0,coll=2,mode=raise,rps=1")
+        monkeypatch.setenv("KF_CONFIG_PEER_DEADLINE", "2")
+        workers, peers = make_slice_peers(2, 2, 26740, monkeypatch)
+        data = [np.ones(4, np.float32) * (i + 1) for i in range(2)]
+        try:
+            run_all([
+                lambda p=p, d=d: p.engine().all_reduce(d, name="s1")
+                for p, d in zip(peers, data)
+            ])
+            results = [None] * 2
+
+            def victim():
+                try:
+                    peers[0].engine().all_reduce(data[0], name="s2")
+                except chaos.InjectedDeath:
+                    peers[0].close()
+                    results[0] = ("died", None)
+
+            def survivor():
+                try:
+                    peers[1].engine().all_reduce(data[1], name="s2")
+                    results[1] = ("clean", None)
+                except PeerFailureError as err:
+                    try:
+                        peers[1].recover_from_failure(err)
+                        results[1] = ("shrunk", None)
+                    except QuorumLostError as q:
+                        results[1] = ("quorum-lost", q)
+
+            ts = [threading.Thread(target=victim, daemon=True),
+                  threading.Thread(target=survivor, daemon=True)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(60)
+            assert not any(t.is_alive() for t in ts)
+            assert results[0][0] == "died"
+            assert results[1][0] == "quorum-lost", results[1]
+        finally:
+            peers[1].close()
+
+
+class TestLastSliceRankGrain:
+    """Once a job is down to ONE slice there is no cross-slice mesh
+    left to protect: a single rank death must run the CLASSIC rank
+    ladder (3-of-? strict majority shrink), not exclude the lone
+    remaining slice and halt everything."""
+
+    def test_rank_death_on_last_slice_shrinks_by_rank(self, monkeypatch):
+        from kungfu_tpu.peer import Peer
+        from kungfu_tpu.utils.envs import Config
+
+        # the post-slice-shrink state, bootstrapped directly: a 2-slice
+        # contract (rps pinned to 3) whose CURRENT membership is one
+        # whole slice of 3 — slice_topology() == (1, 3)
+        monkeypatch.setenv("KF_TPU_HOST_TRANSPORT", "python")
+        monkeypatch.setenv("MEGASCALE_NUM_SLICES", "2")
+        monkeypatch.setenv("KF_SLICE_RANKS", "3")
+        monkeypatch.setenv("KF_CHAOS_SPEC", "die:coll=2,rank=2,mode=raise")
+        monkeypatch.setenv("KF_CONFIG_PEER_DEADLINE", "2")
+        workers = PeerList.of(
+            *(PeerID("127.0.0.1", 26760 + i) for i in range(3)))
+        cluster = Cluster(PeerList.parse("127.0.0.1:38089"), workers)
+        peers = [Peer(Config(self_id=workers[i], cluster=cluster,
+                             strategy=Strategy.STAR)) for i in range(3)]
+        for p in peers:
+            p.start()
+        assert peers[0].slice_topology() is not None
+        assert peers[0].slice_topology().num_slices == 1
+        data = [np.ones(8, np.float32) * (i + 1) for i in range(3)]
+        snaps = [StepSnapshot() for _ in range(3)]
+        try:
+            outs = run_all([
+                lambda p=p, d=d: p.engine().all_reduce(d, name="s1")
+                for p, d in zip(peers, data)
+            ])
+            for i, o in enumerate(outs):
+                snaps[i].commit(1, {"w": o})
+            results = [None] * 3
+
+            def victim():
+                try:
+                    peers[2].engine().all_reduce(data[2], name="s2")
+                except chaos.InjectedDeath:
+                    peers[2].close()
+                    results[2] = ("died", None)
+
+            def survivor(i):
+                try:
+                    peers[i].engine().all_reduce(data[i], name="s2")
+                    results[i] = ("clean", None)
+                except PeerFailureError as err:
+                    # rank grain: NOT SliceExcludedError — 2-of-3 is a
+                    # strict majority and the job keeps training
+                    shrunk, replay = peers[i].recover_from_failure(
+                        err, snapshot=snaps[i])
+                    assert shrunk and replay[0] == 1
+                    results[i] = ("recovered", None)
+
+            ts = ([threading.Thread(target=victim, daemon=True)]
+                  + [threading.Thread(target=survivor, args=(i,), daemon=True)
+                     for i in (0, 1)])
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(60)
+            assert not any(t.is_alive() for t in ts), "recovery hung"
+            assert results[2][0] == "died"
+            for i in (0, 1):
+                assert results[i][0] == "recovered", results[i]
+                assert peers[i].size() == 2
+                # 2 workers no longer tile 3-rank slices: slice
+                # semantics are over for good
+                assert peers[i].slice_topology() is None
+        finally:
+            for i in (0, 1):
+                peers[i].close()
+
+
+class TestReporterSliceIdentity:
+    def test_explicit_none_beats_env(self, monkeypatch):
+        """A Peer that rejected an incoherent MEGASCALE contract passes
+        slice_id=None — authoritative: the env must not resurrect slice
+        rows (false kftop SLICE LOSS alarms on a rank-granular job)."""
+        from kungfu_tpu.monitor.aggregator import RankReporter
+
+        monkeypatch.setenv("MEGASCALE_SLICE_ID", "1")
+        monkeypatch.setenv("MEGASCALE_NUM_SLICES", "2")
+        r = RankReporter(0, "http://127.0.0.1:1", slice_id=None)
+        assert r.slice_id is None
+        assert RankReporter(0, "http://127.0.0.1:1").slice_id == 1
+        assert RankReporter(0, "http://127.0.0.1:1", slice_id=3).slice_id == 3
+
+    def test_malformed_env_means_no_slice(self, monkeypatch):
+        from kungfu_tpu.monitor.aggregator import RankReporter
+
+        monkeypatch.setenv("MEGASCALE_SLICE_ID", "0")
+        monkeypatch.setenv("MEGASCALE_NUM_SLICES", "two")
+        assert RankReporter(0, "http://127.0.0.1:1").slice_id is None
