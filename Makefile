@@ -6,7 +6,7 @@ PY ?= python3
 BASELINE := tests/lint_baseline.json
 
 .PHONY: lint verify shardcheck check test native trace-demo zero-demo \
-    multislice-demo help
+    multislice-demo adapt-demo help
 
 ## lint: all thirteen kf-lint rules — the Python suite (env-contract,
 ## jit-sync, blocking-io, retry-discipline, collective-consistency,
@@ -81,6 +81,16 @@ multislice-demo:
 	$(PY) -m kungfu_tpu.runner.cli -np 4 -num-slices 2 \
 	    -tolerate-failures -chaos 'die_slice:slice=1,step=3' \
 	    $(PY) examples/multislice_shrink.py --n-steps 8
+
+## adapt-demo: kf-adapt scripted interference A/B (3 in-process ranks,
+## chaos `delay` clauses throttling the 0<->1 link on send AND ping):
+## the UCB bandit measures its windows, majority-votes, and performs the
+## consensus-fenced lockstep swap onto the measured-latency MST — the
+## script asserts the swap fires on EVERY rank and the step time
+## recovers (docs/adaptation.md; the full A/B vs every fixed strategy
+## is `python bench.py --adapt`, recorded in BENCH_extra.json).
+adapt-demo:
+	$(PY) examples/adapt_interference.py
 
 help:
 	@grep -E '^## ' Makefile | sed 's/^## //'

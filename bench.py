@@ -1248,6 +1248,165 @@ def payload_multislice(args) -> dict:
     }
 
 
+def payload_adapt(args) -> dict:
+    """kf-adapt A/B under chaos-injected interference (ISSUE 9 gate):
+    a 3-rank in-process host-plane cluster with ``delay`` clauses (the
+    PR-2 chaos layer) throttling the 0<->1 link on BOTH the data path
+    and the latency probe (``on=ping``).  Every fixed strategy routes
+    traffic over the degraded edge (all 3-peer topologies contain 0-1),
+    so each fixed arm pays the injected latency every step; the bandit
+    (:class:`kungfu_tpu.monitor.adapt_device.HostBanditDriver`) measures
+    its windows, votes, and lockstep-swaps onto the measured-latency MST
+    (0-2-1: the slow edge leaves the tree) — steady-state step time must
+    beat the best fixed strategy, and the flight recorder must show the
+    consensus-fenced ``swap`` event on every rank at one step.
+
+    Pure host-plane CPU (the multislice-row technique): cannot be zeroed
+    by a wedged TPU tunnel."""
+    import os
+    import time as _time
+    from collections import Counter
+
+    import numpy as np
+
+    os.environ["KF_NATIVE_ENGINE"] = "0"  # chaos hooks ride the py path
+    os.environ["KF_CONFIG_ENABLE_TRACE"] = "1"  # swap events must record
+    os.environ.setdefault("KF_CONFIG_LOG_LEVEL", "WARNING")
+    wire_ms = 30
+    os.environ["KF_CHAOS_SPEC"] = ";".join(
+        f"delay:ms={wire_ms},rank={a},peer={b},on={on}"
+        for a, b in ((0, 1), (1, 0)) for on in ("send", "ping")
+    )
+
+    from kungfu_tpu.monitor import timeline
+    from kungfu_tpu.monitor.adapt_device import HostBanditDriver
+    from kungfu_tpu.peer import Peer
+    from kungfu_tpu.plan import Cluster, PeerList, parse_strategy
+    from kungfu_tpu.utils.envs import Config
+
+    elems = 25_000 if args.quick else 50_000  # 100/200 KiB fp32
+    fixed_steps = 6 if args.quick else 10
+    adapt_steps = 24 if args.quick else 40
+    data = np.ones(elems, np.float32)
+    fixed_arms = ("STAR", "RING", "BINARY_TREE_STAR")
+
+    def make_peers(base_port, strategy):
+        workers = PeerList.parse(
+            ",".join(f"127.0.0.1:{base_port + i}" for i in range(3)))
+        runners = PeerList.parse(f"127.0.0.1:{base_port + 99}")
+        cluster = Cluster(runners, workers)
+        ps = [Peer(Config(self_id=w, cluster=cluster)) for w in workers]
+        for p in ps:
+            p.config.strategy = parse_strategy(strategy)
+            p.start()
+        return ps
+
+    def run_world(fns, timeout=120.0):
+        import threading
+
+        outs = [None] * len(fns)
+        errs = []
+
+        def wrap(i, f):
+            try:
+                outs[i] = f()
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=wrap, args=(i, f), daemon=True)
+              for i, f in enumerate(fns)]
+        for t in ts:
+            t.start()
+        deadline = _time.monotonic() + timeout
+        for t in ts:
+            t.join(max(0.0, deadline - _time.monotonic()))
+        if errs:
+            raise errs[0]
+        if any(t.is_alive() for t in ts):
+            raise TimeoutError("adapt world hung")
+        return outs
+
+    def measure_step(p, driver=None):
+        t0 = _time.perf_counter()
+        out = p.engine().all_reduce(data, op="sum")
+        dt = _time.perf_counter() - t0
+        assert float(out[0]) == 3.0, out[:4]
+        swapped = driver.step(dt) if driver is not None else False
+        return dt, swapped
+
+    def run_fixed(strategy, port):
+        ps = make_peers(port, strategy)
+        try:
+            times = []
+            for _ in range(fixed_steps):
+                dts = run_world([
+                    lambda p=p: measure_step(p)[0] for p in ps])
+                times.append(max(dts))
+            # drop warm-up (connection bring-up) steps before the median
+            return float(np.median(times[2:]))
+        finally:
+            for p in ps:
+                p.close()
+
+    fixed = {s: run_fixed(s, 24500 + 10 * i)
+             for i, s in enumerate(fixed_arms)}
+
+    timeline.reset()
+    ps = make_peers(24600, fixed_arms[0])
+    drivers = [HostBanditDriver(p, check_every=2, min_pulls=1,
+                                min_swap_collectives=1) for p in ps]
+    times, swap_steps = [], []
+    try:
+        for i in range(adapt_steps):
+            outs = run_world([
+                lambda p=p, d=d: measure_step(p, d)
+                for p, d in zip(ps, drivers)])
+            flags = {s for _, s in outs}
+            assert len(flags) == 1, f"non-lockstep swap at step {i}: {flags}"
+            times.append(max(dt for dt, _ in outs))
+            if flags.pop():
+                swap_steps.append(i)
+        active = {d.active for d in drivers}
+        assert len(active) == 1, f"ranks diverged on the arm: {active}"
+        swap_events = [e for e in timeline.snapshot() if e["kind"] == "swap"]
+        by_seq = Counter((e["attrs"]["seq"], e["name"]) for e in swap_events)
+        # the fence contract: every swap seq carries one event per rank
+        lockstep = {f"seq{seq}:{arm}": n for (seq, arm), n in
+                    sorted(by_seq.items())}
+        assert all(n == 3 for n in by_seq.values()), lockstep
+    finally:
+        for p in ps:
+            p.close()
+
+    steady = float(np.median(times[-8:]))
+    best_fixed = min(fixed.values())
+    speedup = best_fixed / max(steady, 1e-9)
+    return {
+        "metric": "adapt_bandit_steady_step_time_speedup_vs_best_fixed",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "vs_baseline": round(speedup, 3),
+        "vs_baseline_meaning": ("best fixed-strategy step time over the "
+                                "bandit's steady state (>1 = adaptation "
+                                "wins)"),
+        "platform": "cpu-hostplane",
+        "n_devices": 3,
+        "model": (f"3 ranks, {elems * 4 >> 10} KiB fp32 allreduce/step, "
+                  f"{wire_ms} ms chaos delay on the 0<->1 link "
+                  "(send + ping)"),
+        "rows": {
+            **{f"fixed_{s}": {"step_ms": round(t * 1e3, 2)}
+               for s, t in fixed.items()},
+            "bandit": {
+                "steady_step_ms": round(steady * 1e3, 2),
+                "active_arm": next(iter(active)),
+                "swaps_at_steps": swap_steps,
+                "swap_events_per_rank": lockstep,
+            },
+        },
+    }
+
+
 PAYLOADS = {
     "resnet": payload_resnet,
     "kernels": payload_kernels,
@@ -1255,6 +1414,7 @@ PAYLOADS = {
     "lm": payload_lm,
     "zero": payload_zero,
     "multislice": payload_multislice,
+    "adapt": payload_adapt,
 }
 
 
@@ -1284,6 +1444,10 @@ def main() -> None:
                    help="emulated 2-slice hierarchical vs flat all-reduce "
                         "with injected DCN wire latency (host-plane CPU; "
                         "tunnel-proof)")
+    p.add_argument("--adapt", action="store_true",
+                   help="kf-adapt A/B: bandit strategy adaptation vs every "
+                        "fixed strategy under chaos-injected link "
+                        "interference (host-plane CPU; tunnel-proof)")
     p.add_argument("--payload", choices=sorted(PAYLOADS), default=None,
                    help=argparse.SUPPRESS)  # internal: run in-process
     p.add_argument("--timeout", type=float, default=PAYLOAD_TIMEOUT_S)
@@ -1296,7 +1460,8 @@ def main() -> None:
 
     which = ("kernels" if args.kernels else "allreduce" if args.allreduce
              else "lm" if args.lm else "zero" if args.zero
-             else "multislice" if args.multislice else "resnet")
+             else "multislice" if args.multislice
+             else "adapt" if args.adapt else "resnet")
     fwd = ["--payload", which]
     for flag, val in [
         ("--batch-size", args.batch_size), ("--image-size", args.image_size),
@@ -1318,7 +1483,8 @@ def main() -> None:
     # — the preflight exists to avoid 3 x 900 s on a dead tunnel, not to
     # veto measurements.
     pre_err = backend_preflight(
-        cpu=args.cpu or bool(args.cpu_mesh) or which == "multislice")
+        cpu=args.cpu or bool(args.cpu_mesh)
+        or which in ("multislice", "adapt"))
     if pre_err is None:
         out = run_guarded(fwd, timeout=args.timeout)
         if "metric" not in out and not (args.quick or args.cpu):
@@ -1371,6 +1537,8 @@ def main() -> None:
             "zero": ("zero2_traced_comm_bytes_vs_zero1", "x", "tpu_zero"),
             "multislice": ("multislice_hier_allreduce_speedup_vs_flat", "x",
                            "multislice_cpu_mesh"),
+            "adapt": ("adapt_bandit_steady_step_time_speedup_vs_best_fixed",
+                      "x", "adapt_cpu_mesh"),
         }
         metric, unit, section = payload_info[which]
         out = {

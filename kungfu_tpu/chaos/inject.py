@@ -141,6 +141,16 @@ class ChaosController:
                     continue
                 self._reset(name, payload, channel, peer)
 
+    def on_ping(self, to_rank: int) -> None:
+        """Latency-probe hook (``delay:on=ping``): the adaptation layer's
+        ping RTT measurement (``monitor/adapt.get_peer_latencies``) must
+        see an injected slow link, or the MST re-carve it drives would
+        route straight back onto the degraded edge the data path is
+        paying for."""
+        for ci, c in enumerate(self._clauses):
+            if c.kind == "delay" and c.get("on") == "ping":
+                self._maybe_delay(ci, c, to_rank)
+
     def on_recv(self, from_rank: int, name: str) -> None:
         """Engine receive hook (``delay:on=recv`` stragglers)."""
         with self._lock:

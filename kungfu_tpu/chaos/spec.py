@@ -48,7 +48,9 @@ without scoping a clause applies everywhere):
     Straggler: sleep ``ms=X`` (+ uniform ``jitter=Y`` ms, seeded by
     ``KF_CHAOS_SEED``) before a send.  ``peer=R`` restricts the target;
     ``every=K`` delays only every Kth matching send (default 1 = all);
-    ``on=recv`` delays the receive side instead.
+    ``on=recv`` delays the receive side instead, ``on=ping`` the
+    latency-probe pings (``get_peer_latencies``) — a throttled link
+    must look slow to the MST re-carve, not just to the data path.
 ``drop_fanout``
     The failure detector's cross-host fan-out silently loses its POST to
     ``host=H`` (absent = every host); ``count=N`` drops only the first N
@@ -137,8 +139,10 @@ def _parse_clause(text: str) -> Clause:
         raise ValueError(f"{kind} mode must be exit|raise, got {mode!r}")
     if kind == "die_slice" and params.get("slice") is None:
         raise ValueError("die_slice needs slice=S (the slice to kill)")
-    if kind == "delay" and params.get("on") not in (None, "send", "recv"):
-        raise ValueError(f"delay on= must be send|recv, got {params.get('on')!r}")
+    if kind == "delay" and params.get("on") not in (None, "send", "recv",
+                                                    "ping"):
+        raise ValueError(
+            f"delay on= must be send|recv|ping, got {params.get('on')!r}")
     return Clause(kind, tuple(sorted(params.items())))
 
 

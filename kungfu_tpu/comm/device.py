@@ -64,7 +64,9 @@ GLOBAL_AXES = (HOST_AXIS, LOCAL_AXIS)
 _REDUCE_OPS = ("sum", "min", "max", "prod", "mean")
 
 
-def _traced_collective(name: str, op: str, n: int, version: int, fn):
+def _traced_collective(name: str, op: str, n: int, version: int, fn,
+                       nbytes: Optional[int] = None,
+                       sched: Optional[str] = None, hook=None):
     """Run an eager collective under a device-plane timeline span.
 
     JAX dispatch is asynchronous — the eager call returns once the op is
@@ -72,12 +74,29 @@ def _traced_collective(name: str, op: str, n: int, version: int, fn):
     and a straggler-stalled collective would record microseconds (the
     exact signal kftrace exists to expose, inverted).  Traced runs
     therefore block on the result inside the span; untraced runs (the
-    production default) keep the async fast path untouched."""
-    if not timeline.enabled():
+    production default) keep the async fast path untouched.
+
+    ``nbytes``/``sched`` stamp the span for the per-schedule latency
+    rings (kf-adapt), and ``hook`` — the communicator's latency hook —
+    receives ``(nbytes, sched, seconds)`` for every measured collective.
+    An installed hook forces the fence even with tracing off: the bandit
+    needs real execution times, not dispatch times."""
+    if not timeline.enabled() and hook is None:
         return fn()
-    with timeline.span("device", name, op=op, n=n, version=version):
+    attrs = {"op": op, "n": n, "version": version}
+    if nbytes is not None:
+        attrs["nbytes"] = nbytes
+    if sched is not None:
+        attrs["sched"] = sched
+    t0 = time.perf_counter()
+    with timeline.span("device", name, **attrs):
         out = fn()
         jax.block_until_ready(out)
+    if hook is not None and nbytes is not None and sched is not None:
+        try:
+            hook(nbytes, sched, time.perf_counter() - t0)
+        except Exception as e:  # noqa: BLE001 — observers must not break comm
+            _log.warning("latency hook failed: %s", e)
     return out
 
 
@@ -105,6 +124,15 @@ class Communicator:
         self.version = version
         self._strategy = "psum"
         self._on_strategy_change = on_strategy_change
+        #: per-payload-bucket schedule overrides (kf-adapt): bucket index
+        #: (ops.schedules.size_bucket) -> schedule name.  Empty = every
+        #: size rides the global strategy.  Deliberately NOT carried
+        #: across mesh epochs — a resize is a new regime; the bandit
+        #: driver re-explores (monitor/adapt_device.py)
+        self._bucket_strategy: dict = {}
+        #: kf-adapt latency hook: called (nbytes, sched, seconds) after
+        #: every measured eager collective (None = untimed fast path)
+        self._latency_hook: Optional[Callable] = None
         self.set_strategy(strategy)
         devs = list(devices) if devices is not None else list(jax.devices())
         n = len(devs)
@@ -183,6 +211,70 @@ class Communicator:
             # racing this call cannot rebuild the next epoch without it
             self._on_strategy_change(name)
 
+    # -- per-bucket schedule table (kf-adapt) -----------------------------
+    def set_bucket_strategy(self, bucket: int, name: Optional[str]) -> None:
+        """Install ``name`` as the allreduce schedule for one payload
+        bucket (:data:`kungfu_tpu.ops.schedules.SIZE_BUCKETS`) — the
+        online swap hook of the size-bucketed schedule table: small
+        control tensors and large fused gradient buckets carry
+        independently-learned winners.  ``None`` clears the override.
+        Swaps re-jit lazily (programs are cached per (op, shape,
+        schedule)); like :meth:`set_strategy`, all controller processes
+        must make the same call at the same point — the bandit driver's
+        consensus fence (:mod:`kungfu_tpu.monitor.adapt_device`) owns
+        that discipline."""
+        from kungfu_tpu.ops.schedules import (ALLREDUCE_SCHEDULES,
+                                              SIZE_BUCKETS)
+
+        if not 0 <= bucket < len(SIZE_BUCKETS):
+            raise ValueError(
+                f"bucket {bucket} out of range [0, {len(SIZE_BUCKETS)})")
+        if name is None:
+            self._bucket_strategy.pop(bucket, None)
+            return
+        if name not in ALLREDUCE_SCHEDULES:
+            raise ValueError(
+                f"unknown strategy {name!r}; one of {ALLREDUCE_SCHEDULES}")
+        self._bucket_strategy[bucket] = name
+
+    def strategy_for_bucket(self, bucket: int) -> str:
+        """Active schedule for one payload bucket (global strategy when
+        no override is installed)."""
+        return self._bucket_strategy.get(bucket, self._strategy)
+
+    def strategy_for(self, nbytes: int) -> str:
+        """Active schedule for a payload of ``nbytes``."""
+        if not self._bucket_strategy:
+            return self._strategy
+        from kungfu_tpu.ops.schedules import size_bucket
+
+        return self.strategy_for_bucket(size_bucket(nbytes))
+
+    def bucket_strategies(self) -> dict:
+        """Installed per-bucket overrides, ``{bucket_index: name}``."""
+        return dict(self._bucket_strategy)
+
+    def bucket_summary(self) -> str:
+        """Compact ``"small=psum,large=ring"`` rendering of the installed
+        bucket table ("" when empty) — the active-arm column kftop shows
+        per rank (docs/monitoring.md)."""
+        if not self._bucket_strategy:
+            return ""
+        from kungfu_tpu.ops.schedules import SIZE_BUCKETS
+
+        return ",".join(
+            f"{SIZE_BUCKETS[b]}={n}"
+            for b, n in sorted(self._bucket_strategy.items())
+        )
+
+    def set_latency_hook(self, fn: Optional[Callable]) -> None:
+        """Install ``fn(nbytes, sched, seconds)`` to receive the measured
+        execution time of every eager collective — the bandit driver's
+        feed.  The hook forces result-fencing on the eager path (the
+        measurement is execution, not dispatch); pass ``None`` to restore
+        the async fast path."""
+        self._latency_hook = fn
+
     def autotune_strategy(self, nbytes: int = 4 << 20, trials: int = 3) -> str:
         """Measure every allreduce schedule on a representative buffer on
         THIS mesh and install the fastest — the reference's AUTO strategy
@@ -228,7 +320,20 @@ class Communicator:
             # lifetime
             for key in set(self._fns) - cached_before:
                 del self._fns[key]
-        winner = ALLREDUCE_SCHEDULES[int(np.argmin(agreed))]
+        idx = int(np.argmin(agreed))
+        win_t = float(agreed[idx])
+        if not math.isfinite(win_t) or win_t <= 0.0 or win_t >= 1e8:
+            # a 0.0 s / non-finite / sentinel "winner" is a measurement
+            # failure, not a preference — installing it is how the old
+            # 1 KiB 1-trial startup probe coin-flipped the schedule
+            # (ROADMAP #4).  Keep the incumbent and say so loudly.
+            _log.warning(
+                "autotune: winning time %r is not a credible measurement "
+                "(times %s); keeping %r",
+                win_t, list(map(float, agreed)), self._strategy,
+            )
+            return self._strategy
+        winner = ALLREDUCE_SCHEDULES[idx]
         _log.info(
             "autotune: %s over %s",
             winner,
@@ -241,16 +346,23 @@ class Communicator:
     def _agree(self, row, op: str) -> np.ndarray:
         """Reduce a small per-controller vector over the mesh and return
         the agreed row — always over the default psum path (the machinery
-        under measurement must not carry its own agreement traffic)."""
+        under measurement must not carry its own agreement traffic).
+        Bucket overrides and the latency hook are suspended for the same
+        reason: agreement traffic must neither ride a schedule under
+        test nor land in the bandit's measurement windows."""
         stacked = jnp.broadcast_to(
             jnp.asarray(row, jnp.float32), (self._local_n, len(row))
         )
         prev = self._strategy
+        prev_buckets, self._bucket_strategy = self._bucket_strategy, {}
+        prev_hook, self._latency_hook = self._latency_hook, None
         self._strategy = "psum"
         try:
             return np.asarray(self.all_reduce(stacked, op=op))[0]
         finally:
             self._strategy = prev
+            self._bucket_strategy = prev_buckets
+            self._latency_hook = prev_hook
 
     def _time_schedules(self, x, trials):
         """Per-schedule seconds for one allreduce of ``x``, measured the
@@ -457,24 +569,37 @@ class Communicator:
 
     # -- collectives (eager, stacked) ------------------------------------
     def all_reduce(self, x, op: str = "sum"):
-        """Stacked allreduce: out[i] = reduce_j x[j].  Pytrees supported."""
+        """Stacked allreduce: out[i] = reduce_j x[j].  Pytrees supported.
+        The schedule is resolved per payload bucket
+        (:meth:`strategy_for`); the span/latency-hook attribution uses
+        the dominant (largest) leaf — the one that governs the time."""
         if op not in _REDUCE_OPS:
             raise ValueError(f"op {op!r} not in {_REDUCE_OPS}")
         _tree_stack_check(self._local_n, x)
+        dom_nbytes = max(
+            (getattr(leaf, "nbytes", 0)
+             for leaf in jax.tree_util.tree_leaves(x)),
+            default=0,
+        )
         return _traced_collective(
             "device.all_reduce", "all_reduce", self._n, self.version,
             lambda: jax.tree_util.tree_map(
-                lambda a: self._all_reduce_leaf(a, op, GLOBAL_AXES), x))
+                lambda a: self._all_reduce_leaf(a, op, GLOBAL_AXES), x),
+            nbytes=int(dom_nbytes),
+            sched=self.strategy_for(int(dom_nbytes)) if op != "prod"
+            else "psum",
+            hook=self._latency_hook,
+        )
 
     def _all_reduce_leaf(self, a, op, axes):
         a = jnp.asarray(a)
-        sched = self._strategy if op != "prod" else "psum"
+        sched = self.strategy_for(a.nbytes) if op != "prod" else "psum"
         key = ("ar", op, axes, a.shape, a.dtype.name, sched)
 
         def build():
             def body(s):
                 if sched != "psum":
-                    return self._scheduled_body(s, op, axes)
+                    return self._scheduled_body(s, op, axes, sched)
                 if op == "sum":
                     return jax.lax.psum(s, axes)
                 if op == "mean":
@@ -492,15 +617,19 @@ class Communicator:
 
         return self._cached(key, build)(a)
 
-    def _scheduled_body(self, s, op, axes):
+    def _scheduled_body(self, s, op, axes, sched: Optional[str] = None):
         """Non-default schedule over the REQUESTED axes (global or one of
         the local/cross sub-axes).  ``all_reduce_scheduled`` owns the
         hierarchical decomposition: the schedule applies to the FIRST
         non-trivial axis (cross-host in ``(host, local)`` order) after
-        the inner axes fold with one-hop psum."""
+        the inner axes fold with one-hop psum.  ``sched`` is resolved by
+        the CALLER (per-bucket dispatch) — reading ``self._strategy``
+        here would ignore an installed bucket override at trace time."""
         from kungfu_tpu.ops.schedules import all_reduce_scheduled
 
-        return all_reduce_scheduled(s, axes, op=op, schedule=self._strategy)
+        return all_reduce_scheduled(
+            s, axes, op=op,
+            schedule=sched if sched is not None else self._strategy)
 
     def reduce(self, x, root: int = 0, op: str = "sum"):
         """Root-valid reduce (reference ``session.go:157-165``): peer

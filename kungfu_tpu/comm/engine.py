@@ -237,7 +237,15 @@ class CollectiveEngine:
         self._graph_ser: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
         from concurrent.futures import ThreadPoolExecutor
 
-        self._pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="kf-engine")
+        from kungfu_tpu.comm.host import host_pool_size
+
+        # sender/chunk pool scaled with peer count (floor 8 preserves
+        # the measured chunk-pipelining win on small clusters; wider
+        # worlds get up to KF_CONFIG_HOST_POOL_MAX concurrent chunks)
+        self._pool = ThreadPoolExecutor(
+            max_workers=host_pool_size(len(peers), floor=8, pool="engine"),
+            thread_name_prefix="kf-engine",
+        )
         self._async_pool: Optional[ThreadPoolExecutor] = None
         # per-strategy-pair accounting for adaptation: cumulative
         # (bytes, seconds), a recent window (reset on throughputs()), and
@@ -246,6 +254,11 @@ class CollectiveEngine:
         self.stats = [[0, 0.0] for _ in self._graphs]
         self._window = [[0, 0.0] for _ in self._graphs]
         self.best_throughputs = [0.0 for _ in self._graphs]
+        # swap-eligibility epoch (kf-adapt): collectives executed since
+        # the last strategy swap — the bandit driver refuses to judge an
+        # arm that has not carried real traffic yet (mark_swap resets)
+        self._colls_total = 0
+        self._colls_at_swap = 0
 
     # -- public collectives ----------------------------------------------
     def all_reduce(
@@ -300,6 +313,8 @@ class CollectiveEngine:
         a loop that opens with a parameter broadcast still dies where
         the spec says."""
         self._coll_counter.inc()
+        with self._stats_lock:
+            self._colls_total += 1
         if self._chaos is not None:
             self._chaos.on_collective(tag)
 
@@ -874,6 +889,36 @@ class CollectiveEngine:
         with self._stats_lock:
             return [(b / t / 2**30) if t > 0 else 0.0 for b, t in self.stats]
 
+    def window_peek(self) -> List[Tuple[int, float]]:
+        """Non-destructive view of the recent per-strategy-pair window:
+        ``[(bytes, seconds), ...]`` accumulated since the last
+        :meth:`throughputs` call.  The kf-adapt window export: unlike
+        ``throughputs()`` it does NOT reset the window, so the bandit
+        driver and the interference checker can read the same window
+        without racing each other's resets."""
+        with self._stats_lock:
+            return [(int(b), float(t)) for b, t in self._window]
+
+    def mark_swap(self) -> None:
+        """Open a new swap-eligibility epoch: collectives before this
+        point no longer count toward :meth:`swap_eligible` (called by
+        the adaptation drivers right after a fenced strategy swap, so
+        the next verdict is about the NEW arm only)."""
+        with self._stats_lock:
+            self._colls_at_swap = self._colls_total
+
+    def collectives_since_swap(self) -> int:
+        """Collectives executed in the current swap-eligibility epoch."""
+        with self._stats_lock:
+            return self._colls_total - self._colls_at_swap
+
+    def swap_eligible(self, min_collectives: int = 2) -> bool:
+        """Whether the active strategy has carried enough real traffic
+        since the last swap to be judged — the hysteresis gate that
+        stops a bandit (or any adaptation driver) from thrashing
+        strategies faster than it can measure them."""
+        return self.collectives_since_swap() >= max(0, int(min_collectives))
+
     def set_strategy(self, strategy: Strategy) -> None:
         """Swap the strategy set (reference ``SetGlobalStrategy`` +
         ``adaptation.go:8-28``; caller is responsible for the barrier +
@@ -886,3 +931,5 @@ class CollectiveEngine:
             self.stats = [[0, 0.0] for _ in self._graphs]
             self._window = [[0, 0.0] for _ in self._graphs]
             self.best_throughputs = [0.0 for _ in self._graphs]
+            # a swap opens a fresh eligibility epoch by definition
+            self._colls_at_swap = self._colls_total

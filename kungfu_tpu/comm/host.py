@@ -73,6 +73,35 @@ CONNECT_TIMEOUT_S = 10.0
 
 USE_UNIXSOCK = "KF_TPU_USE_UNIXSOCK"
 
+#: default ceiling on the load-scaled pools (``KF_CONFIG_HOST_POOL_MAX``)
+HOST_POOL_CAP_DEFAULT = 16
+
+
+def host_pool_size(n_peers: int, floor: int = 2,
+                   pool: str = "host") -> int:
+    """Responder/sender pool size scaled with the peer count.
+
+    A fixed pool is wrong at both ends: 2 responder threads serialize a
+    16-peer cluster's concurrent blob pulls behind the slowest receiver,
+    and a thread per peer on a 256-worker job is 256 idle stacks.  So:
+    one slot per peer, floored at ``floor`` (a 2-peer world still wants
+    request/response overlap) and capped by ``KF_CONFIG_HOST_POOL_MAX``
+    (default 16 — beyond that the loopback/NIC is the bottleneck, not
+    thread count).  The cap is the operator's ceiling, so it wins over
+    the floor on a thread-constrained host.  The chosen size is exported
+    as the ``kf_host_pool_size{pool=...}`` registry gauge — labeled per
+    pool (engine sender/chunk pool vs p2p responders), since the two
+    scale from different floors — so kftop//metrics can confirm the
+    scaling actually happened."""
+    from kungfu_tpu.monitor.registry import REGISTRY
+    from kungfu_tpu.utils import envs
+
+    cap = max(1, envs.parse_int_env(envs.HOST_POOL_MAX,
+                                    HOST_POOL_CAP_DEFAULT))
+    size = max(1, min(cap, max(int(floor), int(n_peers))))
+    REGISTRY.gauge("kf_host_pool_size", pool=pool).set(size)
+    return size
+
 
 def unixsock_enabled() -> bool:
     """Colocated peers use a unix domain socket (reference

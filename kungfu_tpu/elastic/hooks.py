@@ -55,11 +55,18 @@ def elastic_step(
     schedule: Optional[str],
     params,
     zero_boundary=None,
+    bandit=None,
 ) -> Tuple[ElasticState, object, bool]:
     """Run once per completed training step.
 
     Returns ``(new_state, params, should_stop)``; ``params`` are re-broadcast
     when membership changed.
+
+    ``bandit`` (a kf-adapt driver, :mod:`kungfu_tpu.monitor.adapt_device`)
+    gets ``on_membership_change()`` after a resize: bandit state survives
+    the resize by *re-exploring* — a 4-rank arm table says nothing about
+    the 2-rank regime, so the measured winners are re-learned on the new
+    membership instead of carried stale.
 
     Call order per training step is: local grads → gradient allreduce →
     apply → ``elastic_step``.  The step re-sync happens *first* here so a
@@ -121,6 +128,13 @@ def elastic_step(
         if peer.detached:
             log_event("detached-stopping")
             return replace(state, detached=True), params, True
+        if bandit is not None:
+            # survivors re-explore: the engines/communicators are rebuilt
+            # for the new membership, so the measured arm tables reset
+            # BEFORE any new-epoch window can be charged to a stale
+            # winner.  After the detach check — a detached peer has no
+            # engine in the new membership to re-anchor on
+            bandit.on_membership_change(peer.cluster_version)
         log_event(f"resynced-after-resize-v{peer.cluster_version}")
         # the new cluster shape re-jits the training step (new mesh ⇒
         # fresh XLA compile, multi-ten-second on TPU); tell the failure

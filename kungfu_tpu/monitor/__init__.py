@@ -18,7 +18,10 @@
   pushes to a cluster aggregator co-hosted with the config server
   (freshness/staleness, online skew, cluster health);
 * :mod:`kungfu_tpu.monitor.kftop` — ``kftop``: live refreshing terminal
-  view of the aggregator's ``/cluster`` endpoint.
+  view of the aggregator's ``/cluster`` endpoint;
+* :mod:`kungfu_tpu.monitor.adapt_device` — kf-adapt: the UCB bandit
+  drivers (host strategies + MST arm, per-bucket device schedules) with
+  the consensus-fenced lockstep swap (docs/adaptation.md).
 """
 
 from kungfu_tpu.monitor import timeline
@@ -50,3 +53,20 @@ __all__ = [
     "monitor_epoch_end",
     "monitor_train_end",
 ]
+
+#: kf-adapt bandit drivers, exported LAZILY (PEP 562): adapt_device
+#: pulls in the policy package, whose runner imports elastic.hooks,
+#: which imports kungfu_tpu.chaos — and chaos.inject imports THIS
+#: package for the timeline.  An eager import here closes that loop
+#: into a real circular-import crash whenever kungfu_tpu.chaos is the
+#: first package imported (tests/test_chaos.py standalone).
+_LAZY_BANDIT = ("DeviceBanditDriver", "HostBanditDriver")
+__all__ += list(_LAZY_BANDIT)
+
+
+def __getattr__(name):
+    if name in _LAZY_BANDIT:
+        from kungfu_tpu.monitor import adapt_device
+
+        return getattr(adapt_device, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

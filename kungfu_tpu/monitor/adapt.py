@@ -34,15 +34,23 @@ def get_peer_latencies(peer, samples: int = 1) -> List[float]:
     **+inf for unreachable peers** — an unreachable peer must look
     infinitely expensive to the MST, not free, or the broadcast tree gets
     hubbed on a dead node."""
+    from kungfu_tpu.chaos import controller_for
+
     channel = peer.channel
+    chaos = controller_for(peer.chaos_rank())
     out: List[float] = []
-    for target in peer.cluster.workers:
+    for rank, target in enumerate(peer.cluster.workers):
         if channel is None or target == peer.config.self_id:
             out.append(0.0)
             continue
         best, fails = None, 0
         for _ in range(samples):
             t0 = time.perf_counter()
+            if chaos is not None:
+                # delay:on=ping, inside the timed window — injected link
+                # interference must be visible to the probe the MST
+                # re-carve reads, i.e. inflate the measured RTT
+                chaos.on_ping(rank)
             if channel.ping(target, timeout=5.0):
                 dt = time.perf_counter() - t0
                 best = dt if best is None else min(best, dt)
@@ -85,6 +93,8 @@ def set_tree(engine, forest: List[int]) -> None:
         engine.stats = [[0, 0.0]]
         engine._window = [[0, 0.0]]
         engine.best_throughputs = [0.0]
+        # the tree install is a swap: open a fresh eligibility epoch
+        engine._colls_at_swap = engine._colls_total
     engine._graph_ser.clear()  # native executor serializations are stale
     engine.strategy = None
     _log.info("installed explicit tree %s", forest)

@@ -462,7 +462,11 @@ class ClusterAggregator:
 # -- reporter (rank side) --------------------------------------------------
 #: event kinds a snapshot forwards to the aggregator: the skew feedstock
 #: plus the fault kinds (so `/cluster` can correlate them online)
-REPORT_KINDS = frozenset(skewlib.COLLECTIVE_KINDS) | frozenset(skewlib.FAULT_KINDS)
+REPORT_KINDS = (frozenset(skewlib.COLLECTIVE_KINDS)
+                | frozenset(skewlib.FAULT_KINDS)
+                # kf-adapt swap events ride the same push so kftop's
+                # control/event surfaces see lockstep strategy changes
+                | frozenset({"swap"}))
 
 #: EMA weight for the step-time estimate (~5-push memory)
 _STEP_EMA_ALPHA = 0.2

@@ -185,6 +185,10 @@ METRIC_HELP: Dict[str, str] = {
     "kf_chaos_injections_total": "chaos faults injected, by clause kind",
     "kf_detector_down_total": "failure-detector down verdicts",
     "kf_shrink_events_total": "shrink-to-survivors phase events, by phase",
+    "kf_strategy_swaps_total":
+        "consensus-fenced strategy/schedule swaps (kf-adapt), by arm",
+    "kf_host_pool_size":
+        "host-plane responder/sender pool size (scaled with peer count)",
     "kf_slice_events_total":
         "slice-granular recovery phase events (multislice), by phase",
     "kf_timeline_dropped_total": "flight-recorder ring evictions",

@@ -75,6 +75,9 @@ EVENT_KINDS = frozenset({
                    # verdict / self-excluded / leader-consensus /
                    # propose / quorum-lost at the multislice grain)
     "chaos",       # fault injection fired (chaos/inject.py)
+    "swap",        # consensus-fenced strategy/schedule swap (kf-adapt:
+                   # monitor/adapt_device.py — host arm or device
+                   # per-bucket schedule installed in lockstep)
     "step",        # training-step mark
     "mark",        # generic one-shot annotation
 })
@@ -90,8 +93,9 @@ _COUNTED_KINDS = {
     "down": "kf_detector_down_total",
     "shrink": "kf_shrink_events_total",
     "slice": "kf_slice_events_total",
+    "swap": "kf_strategy_swaps_total",
 }
-_LABELED_KINDS = ("chaos", "shrink", "slice")
+_LABELED_KINDS = ("chaos", "shrink", "slice", "swap")
 
 _lock = threading.Lock()
 _ring: collections.deque = collections.deque()

@@ -42,6 +42,25 @@ Axis = Union[str, Tuple[str, ...]]
 #: selectable device-plane allreduce schedules
 ALLREDUCE_SCHEDULES = ("psum", "two_stage", "ring")
 
+#: payload-size buckets for the per-bucket schedule table
+#: (:meth:`kungfu_tpu.comm.device.Communicator.set_bucket_strategy`): the
+#: best decomposition shifts with payload size — small control tensors
+#: are latency-bound (one fused HLO all-reduce wins), large fused
+#: gradient buckets are bandwidth-bound (the explicit two-stage/ring
+#: decompositions win; PAPERS.md 2011.03641) — so each bucket learns its
+#: own winner.  Edges are upper bounds in bytes; the last bucket is
+#: unbounded.
+SIZE_BUCKETS = ("small", "large")
+SIZE_BUCKET_EDGES = (256 << 10,)  # small: < 256 KiB; large: the rest
+
+
+def size_bucket(nbytes: int) -> int:
+    """Bucket index for a payload of ``nbytes`` (0-based, ascending)."""
+    for i, edge in enumerate(SIZE_BUCKET_EDGES):
+        if nbytes < edge:
+            return i
+    return len(SIZE_BUCKET_EDGES)
+
 _OPS = {
     "sum": jnp.add,
     "mean": jnp.add,  # sum then divide at the end

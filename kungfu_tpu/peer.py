@@ -136,7 +136,8 @@ class Peer:
                 from kungfu_tpu.store import install_p2p_handler
 
                 self._p2p_stop = install_p2p_handler(
-                    self._channel, self.store, self._ctrl_store)
+                    self._channel, self.store, self._ctrl_store,
+                    n_peers=self.size())
             if self.config.coordinator and self.config.num_processes > 1:
                 self._init_jax_distributed()
             from kungfu_tpu.utils.affinity import bind_local_rank
@@ -196,11 +197,22 @@ class Peer:
             log_event("peer-started")
 
     def _active_strategy(self) -> str:
-        """The host-engine strategy currently in force (swaps via
-        set_strategy/adaptation included) — stamped on live snapshots."""
+        """The active strategy/arm set, stamped on live snapshots (the
+        kftop ``strategy`` column): the host-engine strategy in force
+        (set_strategy / adaptation swaps included; an installed explicit
+        tree renders as ``tree``), plus the device communicator's
+        per-bucket schedule table when the kf-adapt bandit has installed
+        one — e.g. ``STAR dev[small=psum,large=ring]``."""
         engine = self._engine
         s = engine.strategy if engine is not None else self.config.strategy
-        return getattr(s, "name", str(s))
+        name = "tree" if (engine is not None and s is None) \
+            else getattr(s, "name", str(s))
+        comm = self._comm
+        if comm is not None:
+            buckets = comm.bucket_summary()
+            if buckets:
+                name = f"{name} dev[{buckets}]"
+        return name
 
     def _net_totals(self) -> dict:
         mon = self.net_monitor

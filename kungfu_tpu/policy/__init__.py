@@ -10,6 +10,11 @@ elastic protocol.
 """
 
 from kungfu_tpu.policy.base import BasePolicy, PolicyContext  # noqa: F401
+from kungfu_tpu.policy.bandit import (  # noqa: F401
+    ArmStats,
+    CollectiveBanditPolicy,
+    ScheduleTable,
+)
 from kungfu_tpu.policy.policies import (  # noqa: F401
     AdaptiveStrategyPolicy,
     GNSResizePolicy,

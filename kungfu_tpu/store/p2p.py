@@ -28,7 +28,7 @@ _FAIL = b"\x00"
 
 
 def install_p2p_handler(channel: HostChannel, store=None,
-                        control_store=None) -> None:
+                        control_store=None, n_peers: Optional[int] = None):
     """Make this endpoint answer blob requests from ``store`` (default: the
     process-global store).  Names under the reserved ``kf.`` prefix are
     served from ``control_store`` instead — control-plane blobs (e.g. the
@@ -94,11 +94,28 @@ def install_p2p_handler(channel: HostChannel, store=None,
             except Exception as e:  # noqa: BLE001 — keep serving
                 _log.warning("p2p serve failed: %s", e)
 
-    # a small pool, not one thread: the reference answers each request
-    # on its own goroutine, and with several peers pulling concurrently
-    # a single responder would serialize ~100 MiB serves behind the
-    # slowest receiver.  KF_CONFIG_P2P_RESPONDERS sizes it.
-    n_threads = max(1, int(os.environ.get("KF_CONFIG_P2P_RESPONDERS", "2")))
+    # a pool, not one thread: the reference answers each request on its
+    # own goroutine, and with several peers pulling concurrently a
+    # single responder would serialize ~100 MiB serves behind the
+    # slowest receiver.  The size SCALES with the peer count
+    # (host_pool_size: floor 2, capped by KF_CONFIG_HOST_POOL_MAX,
+    # exported as the kf_host_pool_size gauge); an explicit
+    # KF_CONFIG_P2P_RESPONDERS pins it instead.
+    from kungfu_tpu.comm.host import host_pool_size
+    from kungfu_tpu.utils import envs
+
+    override = os.environ.get(envs.P2P_RESPONDERS, "").strip()
+    if override:
+        n_threads = max(1, int(override))
+        # the gauge must reflect the PINNED size too, or the one surface
+        # meant to confirm the pool's size goes silent exactly when an
+        # operator overrides it
+        from kungfu_tpu.monitor.registry import REGISTRY
+
+        REGISTRY.gauge("kf_host_pool_size", pool="p2p").set(n_threads)
+    else:
+        n_threads = host_pool_size(
+            n_peers if n_peers is not None else 2, pool="p2p")
     threads = [threading.Thread(target=responder,
                                 name=f"kf-p2p-responder-{i}", daemon=True)
                for i in range(n_threads)]

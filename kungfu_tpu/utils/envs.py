@@ -83,8 +83,15 @@ Tuning envs (read anywhere, any time):
                                    default 3x the push period — well
                                    inside the failure detector's 10 s
                                    down verdict (monitor/aggregator.py)
-``KF_CONFIG_P2P_RESPONDERS``       p2p blob responder pool size,
-                                   default 2 (store/p2p.py)
+``KF_CONFIG_P2P_RESPONDERS``       p2p blob responder pool size override;
+                                   default scales with peer count via
+                                   host_pool_size (store/p2p.py)
+``KF_CONFIG_HOST_POOL_MAX``        cap on the load-scaled host-plane
+                                   responder/sender pools, default 16
+                                   (wins over per-pool floors); current
+                                   sizes exported as the
+                                   kf_host_pool_size{pool=...} gauge
+                                   (comm/host.py)
 ``KF_CONFIG_USE_AFFINITY``         truthy: partition host cores between
                                    colocated workers (utils/affinity.py)
 ``KF_CONFIG_WATCH_GRACE``          runner natural-end grace window s,
@@ -216,6 +223,8 @@ CHUNK_SIZE = "KF_CONFIG_CHUNK_SIZE"
 ENGINE_THREADS = "KF_CONFIG_ENGINE_THREADS"
 ENGINE_TIMEOUT = "KF_CONFIG_ENGINE_TIMEOUT"
 PEER_DEADLINE = "KF_CONFIG_PEER_DEADLINE"
+HOST_POOL_MAX = "KF_CONFIG_HOST_POOL_MAX"
+P2P_RESPONDERS = "KF_CONFIG_P2P_RESPONDERS"
 
 # observability envs (read by kungfu_tpu/monitor/timeline.py, which
 # defines mirror constants next to its reader code; registered here so

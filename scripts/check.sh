@@ -21,6 +21,9 @@
 # 3. kftop         — live-plane /cluster schema self-check (push wire
 #                    format, view schema, and renderer must agree,
 #                    docs/monitoring.md)
+# 3b. adapt-demo   — kf-adapt interference A/B: chaos-degraded link,
+#                    bandit majority vote, consensus-fenced lockstep
+#                    strategy swap on every rank (docs/adaptation.md)
 # 4. compileall    — every .py parses/compiles on this interpreter
 # 5. flag stamps   — no sanitizer flags leaked into the production
 #                    .buildflags stamp (variants must never mix)
@@ -71,6 +74,20 @@ if ! timeout -k 10 240 python3 -m kungfu_tpu.runner.cli -np 4 \
         /tmp/_kf_multislice_demo.log; then
     echo "ERROR: multislice demo did not survive the slice kill"
     tail -40 /tmp/_kf_multislice_demo.log || true
+    fail=1
+fi
+
+echo "== adapt-demo (bandit abandons a chaos-degraded strategy, fenced swap)"
+# kf-adapt end to end: chaos `delay` clauses throttle one link, the UCB
+# bandit's windows degrade, the majority vote agrees, and the
+# consensus-fenced lockstep swap fires on every rank (docs/adaptation.md).
+# Bounded: a wedged fence must fail the gate, not hang it.
+rm -f /tmp/_kf_adapt_demo.log
+if ! timeout -k 10 150 python3 examples/adapt_interference.py \
+        > /tmp/_kf_adapt_demo.log 2>&1 \
+        || ! grep -q "adapt-demo: swap fired" /tmp/_kf_adapt_demo.log; then
+    echo "ERROR: adapt demo did not fire the fenced swap"
+    tail -40 /tmp/_kf_adapt_demo.log || true
     fail=1
 fi
 
