@@ -6,13 +6,14 @@ PY ?= python3
 BASELINE := tests/lint_baseline.json
 
 .PHONY: lint verify shardcheck check test native trace-demo zero-demo \
-    multislice-demo adapt-demo help
+    multislice-demo adapt-demo overlap-demo help
 
-## lint: all thirteen kf-lint rules — the Python suite (env-contract,
-## jit-sync, blocking-io, retry-discipline, collective-consistency,
-## wire-contract, lock-order, trace-vocab, agg-schema, shard-axis,
-## shard-spec, recompile-hazard) AND the transport.cpp lockcheck
-## (lock-discipline) in one command, honoring the baseline.
+## lint: all fourteen kf-lint rules — the Python suite (env-contract,
+## jit-sync, blocking-io, retry-discipline, handle-discipline,
+## collective-consistency, wire-contract, lock-order, trace-vocab,
+## agg-schema, shard-axis, shard-spec, recompile-hazard) AND the
+## transport.cpp lockcheck (lock-discipline) in one command, honoring
+## the baseline.
 lint:
 	$(PY) scripts/kflint $(if $(wildcard $(BASELINE)),--baseline $(BASELINE))
 
@@ -91,6 +92,17 @@ multislice-demo:
 ## is `python bench.py --adapt`, recorded in BENCH_extra.json).
 adapt-demo:
 	$(PY) examples/adapt_interference.py
+
+## overlap-demo: kf-overlap A/B (3 in-process ranks, chaos `delay`
+## injecting 25 ms wire latency on every send): the ZeRO-2 bucket loop
+## runs serial (issue, wait, compute) then depth-k pipelined
+## (host_bucket_pipeline over the engine's async handle window) — the
+## script asserts measured overlap > 0, BITWISE-identical final params,
+## and the kf_overlap_inflight gauge back at 0 (docs/overlap.md; the
+## full A/B incl. zero-3 and the bare shard_map+psum row is
+## `python bench.py --overlap`, recorded in BENCH_extra.json).
+overlap-demo:
+	$(PY) examples/overlap_pipeline.py
 
 help:
 	@grep -E '^## ' Makefile | sed 's/^## //'

@@ -660,11 +660,21 @@ def measure_group(named_steps, init_carry, k_lo=4, k_hi=12, rounds=5,
     final = measure(names, "final", rounds, settle=rounds >= 2)
     out = {}
     for name, t in final.items():
-        if t <= 0 and rounds >= 2:
+        # collapse floor: the differencing cancels constant overhead, so
+        # a derived time well below the per-iteration wall bound is
+        # normal — but 1000x below it means the two K-programs never
+        # separated beyond jitter (observed: a ms-scale train step once
+        # derived ~30 ns and printed as a 0.0 ms row).  Relative to the
+        # contestant's OWN observed wall, so a genuinely-ns synthetic op
+        # (tests) stays measurable while a collapsed ms-scale step does
+        # not.
+        floor = walls.get(name, 0.0) / max(spans.get(name, 1), 1) * 1e-3
+        if (t <= 0 or t < floor) and rounds >= 2:
             # the two K-programs never separated: there is no
-            # measurement here, and a floor value would print as an
-            # impossible TFLOP/s — report honestly
-            print(f"measure_group: {name}: differencing non-positive "
+            # measurement here, and a collapsed value would print as an
+            # impossible TFLOP/s or a 0.0 ms row — report honestly
+            print(f"measure_group: {name}: differencing non-positive or "
+                  f"collapsed below the jitter floor ({floor:.2e}s) "
                   "after all rounds; unmeasurable", file=sys.stderr)
             out[name] = None
         else:
@@ -1052,9 +1062,16 @@ def payload_zero(args) -> dict:
 
     full_state = opt_state_bytes(o_bare)  # replicated: full on EVERY rank
     for name in ("bare", "zero1", "zero2", "zero3"):
+        # sub-us "step times" are the rounds=1 smoke path's clamped
+        # non-positive differencing (one lo/hi sample each on a loaded
+        # 1-core box can time inverted) — that is no measurement of a
+        # ms-scale train step; report None like the settled path does
+        t_name = t.get(name)
+        if t_name is not None and t_name < 1e-6:
+            t_name = None
         row = {
-            "step_ms": (None if t.get(name) is None
-                        else round(t[name] * 1e3, 4)),
+            "step_ms": (None if t_name is None
+                        else round(t_name * 1e3, 4)),
             "traced_comm_bytes_per_rank": {
                 k: round(v, 1) for k, v in traced[name].items()},
         }
@@ -1083,6 +1100,7 @@ def payload_zero(args) -> dict:
         "rows": rows,
         "framework_tax_zero1_vs_bare": (
             None if not t.get("bare") or not t.get("zero1")
+            or t["bare"] < 1e-6 or t["zero1"] < 1e-6  # same smoke floor
             else round(t["zero1"] / t["bare"], 4)),
     }
 
@@ -1407,6 +1425,247 @@ def payload_adapt(args) -> dict:
     }
 
 
+def payload_overlap(args) -> dict:
+    """kf-overlap A/B (ISSUE 10 gate): the bucketed ZeRO-2/3 loops over
+    a 3-rank in-process host-plane cluster with 30 ms chaos-injected
+    wire latency on every send — serial bucket loop (issue, wait,
+    compute) vs the depth-k software pipeline
+    (:func:`kungfu_tpu.parallel.zero.host_bucket_pipeline`: issue bucket
+    i+k while bucket i's optimizer math runs, the engine's bounded
+    async window running up to k collectives' wire time concurrently).
+    Final parameters must be BITWISE identical between the serial and
+    pipelined runs — the pipeline moves wall clock only.  A bare
+    ``shard_map``+``psum`` device-plane row on the same model rides
+    along as the no-framework reference (no injected latency there:
+    XLA's CPU rings share memory, so the row contextualizes framework
+    tax, not the overlap ratio).
+
+    Pure host-plane CPU (the multislice/adapt-row technique): cannot be
+    zeroed by a wedged TPU tunnel."""
+    import os
+    import time as _time
+
+    import numpy as np
+
+    os.environ["KF_NATIVE_ENGINE"] = "0"  # chaos hooks ride the py path
+    os.environ.setdefault("KF_CONFIG_LOG_LEVEL", "WARNING")
+    wire_ms = 30
+    os.environ["KF_CHAOS_SPEC"] = f"delay:ms={wire_ms},on=send"
+
+    from kungfu_tpu.comm.engine import CollectiveEngine
+    from kungfu_tpu.comm.host import HostChannel
+    from kungfu_tpu.monitor.registry import REGISTRY
+    from kungfu_tpu.parallel.zero import (host_bucket_all_gather,
+                                          host_bucket_pipeline,
+                                          host_bucket_spans)
+    from kungfu_tpu.plan import PeerID, PeerList, Strategy
+
+    n = 3
+    chunk = 12_000 if args.quick else 60_000
+    n_buckets = 4
+    widths = [chunk // n_buckets] * n_buckets
+    spans = host_bucket_spans(chunk, widths)
+    total = n * chunk
+    steps = 3 if args.quick else 5
+    lr, mu = np.float32(0.125), np.float32(0.5)  # exact binary fractions
+
+    def init_state(rank):
+        params = (np.arange(total, dtype=np.float32) % 64) / 64
+        mom = np.zeros(chunk, np.float32)
+        return params, mom
+
+    def grad_of(params, rank_unused, k):
+        # deterministic pseudo-gradient in exact binary fractions: any
+        # re-carve or ordering error breaks byte equality loudly
+        return params * np.float32(0.5) + np.float32(2.0 ** -(k + 2))
+
+    def zero2_step(engine, params, mom, k, pipelined, tag):
+        g = grad_of(params, None, k)
+        me = engine.rank
+        own = params[me * chunk:(me + 1) * chunk].copy()
+
+        def compute(b, red):
+            off, w = spans[b]
+            m = mom[off:off + w] * mu + red
+            mom[off:off + w] = m
+            own[off:off + w] -= lr * m
+            return None
+
+        host_bucket_pipeline(engine, g, widths, compute,
+                             pipelined=pipelined, name=f"{tag}r{k}")
+        full = host_bucket_all_gather(engine, own, widths,
+                                      pipelined=pipelined, name=f"{tag}g{k}")
+        return full, mom
+
+    def zero3_step(engine, own, mom, k, pipelined, tag):
+        # params live SHARDED between steps: bucketed all-gather first
+        # (the in-step parameter prefetch), then the gradient
+        # reduce-scatter pipeline updates the owned chunk
+        full = host_bucket_all_gather(engine, own, widths,
+                                      pipelined=pipelined, name=f"{tag}g{k}")
+        g = grad_of(full, None, k)
+        me = engine.rank
+        new_own = own.copy()
+
+        def compute(b, red):
+            off, w = spans[b]
+            m = mom[off:off + w] * mu + red
+            mom[off:off + w] = m
+            new_own[off:off + w] -= lr * m
+            return None
+
+        host_bucket_pipeline(engine, g, widths, compute,
+                             pipelined=pipelined, name=f"{tag}r{k}")
+        return new_own, mom
+
+    def run_world(fns, timeout=240.0):
+        import threading
+
+        outs = [None] * len(fns)
+        errs = []
+
+        def wrap(i, f):
+            try:
+                outs[i] = f()
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=wrap, args=(i, f), daemon=True)
+              for i, f in enumerate(fns)]
+        for t in ts:
+            t.start()
+        deadline = _time.monotonic() + timeout
+        for t in ts:
+            t.join(max(0.0, deadline - _time.monotonic()))
+        if errs:
+            raise errs[0]
+        if any(t.is_alive() for t in ts):
+            raise TimeoutError("overlap world hung")
+        return outs
+
+    def run_mode(stage, pipelined, base_port, tag):
+        peers = PeerList.of(*(PeerID("127.0.0.1", base_port + i)
+                              for i in range(n)))
+        chans = [HostChannel(p, bind_host="127.0.0.1") for p in peers]
+        engines = [CollectiveEngine(c, peers, Strategy.STAR) for c in chans]
+        try:
+            def one(i):
+                params, mom = init_state(i)
+                eng = engines[i]
+                if stage == 3:
+                    state = params[i * chunk:(i + 1) * chunk].copy()
+                else:
+                    state = params
+                times = []
+                for k in range(steps):
+                    t0 = _time.perf_counter()
+                    if stage == 3:
+                        state, mom = zero3_step(eng, state, mom, k,
+                                                pipelined, tag)
+                    else:
+                        state, mom = zero2_step(eng, state, mom, k,
+                                                pipelined, tag)
+                    times.append(_time.perf_counter() - t0)
+                if stage == 3:
+                    # gather once at the end for the bitwise check
+                    state = host_bucket_all_gather(
+                        eng, state, widths, pipelined=pipelined,
+                        name=f"{tag}fin")
+                assert eng.inflight() == 0, "leaked handles"
+                return times, state
+
+            outs = run_world([lambda i=i: one(i) for i in range(n)])
+            step_s = float(np.median(
+                [max(outs[i][0][k] for i in range(n))
+                 for k in range(1, steps)]))
+            finals = [o[1] for o in outs]
+            for f in finals[1:]:
+                assert f.tobytes() == finals[0].tobytes(), "ranks diverged"
+            return step_s, finals[0]
+        finally:
+            for c in chans:
+                c.close()
+
+    rows = {}
+    finals = {}
+    port = 24900
+    for stage in (2, 3):
+        for pipelined in (False, True):
+            key = f"{'pipelined' if pipelined else 'serial'}_zero{stage}"
+            step_s, fin = run_mode(stage, pipelined, port,
+                                   key.replace("_", "")[:6])
+            rows[key] = {"step_ms": round(step_s * 1e3, 2)}
+            finals[(stage, pipelined)] = fin
+            port += 10
+    bitwise = all(
+        finals[(s, True)].tobytes() == finals[(s, False)].tobytes()
+        for s in (2, 3))
+    assert bitwise, "pipelined run diverged from serial (geometry bug)"
+
+    ratio2 = rows["pipelined_zero2"]["step_ms"] / rows["serial_zero2"]["step_ms"]
+    ratio3 = rows["pipelined_zero3"]["step_ms"] / rows["serial_zero3"]["step_ms"]
+    speedup = 1.0 / max(ratio2, 1e-9)
+
+    # bare shard_map + psum reference row on the same model (device
+    # plane; no wire injection — see docstring)
+    try:
+        from kungfu_tpu.utils.jaxcompat import set_cpu_device_count
+
+        set_cpu_device_count(n)
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from kungfu_tpu.utils.jaxcompat import shard_map
+
+        mesh = Mesh(np.array(jax.devices()[:n]), ("d",))
+
+        def bare_body(p):
+            g = p * 0.5 + 0.01
+            g = jax.lax.psum(g, "d") / n
+            return p - 0.125 * g
+
+        bare = jax.jit(shard_map(bare_body, mesh=mesh, in_specs=(P(),),
+                                 out_specs=P()))
+        x = jnp.asarray(init_state(0)[0])
+        bare(x).block_until_ready()  # compile
+        t0 = _time.perf_counter()
+        for _ in range(20):
+            x = bare(x)
+        x.block_until_ready()
+        rows["bare_shardmap_psum"] = {
+            "step_ms": round((_time.perf_counter() - t0) / 20 * 1e3, 4),
+            "note": ("device-plane reference, no injected wire latency "
+                     "(XLA CPU rings are shared-memory) — framework-tax "
+                     "context, not part of the overlap ratio"),
+        }
+    except Exception as e:  # noqa: BLE001 - reference row is best-effort
+        rows["bare_shardmap_psum"] = {"error": str(e)[:200]}
+
+    eff = REGISTRY.snapshot().get("kf_overlap_efficiency", {})
+    return {
+        "metric": "overlap_pipelined_zero2_speedup_vs_serial",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "vs_baseline": round(speedup, 3),
+        "vs_baseline_meaning": ("serial bucket-loop step time over the "
+                                "depth-k pipelined step time under 30 ms "
+                                "injected wire latency (>=1.5 = gate)"),
+        "platform": "cpu-hostplane",
+        "n_devices": n,
+        "model": (f"{total} fp32 params, {n_buckets} buckets x "
+                  f"{widths[0] * 4 >> 10} KiB, momentum SGD, {wire_ms} ms "
+                  "chaos delay on every send"),
+        "rows": {
+            **rows,
+            "pipelined_vs_serial_zero2": round(ratio2, 3),
+            "pipelined_vs_serial_zero3": round(ratio3, 3),
+            "bitwise_identical_final_params": bitwise,
+            "overlap_efficiency_p50": round(float(eff.get("p50", 0.0)), 3),
+        },
+    }
+
+
 PAYLOADS = {
     "resnet": payload_resnet,
     "kernels": payload_kernels,
@@ -1415,6 +1674,7 @@ PAYLOADS = {
     "zero": payload_zero,
     "multislice": payload_multislice,
     "adapt": payload_adapt,
+    "overlap": payload_overlap,
 }
 
 
@@ -1448,6 +1708,11 @@ def main() -> None:
                    help="kf-adapt A/B: bandit strategy adaptation vs every "
                         "fixed strategy under chaos-injected link "
                         "interference (host-plane CPU; tunnel-proof)")
+    p.add_argument("--overlap", action="store_true",
+                   help="kf-overlap A/B: serial vs depth-k pipelined "
+                        "ZeRO-2/3 bucket loops under injected wire "
+                        "latency, plus the bare shard_map+psum row "
+                        "(host-plane CPU; tunnel-proof)")
     p.add_argument("--payload", choices=sorted(PAYLOADS), default=None,
                    help=argparse.SUPPRESS)  # internal: run in-process
     p.add_argument("--timeout", type=float, default=PAYLOAD_TIMEOUT_S)
@@ -1461,7 +1726,8 @@ def main() -> None:
     which = ("kernels" if args.kernels else "allreduce" if args.allreduce
              else "lm" if args.lm else "zero" if args.zero
              else "multislice" if args.multislice
-             else "adapt" if args.adapt else "resnet")
+             else "adapt" if args.adapt
+             else "overlap" if args.overlap else "resnet")
     fwd = ["--payload", which]
     for flag, val in [
         ("--batch-size", args.batch_size), ("--image-size", args.image_size),
@@ -1484,7 +1750,7 @@ def main() -> None:
     # veto measurements.
     pre_err = backend_preflight(
         cpu=args.cpu or bool(args.cpu_mesh)
-        or which in ("multislice", "adapt"))
+        or which in ("multislice", "adapt", "overlap"))
     if pre_err is None:
         out = run_guarded(fwd, timeout=args.timeout)
         if "metric" not in out and not (args.quick or args.cpu):
@@ -1539,6 +1805,8 @@ def main() -> None:
                            "multislice_cpu_mesh"),
             "adapt": ("adapt_bandit_steady_step_time_speedup_vs_best_fixed",
                       "x", "adapt_cpu_mesh"),
+            "overlap": ("overlap_pipelined_zero2_speedup_vs_serial", "x",
+                        "overlap_cpu_mesh"),
         }
         metric, unit, section = payload_info[which]
         out = {

@@ -34,6 +34,7 @@ from kungfu_tpu.analysis import (
     blockingio,
     collectives,
     envcheck,
+    handlecheck,
     jitpurity,
     lockcheck,
     pylockorder,
@@ -52,6 +53,7 @@ CHECKERS: Dict[str, object] = {
     blockingio.CHECKER: blockingio.check,
     lockcheck.CHECKER: lockcheck.check,
     retrydiscipline.CHECKER: retrydiscipline.check,
+    handlecheck.CHECKER: handlecheck.check,
     collectives.CHECKER: collectives.check,
     wirecontract.CHECKER: wirecontract.check,
     pylockorder.CHECKER: pylockorder.check,

@@ -100,6 +100,22 @@ def engine_timeout_s() -> float:
     return envs.parse_float_env(envs.ENGINE_TIMEOUT, 60.0)
 
 
+def overlap_depth_default() -> int:
+    """Bound on in-flight async collective handles per engine
+    (``KF_CONFIG_OVERLAP_DEPTH``, default 2).  Issuing past the window
+    blocks the caller until a handle completes — the backpressure that
+    keeps a depth-k software pipeline from ballooning into
+    buffer-everything.  Purely local: the window changes *when* this
+    process's collectives run, never their tags or issue order, so peers
+    may legally run different depths (and the depth is a learnable knob,
+    :class:`kungfu_tpu.policy.bandit.OverlapDepthBandit`).  Non-positive
+    values fall back to the default, like every engine env reader
+    (``engine_chunk_size``); depth 1 IS the serial window — set that to
+    disable overlap."""
+    v = envs.parse_int_env(envs.OVERLAP_DEPTH, 2)
+    return v if v > 0 else 2
+
+
 def peer_deadline_s() -> float:
     """Per-peer deadline for one collective primitive
     (``KF_CONFIG_PEER_DEADLINE`` seconds; default = the engine timeout).
@@ -170,6 +186,108 @@ def name_based_hash(name: str) -> int:
     chunks of one tensor share a strategy keyed by its name, balancing
     load across *tensors* instead of across chunks."""
     return sum(ord(c) * ord(c) for c in name)
+
+
+# -- async collective plane (kf-overlap) -----------------------------------
+#: process-wide in-flight accounting behind the ``kf_overlap_inflight``
+#: gauge: in-process multi-rank clusters (every chaos/overlap test) run
+#: several engines in one registry, so the gauge is the SUM of their
+#: windows — "returned to 0" then means no rank leaked a handle
+_inflight_lock = threading.Lock()
+_inflight_total = 0
+
+#: observed-at-wait hidden-wire fraction buckets (a ratio in [0, 1],
+#: not a latency — the default latency buckets would collapse it)
+_EFFICIENCY_BUCKETS = (0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
+
+
+def _inflight_adjust(delta: int) -> int:
+    global _inflight_total
+    with _inflight_lock:
+        _inflight_total += delta
+        total = _inflight_total
+        # set INSIDE the lock: Gauge is last-write-wins, and two
+        # concurrent completions setting 1-then-0 out of order would
+        # strand the gauge nonzero after a full drain — the exact value
+        # the demos and chaos tests assert returns to 0
+        REGISTRY.gauge("kf_overlap_inflight").set(total)
+    return total
+
+
+class CollectiveHandle:
+    """A collective in flight: issued now, settled at :meth:`wait`.
+
+    The completion contract mirrors the sync path exactly — whatever the
+    collective would have raised inline (typed
+    :class:`~kungfu_tpu.comm.faults.PeerFailureError` with the suspect
+    rank attached, an injected chaos death, a protocol error) is raised
+    at :meth:`wait` instead of hanging; the per-peer deadline machinery
+    runs inside the collective, so a handle always settles in bounded
+    time even when a peer silently dies mid-flight.
+
+    Lifetime discipline (enforced by the ``handle-discipline`` kflint
+    rule): every handle is waited on every control-flow path, never
+    dropped, and never held across a membership change —
+    :meth:`CollectiveEngine.drain_async` fences the window at
+    resize/shrink boundaries."""
+
+    __slots__ = ("tag", "op", "nbytes", "_event", "_result", "_error",
+                 "_t_issue", "_t_complete", "_observed")
+
+    def __init__(self, tag: str, op: str, nbytes: int):
+        self.tag = tag
+        self.op = op
+        self.nbytes = nbytes
+        self._event = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+        self._t_issue = time.perf_counter()
+        self._t_complete: Optional[float] = None
+        self._observed = False
+
+    # -- issuer side ------------------------------------------------------
+    def _settle(self, result=None, error: Optional[BaseException] = None):
+        self._t_complete = time.perf_counter()
+        self._result = result
+        self._error = error
+        self._event.set()
+
+    # -- owner side -------------------------------------------------------
+    def done(self) -> bool:
+        """True once the collective settled (successfully or not)."""
+        return self._event.is_set()
+
+    def error(self) -> Optional[BaseException]:
+        """The settled failure, or None (not yet settled / succeeded)."""
+        return self._error
+
+    def wait(self, timeout: Optional[float] = None):
+        """Block until the collective settles; return its result or
+        re-raise its typed failure.  Observes the hidden-wire fraction
+        into ``kf_overlap_efficiency`` on first call: 1.0 = the wire
+        time was fully hidden under the caller's compute."""
+        t_wait = time.perf_counter()
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"handle {self.tag!r} not complete after {timeout}s "
+                "(the collective's own deadline machinery should settle "
+                "it; is KF_CONFIG_PEER_DEADLINE larger than this wait?)")
+        if self._error is not None:
+            # no efficiency observation for a failed collective: a
+            # doomed handle waited on late would record hidden≈1.0 —
+            # "wire fully hidden" for a transfer that delivered nothing
+            # — skewing the histogram toward 1.0 during fault storms,
+            # exactly when operators read it
+            raise self._error
+        if not self._observed:
+            self._observed = True
+            wire = (self._t_complete or t_wait) - self._t_issue
+            hidden = 1.0 if wire <= 0 else max(
+                0.0, min(1.0, (t_wait - self._t_issue) / wire))
+            REGISTRY.histogram(
+                "kf_overlap_efficiency", buckets=_EFFICIENCY_BUCKETS
+            ).observe(hidden)
+        return self._result
 
 
 class CollectiveEngine:
@@ -259,6 +377,15 @@ class CollectiveEngine:
         # arm that has not carried real traffic yet (mark_swap resets)
         self._colls_total = 0
         self._colls_at_swap = 0
+        # kf-overlap: the bounded in-flight window for async handles.
+        # A plain count + condition (not a Semaphore) so the depth can
+        # be retuned live (set_overlap_depth) without rebuilding
+        self._overlap_depth = overlap_depth_default()
+        self._overlap_cond = threading.Condition()
+        self._inflight_handles: set = set()
+        #: ``fn(nbytes, depth, seconds)`` per completed async collective
+        #: — the kf-adapt latency feed (None = disabled)
+        self._latency_hook = None
 
     # -- public collectives ----------------------------------------------
     def all_reduce(
@@ -438,6 +565,155 @@ class CollectiveEngine:
         if op == "mean":
             acc = acc / n
         return acc
+
+    # -- async collectives (kf-overlap) ------------------------------------
+    def all_reduce_async(self, x: np.ndarray, op: str = "sum",
+                         name: str = "", record: bool = True
+                         ) -> CollectiveHandle:
+        """Issue a chunked graph allreduce and return immediately with a
+        :class:`CollectiveHandle`; the result (and any typed failure)
+        surfaces at ``handle.wait()``.  The wire protocol is identical
+        to :meth:`all_reduce` — the tag is fixed HERE, in issue order on
+        the calling thread, so peers mixing sync and async issue styles
+        still rendezvous."""
+        tag = name or f"ar{self._next_seq()}"
+        nbytes = np.asarray(x).nbytes
+        return self._issue_async(
+            "all_reduce", tag, nbytes,
+            lambda: self.all_reduce(x, op=op, name=tag, record=record))
+
+    def reduce_scatter_async(self, x: np.ndarray, op: str = "sum",
+                             name: str = "") -> CollectiveHandle:
+        """Async :meth:`reduce_scatter` — the ZeRO-2/3 gradient-bucket
+        pipeline primitive (``parallel/zero.py::host_bucket_pipeline``
+        issues bucket i+1 here while bucket i's optimizer math runs)."""
+        base = name or f"rs{self._next_seq()}"
+        nbytes = np.asarray(x).nbytes
+        return self._issue_async(
+            "reduce_scatter", base, nbytes,
+            lambda: self.reduce_scatter(x, op=op, name=base))
+
+    def all_gather_async(self, x: np.ndarray, name: str = ""
+                         ) -> CollectiveHandle:
+        """Async :meth:`all_gather` — the ZeRO-3 parameter-bucket
+        prefetch primitive."""
+        base = name or f"ag{self._next_seq()}"
+        nbytes = np.asarray(x).nbytes
+        return self._issue_async(
+            "all_gather", base, nbytes, lambda: self.all_gather(x, name=base))
+
+    def _issue_async(self, op: str, tag: str, nbytes: int,
+                     fn) -> CollectiveHandle:
+        """Admit one collective into the bounded in-flight window and
+        run it on the async pool.  Blocks while ``overlap_depth`` handles
+        are already in flight (completion — success OR typed failure —
+        releases a slot; a slot is never released by ``wait()``, so an
+        unwaited handle cannot deadlock the window)."""
+        pool = self.async_pool()
+        with self._overlap_cond:
+            while len(self._inflight_handles) >= self._overlap_depth:
+                self._overlap_cond.wait()
+            handle = CollectiveHandle(tag, op, nbytes)
+            self._inflight_handles.add(handle)
+            depth_now = len(self._inflight_handles)
+        total = _inflight_adjust(+1)
+        if timeline.enabled():
+            timeline.event("overlap", "issue", rank=self._timeline_rank,
+                           op=op, tag=tag, nbytes=nbytes,
+                           inflight=depth_now, inflight_total=total)
+
+        def run():
+            err = None
+            t0 = time.perf_counter()
+            try:
+                out = fn()
+            except BaseException as e:  # noqa: BLE001 - settled at wait()
+                err = e
+                out = None
+            dt = time.perf_counter() - t0
+            # one critical section for the whole completion: gauge
+            # decrement, window removal, settle, notify.  Ordering races
+            # on either side otherwise — a drainer waking on the empty
+            # set must find the handle already settled (the chaos tests
+            # read hb.error() right after a drain), and a waiter woken
+            # by _settle must find the gauge already decremented (the
+            # demos assert it reads 0 the moment every wait returned).
+            # Lock nesting is cond → _inflight_lock only, never the
+            # reverse — no cycle.
+            with self._overlap_cond:
+                total_now = _inflight_adjust(-1)
+                self._inflight_handles.discard(handle)
+                left = len(self._inflight_handles)
+                handle._settle(out, err)
+                self._overlap_cond.notify_all()
+            if timeline.enabled():
+                timeline.event(
+                    "overlap", "complete", rank=self._timeline_rank,
+                    op=op, tag=tag, nbytes=nbytes, inflight=left,
+                    inflight_total=total_now, dur=round(dt, 6),
+                    error=type(err).__name__ if err is not None else None)
+            hook = self._latency_hook
+            if hook is not None and err is None:
+                try:
+                    hook(nbytes, self._overlap_depth, dt)
+                except Exception:  # noqa: BLE001 - observability only
+                    _log.exception("overlap latency hook failed")
+
+        pool.submit(run)
+        return handle
+
+    @property
+    def overlap_depth(self) -> int:
+        """The in-flight window bound currently in force."""
+        return self._overlap_depth
+
+    def set_overlap_depth(self, depth: int) -> None:
+        """Retune the in-flight window live.  Safe mid-flight: shrinking
+        only delays FUTURE issues (already-issued handles finish), and
+        growth wakes blocked issuers immediately.  Local backpressure
+        only — never part of the wire protocol, so no fence is needed."""
+        if depth < 1:
+            raise ValueError(f"overlap depth must be >= 1, got {depth}")
+        with self._overlap_cond:
+            self._overlap_depth = int(depth)
+            self._overlap_cond.notify_all()
+
+    def inflight(self) -> int:
+        """Issued-and-unsettled handle count on THIS engine."""
+        with self._overlap_cond:
+            return len(self._inflight_handles)
+
+    def drain_async(self, timeout: Optional[float] = None) -> int:
+        """Block until every in-flight handle settles; returns how many
+        were drained.  THE membership fence: a handle may never cross a
+        resize/shrink (its tags and peer set belong to the old epoch),
+        so ``Peer._propose`` and the shrink ladder drain here first.
+        Settling is deadline-bounded by construction (every send/recv
+        inside a collective runs under the per-peer deadline), so a
+        bare drain cannot hang on a dead peer — it observes the typed
+        failure and moves on; the failure still belongs to the handle's
+        owner and re-raises at that handle's ``wait()``."""
+        deadline = (time.monotonic() + timeout) if timeout is not None else None
+        with self._overlap_cond:
+            drained = len(self._inflight_handles)
+            while self._inflight_handles:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"{len(self._inflight_handles)} async handle(s) "
+                            f"still in flight after {timeout}s drain")
+                self._overlap_cond.wait(remaining)
+        return drained
+
+    def set_latency_hook(self, fn) -> None:
+        """Install ``fn(nbytes, depth, seconds)`` to receive each
+        completed async collective's measured wall time — the kf-adapt
+        feed that makes the overlap depth a learnable arm
+        (:class:`kungfu_tpu.policy.bandit.OverlapDepthBandit`).  Pass
+        ``None`` to disable."""
+        self._latency_hook = fn
 
     # -- hierarchical (host-partitioned) collectives ----------------------
     # Local = peers sharing this peer's host; the local root is the
@@ -923,6 +1199,11 @@ class CollectiveEngine:
         """Swap the strategy set (reference ``SetGlobalStrategy`` +
         ``adaptation.go:8-28``; caller is responsible for the barrier +
         consensus fencing around the swap)."""
+        # kf-overlap: a handle in flight walks the OLD graphs — swapping
+        # them under it would tear the wire protocol mid-collective.
+        # Free when the window is empty (the fenced-swap drivers barrier
+        # before calling here, so it always is in practice).
+        self.drain_async()
         self.strategy = strategy
         self._graphs = build_strategy_graphs(strategy, self.peers)
         self._cross_graphs = build_cross_strategy_graphs(strategy, self.peers)

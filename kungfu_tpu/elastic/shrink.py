@@ -217,6 +217,18 @@ def shrink_to_survivors(peer, dead_ranks: Sequence[int]) -> bool:
     me = workers.rank(peer.config.self_id)
     if me is None or me in dead:
         raise ValueError("shrink_to_survivors must run on a surviving member")
+    # kf-overlap fence, BEFORE exclusion consensus: every issued async
+    # handle must settle first — handles toward the dead complete with
+    # their typed PeerFailureError via the per-peer deadline (bounded,
+    # cannot hang), and a handle left in flight would otherwise tangle
+    # its old-epoch recvs with the consensus traffic and the rebuilt
+    # engine.  _propose drains again, but by then the consensus has run;
+    # the window must be empty before the first shrink collective.
+    eng = getattr(peer, "_engine", None)
+    if eng is not None:
+        drained = eng.drain_async()
+        if drained:
+            timeline.event("shrink", "drain", rank=me, drained=drained)
     topo = _peer_slice_topology(peer)
     if topo is not None and topo.num_slices <= 1:
         # a job shrunk down to ONE surviving slice has its failure grain

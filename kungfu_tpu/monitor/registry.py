@@ -195,6 +195,13 @@ METRIC_HELP: Dict[str, str] = {
     "kf_opt_state_bytes":
         "per-rank optimizer-state footprint (worst device; ZeRO shards "
         "count one chunk, replicated state counts fully)",
+    "kf_overlap_inflight":
+        "async collective handles issued and not yet complete "
+        "(kf-overlap in-flight window; 0 = fully drained)",
+    "kf_overlap_efficiency":
+        "per-handle hidden-wire fraction observed at wait(): 1.0 = the "
+        "collective finished before the caller needed it (fully hidden), "
+        "0.0 = the caller blocked for the whole wire time",
     "kf_net_egress_bytes":
         "aggregate egress bytes (mirrored from NetMonitor)",
     "kf_net_ingress_bytes":

@@ -78,6 +78,12 @@ EVENT_KINDS = frozenset({
     "swap",        # consensus-fenced strategy/schedule swap (kf-adapt:
                    # monitor/adapt_device.py — host arm or device
                    # per-bucket schedule installed in lockstep)
+    "overlap",     # async collective handle lifecycle (kf-overlap,
+                   # comm/engine.py: "issue" / "complete" marks carrying
+                   # tag, nbytes, and the in-flight queue depth).  A hot
+                   # kind: recorded only when tracing is on — the
+                   # always-on surfaces are the kf_overlap_inflight
+                   # gauge and the kf_overlap_efficiency histogram
     "step",        # training-step mark
     "mark",        # generic one-shot annotation
 })

@@ -168,6 +168,45 @@ _PSUM_FOLD = {"sum": lax.psum, "min": lax.pmin, "max": lax.pmax}
 # XLA independent program points to overlap with neighboring compute — the
 # same reason `two_stage` exists (docstring above).
 
+def _dep_fence(pair):
+    """Value-identity scheduling fence: ``(a, b) -> (a, b)`` bitwise
+    unchanged, but the compiler may not start computing the outputs
+    before BOTH inputs exist.  This is how the bucket loops express a
+    depth-k window *inside the traced program*: fencing bucket i's
+    operand on bucket i-k's result bounds how many bucket collectives
+    XLA can hold in flight (and therefore how much gathered live range
+    it can accumulate) without changing a single output bit.
+
+    ``lax.optimization_barrier`` has no differentiation rule on current
+    jax, so the fence is a custom_vjp identity whose backward applies
+    the same barrier to the cotangents — the ZeRO-3 gradient path (an
+    all-gather whose transpose IS the reduce-scatter) gets the same
+    window on the backward collectives for free.  Falls back to a plain
+    identity where the primitive is unavailable (older jax): the values
+    are identical either way, only the scheduling hint is lost."""
+    bar = getattr(lax, "optimization_barrier", None)
+    if bar is None:
+        return pair
+    return _dep_fence_vjp(pair)
+
+
+@jax.custom_vjp
+def _dep_fence_vjp(pair):
+    return lax.optimization_barrier(pair)
+
+
+def _dep_fence_fwd(pair):
+    return lax.optimization_barrier(pair), None
+
+
+def _dep_fence_bwd(_, ct):
+    return (lax.optimization_barrier(ct),)
+
+
+if hasattr(lax, "optimization_barrier"):
+    _dep_fence_vjp.defvjp(_dep_fence_fwd, _dep_fence_bwd)
+
+
 def bucket_widths(chunk: int, n: int, itemsize: int,
                   bucket_bytes: int) -> List[int]:
     """Per-bucket column widths partitioning ``chunk`` so each bucket's
@@ -186,7 +225,8 @@ def bucket_widths(chunk: int, n: int, itemsize: int,
 
 
 def reduce_scatter_flat(g, axes: Sequence[str], chunk: int,
-                        widths: Optional[Sequence[int]] = None):
+                        widths: Optional[Sequence[int]] = None,
+                        serial: bool = False):
     """Bucketed reduce-scatter of a flat mesh-major buffer.
 
     ``g``: per-device ``[n*chunk]`` (the full fused gradient, VMA-varying
@@ -194,7 +234,19 @@ def reduce_scatter_flat(g, axes: Sequence[str], chunk: int,
     where the device's flat index is mesh-major over ``axes`` (outer axis
     first — the same order :mod:`kungfu_tpu.parallel.zero` scatters in).
     ``axes`` must already be filtered to the non-trivial mesh axes; empty
-    ``axes`` means a 1-device world and the buffer IS the chunk."""
+    ``axes`` means a 1-device world and the buffer IS the chunk.
+
+    The default (pipelined) form leaves every bucket's collective
+    data-independent, so XLA may overlap them with each other and with
+    neighboring compute.  ``serial=True`` is the reference shape — each
+    bucket's operand is fenced on the previous bucket's result, forcing
+    one collective in flight at a time.  The two forms are **bitwise
+    identical** for every bucket count, including the 1-bucket and
+    padded-tail degenerate cases (pinned in ``tests/test_schedules.py``):
+    the fence is a value identity, and each bucket's reduction order is
+    fixed by its own collective either way.  ``serial`` exists as the
+    regression control the overlap bench diffs against — never as a
+    production path."""
     if not axes:
         return g[:chunk]
     n = 1
@@ -206,6 +258,8 @@ def reduce_scatter_flat(g, axes: Sequence[str], chunk: int,
     off = 0
     for w in widths:
         slab = g2[:, off:off + w].reshape(-1)
+        if serial and parts:
+            slab, _ = _dep_fence((slab, parts[-1]))
         for ax in axes:
             slab = lax.psum_scatter(slab, ax, scatter_dimension=0, tiled=True)
         parts.append(slab)
@@ -215,14 +269,25 @@ def reduce_scatter_flat(g, axes: Sequence[str], chunk: int,
 
 
 def all_gather_flat(shard, axes: Sequence[str],
-                    widths: Optional[Sequence[int]] = None):
+                    widths: Optional[Sequence[int]] = None,
+                    prefetch: bool = False):
     """Bucketed all-gather: inverse layout of :func:`reduce_scatter_flat`.
 
     ``shard``: this device's ``[chunk]`` slice; returns the mesh-major
     ``[n*chunk]`` full buffer on every device.  Differentiable — the
     transpose of each bucket's tiled all-gather is the matching tiled
     psum-scatter, so ``grad(loss(all_gather_flat(p)))`` arrives already
-    reduce-scattered (the ZeRO-3 gradient path costs no extra collective)."""
+    reduce-scattered (the ZeRO-3 gradient path costs no extra collective).
+
+    ``prefetch=True`` double-buffers the bucket gathers: bucket i's
+    operand is fenced on bucket i-2's gathered result, so at most two
+    gathers are in flight — the next bucket prefetches while the current
+    one retires, but XLA cannot widen the window to all B buckets and
+    hold B gathered slabs (n× their shard size each) live at once.  The
+    fence is a value identity (bitwise-pinned against ``prefetch=False``)
+    and its custom backward applies the same window to the transposed
+    reduce-scatters, so the ZeRO-3 gradient path is double-buffered in
+    both directions."""
     if not axes:
         return shard
     n = 1
@@ -234,6 +299,8 @@ def all_gather_flat(shard, axes: Sequence[str],
     off = 0
     for w in widths:
         piece = shard[off:off + w]
+        if prefetch and len(slabs) >= 2:
+            piece, _ = _dep_fence((piece, slabs[-2]))
         for ax in reversed(axes):
             piece = lax.all_gather(piece, ax, axis=0, tiled=True)
         slabs.append(piece.reshape(n, w))

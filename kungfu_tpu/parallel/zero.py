@@ -706,8 +706,15 @@ class ZeroStep:
                 # only ever full in-flight; the transpose of each tiled
                 # all-gather is the matching tiled psum-scatter, so the
                 # backward pass emits the bucketed gradient
-                # reduce-scatter with no extra collective written here
-                full = all_gather_flat(ps, geo.scatter_axes, geo.widths)
+                # reduce-scatter with no extra collective written here.
+                # prefetch=True double-buffers the bucket gathers (and,
+                # via the fence's custom vjp, the transposed backward
+                # reduce-scatters): the next bucket's wire time hides
+                # under the current one's retirement without letting
+                # XLA hold every gathered slab live at once — values
+                # bitwise identical (tests/test_schedules.py pins it)
+                full = all_gather_flat(ps, geo.scatter_axes, geo.widths,
+                                       prefetch=True)
                 return loss_fn(defuse(full[:total], geo.spec), batch)
 
             loss, g_shard = jax.value_and_grad(loss_of)(p_loc)
@@ -777,6 +784,141 @@ def zero_comm_bytes(total_params: int, n: int, stage: int,
         "total_bytes": grad + rs,
         "padded_params": padded,
     }
+
+
+# -- host-plane bucket pipelining (kf-overlap) -----------------------------
+#
+# The multi-process data path (CPU test clusters, between-mesh-epoch
+# phases) runs the ZeRO bucket loops over the host engine, where
+# communication is real wall time the Python loop used to serialize:
+# issue bucket i, WAIT, do bucket i's optimizer math, issue bucket i+1 —
+# wire and compute adding instead of overlapping.  The helpers below are
+# the depth-k software pipeline over the engine's async handles: issue
+# bucket i+k while bucket i's math runs.  Bucket order, tags, and
+# per-bucket arithmetic are IDENTICAL to the serial loop (one geometry,
+# PR 7's invariant), so serial and pipelined runs produce bitwise-equal
+# results — only the wall clock moves (measured: bench.py --overlap).
+
+
+def host_bucket_spans(chunk: int, widths) -> list:
+    """``[(offset, width)]`` bucket tiling of one rank's chunk — shared
+    by the serial and pipelined loops so their geometry cannot drift."""
+    spans = []
+    off = 0
+    for w in widths:
+        spans.append((off, int(w)))
+        off += int(w)
+    if off != chunk:
+        raise ValueError(f"widths {list(widths)} do not tile chunk {chunk}")
+    return spans
+
+
+def host_bucket_pipeline(engine, flat, widths, compute, *, op: str = "sum",
+                         pipelined: bool = True,
+                         depth: Optional[int] = None,
+                         name: str = "zp") -> list:
+    """Bucketed host-plane reduce-scatter with a depth-k software
+    pipeline: ``flat`` is this rank's full mesh-major ``[n*chunk]``
+    buffer (the fused gradient), bucket b's collective operand is the
+    ``[n, width_b]`` column slab — the exact device-plane
+    :func:`~kungfu_tpu.ops.schedules.reduce_scatter_flat` geometry, so
+    concatenating the per-bucket results reproduces this rank's
+    contiguous chunk.  ``compute(i, reduced)`` runs each bucket's local
+    math (optimizer update on the owned slice) and its results are
+    returned in bucket order.
+
+    ``pipelined=True`` issues bucket ``i+depth``'s reduce-scatter
+    *before* running bucket ``i``'s compute, so wire time hides under
+    math (and under other buckets' wire time — the engine's bounded
+    window runs up to ``depth`` collectives concurrently).  The serial
+    form is the reference loop: issue, wait, compute, repeat.  Tags are
+    explicit and identical in both forms, so the two are wire-compatible
+    and bitwise-equal in results."""
+    n = len(engine.peers)
+    if len(flat) % n:
+        raise ValueError(f"flat buffer ({len(flat)}) must tile {n} ranks")
+    chunk = len(flat) // n
+    g2 = np.asarray(flat).reshape(n, chunk)
+    spans = host_bucket_spans(chunk, widths)
+
+    def slab(i):
+        off, w = spans[i]
+        return np.ascontiguousarray(g2[:, off:off + w]).reshape(-1)
+
+    if not pipelined:
+        return [compute(i, engine.reduce_scatter(
+                    slab(i), op=op, name=f"{name}.b{i}"))
+                for i in range(len(spans))]
+
+    if depth is None:
+        depth = engine.overlap_depth
+    if depth < 1:
+        # same guard as engine.set_overlap_depth: an empty prefill would
+        # otherwise surface as a bare IndexError on the first popleft
+        raise ValueError(f"pipeline depth must be >= 1, got {depth}")
+    from collections import deque
+
+    handles = deque(
+        engine.reduce_scatter_async(slab(i), op=op, name=f"{name}.b{i}")
+        for i in range(min(depth, len(spans))))
+    outs = []
+    for i in range(len(spans)):
+        reduced = handles.popleft().wait()
+        nxt = i + depth
+        if nxt < len(spans):
+            # issue BEFORE compute: bucket nxt's wire time runs under
+            # bucket i's optimizer math — the pipeline's whole point
+            handles.append(engine.reduce_scatter_async(
+                slab(nxt), op=op, name=f"{name}.b{nxt}"))
+        outs.append(compute(i, reduced))
+    return outs
+
+
+def host_bucket_all_gather(engine, shard, widths, *, pipelined: bool = True,
+                           depth: Optional[int] = None,
+                           name: str = "zg"):
+    """Bucketed host-plane all-gather of this rank's ``[chunk]`` shard
+    back to the mesh-major ``[n*chunk]`` full buffer — the ZeRO-3
+    parameter path's host-plane analog of
+    :func:`~kungfu_tpu.ops.schedules.all_gather_flat`.  Pipelined form
+    keeps up to ``depth`` bucket gathers in flight; results are
+    assembled in bucket order either way (bitwise-equal)."""
+    n = len(engine.peers)
+    chunk = len(shard)
+    spans = host_bucket_spans(chunk, widths)
+    shard = np.asarray(shard)
+
+    def assemble(pieces):
+        full = np.empty((n, chunk), shard.dtype)
+        for (off, w), piece in zip(spans, pieces):
+            full[:, off:off + w] = piece.reshape(n, w)
+        return full.reshape(-1)
+
+    if not pipelined:
+        return assemble([
+            engine.all_gather(shard[off:off + w], name=f"{name}.b{i}")
+            for i, (off, w) in enumerate(spans)])
+
+    if depth is None:
+        depth = engine.overlap_depth
+    if depth < 1:
+        raise ValueError(f"pipeline depth must be >= 1, got {depth}")
+    from collections import deque
+
+    handles = deque(
+        engine.all_gather_async(shard[spans[i][0]:spans[i][0] + spans[i][1]],
+                                name=f"{name}.b{i}")
+        for i in range(min(depth, len(spans))))
+    pieces = []
+    for i in range(len(spans)):
+        got = handles.popleft().wait()
+        nxt = i + depth
+        if nxt < len(spans):
+            off, w = spans[nxt]
+            handles.append(engine.all_gather_async(
+                shard[off:off + w], name=f"{name}.b{nxt}"))
+        pieces.append(got)
+    return assemble(pieces)
 
 
 # -- generalized (stage-agnostic) elastic state movement -------------------

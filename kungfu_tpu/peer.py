@@ -642,6 +642,16 @@ class Peer:
     def _propose(self, new_cluster: Cluster, version: int) -> bool:
         """Apply an agreed membership change (reference ``peer.go:177-225``):
         notify runners, bump version, detach if not in the new worker list."""
+        # kf-overlap fence: an async collective handle may never cross a
+        # membership change (its tags and peer set belong to the old
+        # epoch; the post-resize engine rebuild would strand its recvs).
+        # Settling is deadline-bounded, so this cannot hang on a dead
+        # peer — a doomed handle completes with its typed failure, which
+        # still re-raises at that handle's own wait().  Outside the lock:
+        # the draining collectives' completion path must not need it.
+        eng = self._engine
+        if eng is not None:
+            eng.drain_async()
         with self._lock:
             if new_cluster.workers == self.cluster.workers:
                 return False

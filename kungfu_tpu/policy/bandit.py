@@ -183,6 +183,64 @@ class ScheduleTable:
                 for b, t in enumerate(self.tables)}
 
 
+class OverlapDepthBandit:
+    """UCB arms over the engine's async in-flight window depth
+    (kf-overlap): the measured reward is the wall time of one bucketed
+    pipeline run (``parallel/zero.py::host_bucket_pipeline``) at the
+    active depth, fed via :meth:`observe`; every ``check_every``
+    observations the table re-selects and installs the winner with
+    :meth:`~kungfu_tpu.comm.engine.CollectiveEngine.set_overlap_depth`.
+
+    Unlike the strategy arms this needs **no fence and no consensus**:
+    the window is local backpressure — tags and issue order never
+    change with it — so each rank may legally learn its own depth
+    (a straggler host with slow NICs wants a deeper window than its
+    peers; forcing agreement would deny exactly that).  The per-bucket
+    latencies behind the pipeline measurement arrive through the
+    engine's kf-adapt latency hook (``engine.set_latency_hook``), the
+    same feed shape the device bandit drinks from."""
+
+    def __init__(self, engine, depths: Sequence[int] = (1, 2, 4),
+                 check_every: int = 3, c: float = DEFAULT_EXPLORE_C,
+                 min_pulls: int = 1, decay: float = 1.0):
+        if not depths or any(d < 1 for d in depths):
+            raise ValueError(f"depths must be positive, got {depths}")
+        self.stats = ArmStats([str(d) for d in depths], c=c,
+                              min_pulls=min_pulls, decay=decay)
+        self.check_every = max(1, int(check_every))
+        self._engine = engine
+        self.swaps = 0
+        self._n = 0
+        # start on the table's first arm so exploration order is the
+        # declaration order (determinism contract of ArmStats)
+        self.active = self.stats.arms[0]
+        engine.set_overlap_depth(int(self.active))
+
+    def observe(self, pipeline_seconds: float) -> bool:
+        """Fold one pipeline run's wall time into the active depth's
+        arm; True when a new depth was just installed."""
+        self.stats.observe(self.active, pipeline_seconds)
+        self._n += 1
+        if self._n % self.check_every:
+            return False
+        pick = self.stats.select()
+        if pick == self.active:
+            return False
+        self.active = pick
+        self._engine.set_overlap_depth(int(pick))
+        self.swaps += 1
+        return True
+
+    def reset(self) -> None:
+        """Re-explore (post-resize: a 4-rank depth winner says nothing
+        about the 2-rank wire regime) — same contract as the strategy
+        tables."""
+        self.stats.reset()
+        self._n = 0
+        self.active = self.stats.arms[0]
+        self._engine.set_overlap_depth(int(self.active))
+
+
 class CollectiveBanditPolicy(BasePolicy):
     """Policy-runner wiring for the bandit drivers: runs the host-plane
     (and optionally device-plane) bandit after every step, feeding it the

@@ -86,6 +86,15 @@ Tuning envs (read anywhere, any time):
 ``KF_CONFIG_P2P_RESPONDERS``       p2p blob responder pool size override;
                                    default scales with peer count via
                                    host_pool_size (store/p2p.py)
+``KF_CONFIG_OVERLAP_DEPTH``        bound on in-flight async collective
+                                   handles per engine (the kf-overlap
+                                   window), default 2; issuing past it
+                                   blocks until one completes.  Local
+                                   backpressure only — tags and issue
+                                   order are unchanged, so peers may
+                                   legally run different depths
+                                   (comm/engine.py; learnable via
+                                   policy.bandit.OverlapDepthBandit)
 ``KF_CONFIG_HOST_POOL_MAX``        cap on the load-scaled host-plane
                                    responder/sender pools, default 16
                                    (wins over per-pool floors); current
@@ -225,6 +234,7 @@ ENGINE_TIMEOUT = "KF_CONFIG_ENGINE_TIMEOUT"
 PEER_DEADLINE = "KF_CONFIG_PEER_DEADLINE"
 HOST_POOL_MAX = "KF_CONFIG_HOST_POOL_MAX"
 P2P_RESPONDERS = "KF_CONFIG_P2P_RESPONDERS"
+OVERLAP_DEPTH = "KF_CONFIG_OVERLAP_DEPTH"
 
 # observability envs (read by kungfu_tpu/monitor/timeline.py, which
 # defines mirror constants next to its reader code; registered here so

@@ -4,7 +4,7 @@
 #
 #   scripts/check.sh
 #
-# 1. kflint        — all thirteen project-invariant checkers, including
+# 1. kflint        — all fourteen project-invariant checkers, including
 #                    the kf-verify interprocedural rules and the
 #                    kf-shard axis-environment rules (docs/lint.md),
 #                    over kungfu_tpu/, scripts/, benchmarks/, examples/,
@@ -12,10 +12,12 @@
 #                    in tests/lint_baseline.json are suppressed (legacy
 #                    debt being ratcheted down); anything NOT in the
 #                    baseline fails the gate.
-# 1b. kf-shard     — shard-axis / shard-spec / recompile-hazard rerun
-#                    WITHOUT the baseline: the sharding rules gate with
-#                    an empty baseline (a mesh-axis typo or resize
-#                    hazard can never land as "legacy debt").
+# 1b. kf-shard +   — shard-axis / shard-spec / recompile-hazard /
+#     handles        handle-discipline rerun WITHOUT the baseline: the
+#                    sharding rules and the async-handle lifetime rule
+#                    gate with an empty baseline (a mesh-axis typo, a
+#                    resize hazard, or a leaked in-flight collective
+#                    can never land as "legacy debt").
 # 2. kftrace       — flight-recorder dump schema self-check (recorder
 #                    and reader must agree byte-for-byte, docs/tracing.md)
 # 3. kftop         — live-plane /cluster schema self-check (push wire
@@ -42,10 +44,11 @@ if ! python3 scripts/kflint "${KFLINT_ARGS[@]}"; then
     fail=1
 fi
 
-echo "== kf-shard empty-baseline gate (shard-axis, shard-spec, recompile-hazard)"
-# no --baseline on purpose: sharding/resize hazards never ratchet
+echo "== empty-baseline gate (shard-axis, shard-spec, recompile-hazard, handle-discipline)"
+# no --baseline on purpose: sharding/resize hazards and leaked async
+# collective handles never ratchet
 if ! python3 scripts/kflint --checker shard-axis --checker shard-spec \
-        --checker recompile-hazard; then
+        --checker recompile-hazard --checker handle-discipline; then
     fail=1
 fi
 
@@ -88,6 +91,20 @@ if ! timeout -k 10 150 python3 examples/adapt_interference.py \
         || ! grep -q "adapt-demo: swap fired" /tmp/_kf_adapt_demo.log; then
     echo "ERROR: adapt demo did not fire the fenced swap"
     tail -40 /tmp/_kf_adapt_demo.log || true
+    fail=1
+fi
+
+echo "== overlap-demo (bucketed communication/computation overlap measured)"
+# kf-overlap end to end: chaos-injected wire latency, serial vs depth-k
+# pipelined ZeRO-2 bucket loop — asserts measured overlap > 0,
+# bitwise-identical final params, and the in-flight gauge back at 0
+# (docs/overlap.md).  Bounded: a wedged window must fail the gate.
+rm -f /tmp/_kf_overlap_demo.log
+if ! timeout -k 10 150 python3 examples/overlap_pipeline.py \
+        > /tmp/_kf_overlap_demo.log 2>&1 \
+        || ! grep -q "overlap-demo: overlap" /tmp/_kf_overlap_demo.log; then
+    echo "ERROR: overlap demo did not measure positive overlap"
+    tail -40 /tmp/_kf_overlap_demo.log || true
     fail=1
 fi
 

@@ -1,0 +1,51 @@
+"""handle-discipline fixture: every shape the rule must catch."""
+import numpy as np
+
+
+def dropped(engine, x):
+    engine.all_reduce_async(x)          # line 6: handle dropped
+    return x
+
+
+def never_waited(engine, x):
+    h = engine.reduce_scatter_async(x)  # line 11: never waited
+    total = np.sum(x)
+    return total
+
+
+def early_return_leak(engine, x, flag):
+    h = engine.all_reduce_async(x)      # line 17: not waited on all paths
+    if flag:
+        return None                     # leaks h
+    return h.wait()
+
+
+def one_sided_branch(engine, x, flag):
+    h = engine.all_gather_async(x)      # line 24: not waited on all paths
+    if flag:
+        out = h.wait()
+    else:
+        out = x                         # this path leaks h
+    return out
+
+
+def held_across_resize(engine, peer, state, schedule, params, x):
+    h = engine.all_reduce_async(x)
+    state, params, stop = elastic_step(  # line 34: fence while in flight
+        peer, state, schedule, params)
+    out = h.wait()
+    return out, state, params, stop
+
+
+def held_across_shrink(engine, peer, x):
+    h = engine.reduce_scatter_async(x)
+    shrink_to_survivors(peer, [2])       # line 42: fence while in flight
+    return h.wait()
+
+
+def elastic_step(peer, state, schedule, params):
+    return state, params, False
+
+
+def shrink_to_survivors(peer, dead):
+    return True

@@ -1,0 +1,81 @@
+"""handle-discipline fixture: compliant shapes that must NOT flag."""
+
+
+def straight_line(engine, x):
+    h = engine.all_reduce_async(x)
+    return h.wait()
+
+
+def both_branches(engine, x, flag):
+    h = engine.reduce_scatter_async(x)
+    if flag:
+        out = h.wait()
+    else:
+        out = h.wait(timeout=5.0)
+    return out
+
+
+def try_finally(engine, x):
+    h = engine.all_gather_async(x)
+    try:
+        prepare(x)
+    finally:
+        out = h.wait()
+    return out
+
+
+def escapes_by_return(engine, x):
+    # ownership transferred to the caller — their discipline now
+    return engine.all_reduce_async(x)
+
+
+def escapes_into_collection(engine, xs, handles):
+    for x in xs:
+        handles.append(engine.reduce_scatter_async(x))
+    return handles
+
+
+def escapes_to_helper(engine, x):
+    h = engine.all_gather_async(x)
+    consume(h)
+    return None
+
+
+def wait_then_resize(engine, peer, x):
+    h = engine.all_reduce_async(x)
+    out = h.wait()
+    peer.resize_cluster(2)  # fence AFTER the settle: fine
+    return out
+
+
+def pipelined_window(engine, xs):
+    # the canonical depth-k pipeline: issue nested in an expression
+    # flows into the deque — not a tracked bare handle
+    from collections import deque
+
+    handles = deque(engine.reduce_scatter_async(x) for x in xs[:2])
+    outs = []
+    for i, x in enumerate(xs):
+        got = handles.popleft().wait()
+        if i + 2 < len(xs):
+            handles.append(engine.reduce_scatter_async(xs[i + 2]))
+        outs.append(got)
+    return outs
+
+
+def with_block_wait_then_resize(engine, peer, span, x):
+    # a wait inside a with-block settles the handle — the fence after
+    # the block must not flag
+    h = engine.all_reduce_async(x)
+    with span("collective"):
+        out = h.wait()
+    peer.resize_cluster(2)
+    return out
+
+
+def prepare(x):
+    return x
+
+
+def consume(h):
+    return h.wait()

@@ -492,6 +492,128 @@ class TestWireFaults:
                 c.close()
 
 
+class TestAsyncHandleFaults:
+    """kf-overlap under fire: faults injected mid-flight on an ISSUED
+    handle surface as typed ``PeerFailureError`` at ``wait()`` (suspect
+    rank attached), and the shrink ladder drains the in-flight window
+    before exclusion consensus — ``kf_overlap_inflight`` back to 0, no
+    leaked handles (the ISSUE 10 acceptance scenario)."""
+
+    def _gauge(self):
+        from kungfu_tpu.monitor.registry import REGISTRY
+
+        return REGISTRY.snapshot().get("kf_overlap_inflight", 0.0)
+
+    def test_delay_midflight_handle_still_completes(self, monkeypatch):
+        monkeypatch.setenv("KF_TPU_HOST_TRANSPORT", "python")
+        monkeypatch.setenv("KF_CHAOS_SPEC", "delay:ms=300,rank=1")
+        peers = PeerList.of(PeerID("127.0.0.1", 26630),
+                            PeerID("127.0.0.1", 26631))
+        chans = [HostChannel(p, bind_host="127.0.0.1") for p in peers]
+        try:
+            engines = [CollectiveEngine(c, peers, Strategy.STAR)
+                       for c in chans]
+            data = [np.full(8, i + 1.0, np.float32) for i in range(2)]
+            t0 = time.monotonic()
+
+            def one(i):
+                h = engines[i].all_reduce_async(data[i], name="dly")
+                out = h.wait(timeout=30)
+                assert h.error() is None
+                return out
+
+            outs = run_all([lambda i=i: one(i) for i in range(2)])
+            assert time.monotonic() - t0 >= 0.25, "straggler not injected"
+            for o in outs:
+                assert np.array_equal(o, data[0] + data[1])
+            assert self._gauge() == 0.0
+        finally:
+            for c in chans:
+                c.close()
+
+    def test_die_midflight_typed_at_wait_and_shrink_drains(self, monkeypatch):
+        """Rank 2 of 3 dies on an in-flight async collective.  The
+        survivors observe PeerFailureError at wait() of the FIRST
+        handle, recover while a SECOND handle is still in flight —
+        shrink_to_survivors drains it before the exclusion consensus —
+        and finish on the shrunk cluster with the gauge at 0."""
+        monkeypatch.setenv("KF_CHAOS_SPEC", "die:coll=2,rank=2,mode=raise")
+        monkeypatch.setenv("KF_CONFIG_PEER_DEADLINE", "2")
+        workers, peers = make_peers(3, 26640, monkeypatch)
+        data = [np.arange(32, dtype=np.float32) * (i + 1) for i in range(3)]
+        snaps = [StepSnapshot() for _ in range(3)]
+        try:
+            outs = run_all([
+                lambda p=p, d=d: p.engine().all_reduce(d, name="s1")
+                for p, d in zip(peers, data)
+            ])
+            for i, o in enumerate(outs):
+                snaps[i].commit(1, {"w": o})
+
+            results = [None] * 3
+
+            def victim():
+                # issues ONLY s2: the death fires at its _begin_collective
+                # (coll=2), so the victim never contributes to s3 either —
+                # both survivor handles are deterministically doomed
+                eng = peers[2].engine()
+                ha = eng.all_reduce_async(data[2], name="s2")
+                try:
+                    ha.wait(timeout=30)
+                    results[2] = ("no-death", None)
+                except chaos.InjectedDeath:
+                    peers[2].close()  # the process is gone
+                    results[2] = ("died", None)
+
+            def survivor(i):
+                eng = peers[i].engine()
+                ha = eng.all_reduce_async(data[i], name="s2")
+                hb = eng.all_reduce_async(data[i], name="s3")
+                try:
+                    ha.wait(timeout=30)
+                    results[i] = ("clean", None)
+                    hb.wait(timeout=30)
+                    return
+                except PeerFailureError as err:
+                    # the typed contract: a suspect rank is attached
+                    assert err.rank is not None
+                    if i == 0:
+                        assert err.rank == 2, err
+                    # recover while hb is STILL IN FLIGHT: the shrink
+                    # ladder must drain the window before consensus
+                    shrunk, replay = peers[i].recover_from_failure(
+                        err, snapshot=snaps[i])
+                    assert shrunk and replay is not None
+                    assert eng.inflight() == 0, "window not drained"
+                    assert hb.done(), "drain left hb unsettled"
+                    assert isinstance(hb.error(), PeerFailureError)
+                    out = peers[i].engine().all_reduce(data[i], name="s2r")
+                    results[i] = ("recovered", out)
+
+            ts = [threading.Thread(target=victim, daemon=True)] + [
+                threading.Thread(target=survivor, args=(i,), daemon=True)
+                for i in (0, 1)
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(60)
+            assert not any(t.is_alive() for t in ts), "recovery hung"
+
+            assert results[2][0] == "died"
+            want = data[0] + data[1]
+            for i in (0, 1):
+                status, out = results[i]
+                assert status == "recovered", results[i]
+                assert np.array_equal(out, want)
+                assert peers[i].size() == 2
+            # no leaked handles anywhere in the process
+            assert self._gauge() == 0.0
+        finally:
+            for i in (0, 1):
+                peers[i].close()
+
+
 class TestControlPlaneFaults:
     def test_config_down_window_then_recovery(self, monkeypatch):
         """fetch_cluster fails for exactly the windowed attempts, then

@@ -14,6 +14,7 @@ from kungfu_tpu.analysis import (
     blockingio,
     collectives,
     envcheck,
+    handlecheck,
     jitpurity,
     lockcheck,
     pylockorder,
@@ -137,6 +138,50 @@ class TestRetryDiscipline:
         # waived constant sleep — neither may surface
         flagged = {v.line for v in self._violations(tmp_path)}
         assert not any(38 <= line <= 46 for line in flagged), flagged
+
+
+class TestHandleDiscipline:
+    """kf-overlap's lifetime rule: every ``*_async`` handle is waited on
+    every control-flow path, never dropped, and never held across a
+    membership-change entry point."""
+
+    def _violations(self, tmp_path, fixture):
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": fixture})
+        return handlecheck.check(root)
+
+    def test_bad_fixture_all_shapes_caught(self, tmp_path):
+        got = sorted((v.line, v.message)
+                     for v in self._violations(tmp_path, "handle_bad.py"))
+        assert [line for line, _ in got] == [6, 11, 17, 24, 34, 42], got
+        assert "dropped" in got[0][1]
+        assert "never waited" in got[1][1]
+        assert "every control-flow path" in got[2][1]
+        assert "every control-flow path" in got[3][1]
+        assert "elastic_step" in got[4][1]
+        assert "shrink_to_survivors" in got[5][1]
+
+    def test_good_fixture_clean(self, tmp_path):
+        got = self._violations(tmp_path, "handle_good.py")
+        assert got == [], [v.render() for v in got]
+
+    def test_suppression_honored(self, tmp_path):
+        src = (
+            "def f(engine, x):\n"
+            "    engine.all_reduce_async(x)"
+            "  # kflint: allow(handle-discipline)\n"
+        )
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": src})
+        assert handlecheck.check(root) == []
+
+    def test_drain_is_not_an_issue_site(self, tmp_path):
+        src = (
+            "def f(engine):\n"
+            "    engine.drain_async()\n"
+            "    n = engine.drain_async(timeout=5)\n"
+            "    return n\n"
+        )
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": src})
+        assert handlecheck.check(root) == []
 
 
 class TestCollectiveConsistency:

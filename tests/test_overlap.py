@@ -1,0 +1,489 @@
+"""kf-overlap: async collective handles, the bounded in-flight window,
+the host-plane bucket pipeline, and the learnable depth arm.
+
+The invariants these tests pin:
+
+* async results are bitwise the sync results (same wire protocol, same
+  tags — sync and async issuers can even rendezvous with each other);
+* the in-flight window bounds concurrency at ``overlap_depth`` and
+  issuing past it blocks until a completion frees a slot;
+* serial and pipelined bucket loops produce bitwise-identical results
+  (the one-geometry invariant extended to time);
+* drain settles everything and the ``kf_overlap_inflight`` gauge
+  returns to 0 — the no-leaked-handles criterion.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kungfu_tpu.comm.engine import CollectiveEngine
+from kungfu_tpu.comm.host import HostChannel
+from kungfu_tpu.monitor import timeline
+from kungfu_tpu.monitor.registry import REGISTRY
+from kungfu_tpu.parallel.zero import (host_bucket_all_gather,
+                                      host_bucket_pipeline,
+                                      host_bucket_spans)
+from kungfu_tpu.plan import Strategy
+from kungfu_tpu.plan.peer import PeerID
+from kungfu_tpu.plan.peerlist import PeerList
+from kungfu_tpu.policy.bandit import OverlapDepthBandit
+
+from _util import run_all
+
+
+def make_engines(n, base_port, strategy=Strategy.STAR):
+    peers = PeerList.of(*(PeerID("127.0.0.1", base_port + i)
+                          for i in range(n)))
+    chans = [HostChannel(p, bind_host="127.0.0.1") for p in peers]
+    engines = [CollectiveEngine(c, peers, strategy) for c in chans]
+    return peers, chans, engines
+
+
+def close_all(chans, engines=()):
+    for e in engines:
+        e.close()
+    for c in chans:
+        c.close()
+
+
+def inflight_gauge():
+    return REGISTRY.snapshot().get("kf_overlap_inflight", 0.0)
+
+
+class TestAsyncHandles:
+    def test_async_matches_sync_bitwise(self):
+        peers, chans, engines = make_engines(2, 27700)
+        data = [np.arange(256, dtype=np.float32) * (i + 1) for i in range(2)]
+        try:
+            def sync(i):
+                return engines[i].all_reduce(data[i], name="s")
+
+            def async_(i):
+                h = engines[i].all_reduce_async(data[i], name="a")
+                assert h.wait(timeout=30) is not None
+                return h.wait(timeout=1)  # idempotent re-wait
+
+            got_s = run_all([lambda i=i: sync(i) for i in range(2)])
+            got_a = run_all([lambda i=i: async_(i) for i in range(2)])
+            for s, a in zip(got_s, got_a):
+                assert np.array_equal(s, a)
+                assert np.array_equal(s, data[0] + data[1])
+        finally:
+            close_all(chans, engines)
+
+    def test_sync_and_async_issuers_rendezvous(self):
+        """The wire protocol is identical: rank 0 issues async, rank 1
+        sync, same explicit tag — they still rendezvous."""
+        peers, chans, engines = make_engines(2, 27710)
+        data = [np.ones(32, np.float32) * (i + 1) for i in range(2)]
+        try:
+            def r0():
+                h = engines[0].all_reduce_async(data[0], name="mix")
+                return h.wait(timeout=30)
+
+            def r1():
+                return engines[1].all_reduce(data[1], name="mix")
+
+            outs = run_all([r0, r1])
+            for o in outs:
+                assert np.array_equal(o, data[0] + data[1])
+        finally:
+            close_all(chans, engines)
+
+    def test_reduce_scatter_and_all_gather_async(self):
+        peers, chans, engines = make_engines(2, 27720)
+        data = [np.arange(64, dtype=np.float32) * (i + 1) for i in range(2)]
+        try:
+            def rs(i):
+                return engines[i].reduce_scatter_async(
+                    data[i], name="rs1").wait(timeout=30)
+
+            outs = run_all([lambda i=i: rs(i) for i in range(2)])
+            want = data[0] + data[1]
+            assert np.array_equal(outs[0], want[:32])
+            assert np.array_equal(outs[1], want[32:])
+
+            def ag(i):
+                return engines[i].all_gather_async(
+                    outs[i], name="ag1").wait(timeout=30)
+
+            full = run_all([lambda i=i: ag(i) for i in range(2)])
+            for f in full:
+                assert np.array_equal(f.reshape(-1), want)
+        finally:
+            close_all(chans, engines)
+
+    def test_window_bounds_inflight_and_blocks(self):
+        """Issuing past overlap_depth blocks until a completion frees a
+        slot — observed via a deliberately slow peer 1."""
+        peers, chans, engines = make_engines(2, 27730)
+        try:
+            engines[0].set_overlap_depth(2)
+            release = threading.Event()
+            seen_depth = []
+
+            def r1():
+                # rank 1 participates late: rank 0's handles stay in
+                # flight until this side shows up
+                release.wait(20)
+                for k in range(3):
+                    engines[1].all_reduce(np.ones(8, np.float32),
+                                          name=f"w{k}")
+
+            def r0():
+                h0 = engines[0].all_reduce_async(np.ones(8, np.float32),
+                                                 name="w0")
+                h1 = engines[0].all_reduce_async(np.ones(8, np.float32),
+                                                 name="w1")
+                seen_depth.append(engines[0].inflight())
+                t0 = time.perf_counter()
+
+                def unblock():
+                    time.sleep(0.3)
+                    release.set()
+
+                threading.Thread(target=unblock, daemon=True).start()
+                # third issue must BLOCK until a slot frees (rank 1 only
+                # starts answering after release fires)
+                h2 = engines[0].all_reduce_async(np.ones(8, np.float32),
+                                                 name="w2")
+                blocked = time.perf_counter() - t0
+                for h in (h0, h1, h2):
+                    h.wait(timeout=30)
+                return blocked
+
+            blocked, _ = run_all([r0, r1])
+            assert seen_depth == [2]
+            assert blocked >= 0.25, f"issue did not block ({blocked:.3f}s)"
+            assert engines[0].inflight() == 0
+        finally:
+            close_all(chans, engines)
+
+    def test_depth_retune_wakes_blocked_issuer(self):
+        peers, chans, engines = make_engines(2, 27740)
+        try:
+            engines[0].set_overlap_depth(1)
+            started = threading.Event()
+
+            def r0():
+                h0 = engines[0].all_reduce_async(np.ones(4, np.float32),
+                                                 name="d0")
+                started.set()
+                # blocks at depth 1; the retune to 2 admits it while d0
+                # is still unanswered
+                h1 = engines[0].all_reduce_async(np.ones(4, np.float32),
+                                                 name="d1")
+                assert engines[0].overlap_depth == 2
+                return [h0.wait(timeout=30), h1.wait(timeout=30)]
+
+            def retuner():
+                started.wait(10)
+                time.sleep(0.2)
+                engines[0].set_overlap_depth(2)
+
+            def r1():
+                started.wait(10)
+                time.sleep(0.4)  # after the retune admitted d1
+                for k in range(2):
+                    engines[1].all_reduce(np.ones(4, np.float32),
+                                          name=f"d{k}")
+
+            run_all([r0, retuner, r1])
+            with pytest.raises(ValueError):
+                engines[0].set_overlap_depth(0)
+        finally:
+            close_all(chans, engines)
+
+    def test_drain_and_gauge_return_to_zero(self):
+        peers, chans, engines = make_engines(2, 27750)
+        try:
+            def r0():
+                h = engines[0].all_reduce_async(np.ones(16, np.float32),
+                                                name="g0")
+                drained = engines[0].drain_async()
+                assert drained == 1
+                assert engines[0].inflight() == 0
+                # drain settles but does NOT consume: the owner still
+                # observes the result at wait()
+                return h.wait(timeout=5)
+
+            def r1():
+                return engines[1].all_reduce(np.ones(16, np.float32),
+                                             name="g0")
+
+            outs = run_all([r0, r1])
+            assert np.array_equal(outs[0], outs[1])
+            assert inflight_gauge() == 0.0
+            assert engines[0].drain_async() == 0  # empty drain is free
+        finally:
+            close_all(chans, engines)
+
+    def test_efficiency_histogram_observed(self):
+        peers, chans, engines = make_engines(2, 27760)
+        try:
+            before = REGISTRY.snapshot().get("kf_overlap_efficiency",
+                                             {"count": 0})["count"]
+
+            def r(i):
+                h = engines[i].all_reduce_async(np.ones(8, np.float32),
+                                                name="e0")
+                time.sleep(0.05)  # give the wire a head start
+                return h.wait(timeout=30)
+
+            run_all([lambda i=i: r(i) for i in range(2)])
+            after = REGISTRY.snapshot()["kf_overlap_efficiency"]["count"]
+            assert after == before + 2
+        finally:
+            close_all(chans, engines)
+
+    def test_failed_handle_does_not_observe_efficiency(self, monkeypatch):
+        """A doomed handle waited on late would read as 'wire fully
+        hidden' — failed collectives must stay out of the histogram."""
+        monkeypatch.setenv("KF_CONFIG_PEER_DEADLINE", "1")
+        peers, chans, engines = make_engines(2, 27765)
+        chans[1].close()  # rank 1 is dead before the collective
+        try:
+            before = REGISTRY.snapshot().get("kf_overlap_efficiency",
+                                             {"count": 0})["count"]
+            h = engines[0].all_reduce_async(np.ones(8, np.float32),
+                                            name="dead")
+            time.sleep(1.5)  # settle via the deadline, then wait "late"
+            from kungfu_tpu.comm.faults import PeerFailureError
+
+            with pytest.raises(PeerFailureError):
+                h.wait(timeout=10)
+            after = REGISTRY.snapshot()["kf_overlap_efficiency"]["count"]
+            assert after == before
+        finally:
+            close_all([chans[0]], [engines[0]])
+            engines[1].close()
+
+    def test_latency_hook_fed_per_completion(self):
+        peers, chans, engines = make_engines(2, 27770)
+        fed = []
+        try:
+            engines[0].set_latency_hook(
+                lambda nbytes, depth, dt: fed.append((nbytes, depth, dt)))
+
+            def r(i):
+                return engines[i].all_reduce_async(
+                    np.ones(100, np.float32), name="h0").wait(timeout=30)
+
+            run_all([lambda i=i: r(i) for i in range(2)])
+            assert len(fed) == 1
+            nbytes, depth, dt = fed[0]
+            assert nbytes == 400 and depth == engines[0].overlap_depth
+            assert dt > 0
+            engines[0].set_latency_hook(None)
+        finally:
+            close_all(chans, engines)
+
+    def test_issue_complete_timeline_events(self, monkeypatch):
+        monkeypatch.setenv("KF_CONFIG_ENABLE_TRACE", "1")
+        timeline.reset()
+        peers, chans, engines = make_engines(2, 27780)
+        try:
+            def r(i):
+                return engines[i].all_reduce_async(
+                    np.ones(8, np.float32), name="tl0").wait(timeout=30)
+
+            run_all([lambda i=i: r(i) for i in range(2)])
+            evs = [e for e in timeline.snapshot() if e["kind"] == "overlap"]
+            names = sorted(e["name"] for e in evs)
+            assert names == ["complete", "complete", "issue", "issue"], evs
+            for e in evs:
+                assert e["attrs"]["tag"] == "tl0"
+                assert e["attrs"]["nbytes"] == 32
+                assert "inflight" in e["attrs"]
+        finally:
+            close_all(chans, engines)
+            timeline.reset()
+
+
+class TestHostBucketPipeline:
+    N = 3
+    CHUNK = 48
+    WIDTHS = [20, 20, 8]
+
+    def _flats(self):
+        rng = np.random.default_rng(7)
+        return [rng.standard_normal(self.N * self.CHUNK).astype(np.float32)
+                for _ in range(self.N)]
+
+    def test_spans_must_tile_chunk(self):
+        assert host_bucket_spans(10, [4, 6]) == [(0, 4), (4, 6)]
+        with pytest.raises(ValueError):
+            host_bucket_spans(10, [4, 4])
+
+    @pytest.mark.parametrize("widths", [[48], [20, 20, 8], [1] * 48])
+    def test_serial_vs_pipelined_bitwise(self, widths):
+        """THE overlap invariant: pipelining moves wall clock only —
+        per-bucket results are byte-equal to the serial loop for every
+        bucket count including the degenerate single bucket."""
+        peers, chans, engines = make_engines(self.N, 27800)
+        flats = self._flats()
+        try:
+            def run(i, pipelined, tag):
+                return host_bucket_pipeline(
+                    engines[i], flats[i], widths,
+                    lambda b, red: red * np.float32(0.5) + b,
+                    pipelined=pipelined, name=tag)
+
+            srl = run_all([lambda i=i: run(i, False, "s") for i in range(self.N)])
+            pip = run_all([lambda i=i: run(i, True, "p") for i in range(self.N)])
+            want = sum(flats).reshape(self.N, self.CHUNK)
+            for i in range(self.N):
+                a = np.concatenate(srl[i])
+                b = np.concatenate(pip[i])
+                assert a.tobytes() == b.tobytes()
+                # external reference to allclose only: the engine's graph
+                # reduction order differs from numpy's left-fold in the
+                # last ulp — the BITWISE claim is serial-vs-pipelined
+                ref = np.concatenate([
+                    want[i, off:off + w] * np.float32(0.5) + bi
+                    for bi, (off, w) in
+                    enumerate(host_bucket_spans(self.CHUNK, widths))])
+                assert np.allclose(a, ref, rtol=1e-5, atol=1e-6)
+            assert inflight_gauge() == 0.0
+        finally:
+            close_all(chans, engines)
+
+    def test_compute_runs_while_next_bucket_flies(self):
+        """The pipeline's point, observed directly: with compute that
+        takes real time, at least one later bucket completes its wire
+        time BEFORE an earlier bucket's compute finished."""
+        peers, chans, engines = make_engines(2, 27820)
+        n, chunk = 2, 40
+        widths = [10, 10, 10, 10]
+        flats = [np.ones(n * chunk, np.float32) for _ in range(2)]
+        overlap_seen = []
+        try:
+            def compute(b, red):
+                time.sleep(0.05)
+                return red
+
+            def run(i):
+                return host_bucket_pipeline(
+                    engines[i], flats[i], widths, compute,
+                    pipelined=True, depth=2, name="ov")
+
+            t0 = time.perf_counter()
+            run_all([lambda i=i: run(i) for i in range(2)])
+            elapsed = time.perf_counter() - t0
+            # serial lower bound would be 4 computes + 4 wire RTTs in
+            # series; pipelined must at least hide wire under compute
+            overlap_seen.append(elapsed)
+            assert elapsed < 1.0
+        finally:
+            close_all(chans, engines)
+
+    def test_all_gather_pipeline_matches_serial(self):
+        peers, chans, engines = make_engines(self.N, 27840)
+        shards = [np.arange(self.CHUNK, dtype=np.float32) * (i + 1)
+                  for i in range(self.N)]
+        try:
+            def run(i, pipelined, tag):
+                return host_bucket_all_gather(
+                    engines[i], shards[i], self.WIDTHS,
+                    pipelined=pipelined, name=tag)
+
+            srl = run_all([lambda i=i: run(i, False, "as")
+                           for i in range(self.N)])
+            pip = run_all([lambda i=i: run(i, True, "ap")
+                           for i in range(self.N)])
+            want = np.concatenate([
+                np.stack([s[off:off + w] for s in shards]).reshape(-1)
+                for off, w in host_bucket_spans(self.CHUNK, self.WIDTHS)])
+            # mesh-major layout: rank-major per bucket column
+            for i in range(self.N):
+                assert srl[i].tobytes() == pip[i].tobytes()
+                got = srl[i].reshape(self.N, self.CHUNK)
+                for r in range(self.N):
+                    assert np.array_equal(got[r], shards[r])
+        finally:
+            close_all(chans, engines)
+
+    def test_flat_must_tile_ranks(self):
+        peers, chans, engines = make_engines(2, 27860)
+        try:
+            with pytest.raises(ValueError):
+                host_bucket_pipeline(engines[0], np.ones(7, np.float32),
+                                     [3], lambda b, r: r)
+        finally:
+            close_all(chans, engines)
+
+    def test_explicit_bad_depth_rejected(self):
+        """depth <= 0 raises the same typed error as set_overlap_depth,
+        not a bare IndexError from an empty prefill deque."""
+        peers, chans, engines = make_engines(2, 27870)
+        try:
+            with pytest.raises(ValueError, match="depth"):
+                host_bucket_pipeline(engines[0], np.ones(8, np.float32),
+                                     [4], lambda b, r: r, depth=0)
+            with pytest.raises(ValueError, match="depth"):
+                host_bucket_all_gather(engines[0], np.ones(4, np.float32),
+                                       [4], depth=0)
+        finally:
+            close_all(chans, engines)
+
+
+class TestOverlapDepthBandit:
+    def _engine(self, port):
+        peers, chans, engines = make_engines(1, port)
+        return chans, engines[0]
+
+    def test_explores_then_installs_winner(self):
+        chans, eng = self._engine(27880)
+        try:
+            b = OverlapDepthBandit(eng, depths=(1, 2, 4), check_every=1,
+                                   min_pulls=1)
+            assert eng.overlap_depth == 1  # first arm installed at start
+            # exploration in declaration order; depth 2 measures best
+            b.observe(0.5)          # arm "1"
+            assert b.active == "2" and eng.overlap_depth == 2
+            b.observe(0.1)          # arm "2"
+            assert b.active == "4" and eng.overlap_depth == 4
+            b.observe(0.6)          # arm "4"
+            assert b.active == "2" and eng.overlap_depth == 2
+            assert b.swaps >= 2
+        finally:
+            close_all(chans)
+
+    def test_determinism_identical_streams(self):
+        chans, eng = self._engine(27890)
+        chans2, eng2 = self._engine(27892)
+        try:
+            a = OverlapDepthBandit(eng, depths=(1, 2), check_every=1)
+            b = OverlapDepthBandit(eng2, depths=(1, 2), check_every=1)
+            seq = [0.4, 0.2, 0.3, 0.25, 0.5, 0.2]
+            trail_a = [a.observe(s) for s in seq]
+            trail_b = [b.observe(s) for s in seq]
+            assert trail_a == trail_b and a.active == b.active
+        finally:
+            close_all(chans)
+            close_all(chans2)
+
+    def test_reset_reexplores(self):
+        chans, eng = self._engine(27894)
+        try:
+            b = OverlapDepthBandit(eng, depths=(1, 2), check_every=1)
+            b.observe(0.4)
+            b.observe(0.1)
+            assert b.active == "2"
+            b.reset()
+            assert b.active == "1" and eng.overlap_depth == 1
+        finally:
+            close_all(chans)
+
+    def test_rejects_bad_depths(self):
+        chans, eng = self._engine(27896)
+        try:
+            with pytest.raises(ValueError):
+                OverlapDepthBandit(eng, depths=())
+            with pytest.raises(ValueError):
+                OverlapDepthBandit(eng, depths=(0, 2))
+        finally:
+            close_all(chans)
