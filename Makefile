@@ -5,8 +5,8 @@
 PY ?= python3
 BASELINE := tests/lint_baseline.json
 
-.PHONY: lint verify shardcheck check test native trace-demo zero-demo \
-    multislice-demo adapt-demo overlap-demo help
+.PHONY: lint verify shardcheck pallas-check check test native trace-demo \
+    zero-demo multislice-demo adapt-demo overlap-demo help
 
 ## lint: all fourteen kf-lint rules — the Python suite (env-contract,
 ## jit-sync, blocking-io, retry-discipline, handle-discipline,
@@ -30,6 +30,17 @@ verify:
 shardcheck:
 	$(PY) scripts/kflint --checker shard-axis --checker shard-spec \
 	    --checker recompile-hazard
+
+## pallas-check: the Pallas ICI collectives interpreter-path bitwise
+## suite (docs/pallas_collectives.md): every ring kernel form — uni/
+## bidirectional reduce-scatter and all-gather, 1-chunk, padded-tail,
+## non-divisible world sizes — pinned bitwise against the order-matched
+## lax emulation and the lax references, plus the vjp pair, the
+## pallas_ring schedule plumbing (flat buckets, eager communicator,
+## ZeRO, ring attention) and the traced-bytes parity rows.
+pallas-check:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_pallas_collectives.py \
+	    -q -m 'not slow' -p no:cacheprovider
 
 ## check: the full pre-merge gate (lint + compileall + build stamps).
 check:

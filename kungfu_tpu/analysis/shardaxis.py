@@ -76,6 +76,13 @@ AXIS_ARGS: Dict[str, Tuple[int, Tuple[str, ...]]] = {
     "peer_rank": (0, ("axis",)),
     "peer_size": (0, ("axis",)),
     "pcast_varying": (1, ("axes",)),
+    # the Pallas ICI ring collectives (ops/pallas/collectives.py): the
+    # axis name threads through pallas_call kernels under shard_map —
+    # a typo'd literal here fails at trace time on the pod exactly like
+    # a lax primitive's would
+    "ring_reduce_scatter": (1, ("axis",)),
+    "ring_all_gather": (1, ("axis",)),
+    "ring_all_reduce": (1, ("axis",)),
 }
 
 #: strings that are reduce-op selectors sharing call slots with axis

@@ -770,7 +770,12 @@ class Communicator:
         The collective runs **bucketed** (``bucket_bytes`` per piece,
         the gradient-bucket fusion of :mod:`kungfu_tpu.ops.schedules`
         folded to reduce-scatter-sized pieces), so XLA gets independent
-        program points to overlap with neighboring compute."""
+        program points to overlap with neighboring compute.
+
+        When the bandit (or the user) has installed ``pallas_ring`` for
+        this payload's size bucket, each bucket's scatter rides the
+        in-kernel-overlap ring kernel instead of ``lax.psum_scatter`` —
+        same mesh-major chunk geometry, one more measured arm."""
         if op not in ("sum", "mean"):
             raise ValueError(
                 f"reduce_scatter supports sum/mean, got {op!r}")
@@ -779,7 +784,11 @@ class Communicator:
 
         def leaf(a):
             a = jnp.asarray(a)
-            key = ("rs", op, a.shape, a.dtype.name, int(bucket_bytes))
+            flat_sched = ("pallas_ring"
+                          if self.strategy_for(a.nbytes) == "pallas_ring"
+                          else "lax")
+            key = ("rs", op, a.shape, a.dtype.name, int(bucket_bytes),
+                   flat_sched)
 
             def build():
                 from kungfu_tpu.ops.schedules import (bucket_widths,
@@ -799,9 +808,19 @@ class Communicator:
                     if pad:
                         g = jnp.concatenate(
                             [g, jnp.zeros((s.shape[0], pad), g.dtype)], -1)
-                    out = jax.vmap(
-                        lambda row: reduce_scatter_flat(
-                            row, axes, chunk, widths))(g)
+                    if flat_sched == "pallas_ring":
+                        # the stacked eager convention leaves exactly one
+                        # row per device inside shard_map: apply the ring
+                        # kernel to it directly (a pallas_call under a
+                        # size-1 vmap would stress the batching rule for
+                        # nothing)
+                        out = reduce_scatter_flat(
+                            g[0], axes, chunk, widths,
+                            schedule=flat_sched)[None]
+                    else:
+                        out = jax.vmap(
+                            lambda row: reduce_scatter_flat(
+                                row, axes, chunk, widths))(g)
                     if op == "mean":
                         out = out / n
                     return out
@@ -826,7 +845,11 @@ class Communicator:
 
         def leaf(a):
             a = jnp.asarray(a)
-            key = ("ags", a.shape, a.dtype.name, int(bucket_bytes))
+            flat_sched = ("pallas_ring"
+                          if self.strategy_for(a.nbytes) == "pallas_ring"
+                          else "lax")
+            key = ("ags", a.shape, a.dtype.name, int(bucket_bytes),
+                   flat_sched)
 
             def build():
                 from kungfu_tpu.ops.schedules import (all_gather_flat,
@@ -841,6 +864,10 @@ class Communicator:
 
                 def body(s):
                     g = s.reshape(s.shape[0], -1)
+                    if flat_sched == "pallas_ring":
+                        # one row per device (see reduce_scatter)
+                        return all_gather_flat(
+                            g[0], axes, widths, schedule=flat_sched)[None]
                     return jax.vmap(
                         lambda row: all_gather_flat(row, axes, widths))(g)
 

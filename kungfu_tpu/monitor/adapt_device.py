@@ -12,7 +12,9 @@ this module is the *plumbing that makes it collective-safe*:
   swap-eligibility epochs gate the hysteresis.
 
 * :class:`DeviceBanditDriver` — arms are the compiled allreduce
-  schedules ``psum``/``two_stage``/``ring``, learned **per payload-size
+  schedules ``psum``/``two_stage``/``ring``/``pallas_ring`` (the last
+  lowering BELOW XLA into the in-kernel-overlap ring kernels of
+  :mod:`kungfu_tpu.ops.pallas.collectives`), learned **per payload-size
   bucket** (small control tensors and large fused gradient buckets get
   independent winners — :data:`kungfu_tpu.ops.schedules.SIZE_BUCKETS`)
   and installed into the communicator's per-``nbytes`` dispatch

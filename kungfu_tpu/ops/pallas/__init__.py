@@ -14,6 +14,11 @@ from kungfu_tpu.ops.pallas.attention import (
     flash_attention_with_lse,
     make_flash_attn,
 )
+from kungfu_tpu.ops.pallas.collectives import (
+    ring_all_gather,
+    ring_all_reduce,
+    ring_reduce_scatter,
+)
 from kungfu_tpu.ops.pallas.lm_head import lm_head_nll
 from kungfu_tpu.ops.pallas.xent import softmax_cross_entropy, token_nll
 
@@ -22,6 +27,9 @@ __all__ = [
     "flash_attention_with_lse",
     "make_flash_attn",
     "lm_head_nll",
+    "ring_all_gather",
+    "ring_all_reduce",
+    "ring_reduce_scatter",
     "softmax_cross_entropy",
     "token_nll",
 ]

@@ -21,6 +21,7 @@ Interpret mode on CPU for tests; compiled on TPU.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -30,6 +31,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from kungfu_tpu.ops.pallas._sharding import vma_of as _vma
 from kungfu_tpu.ops.pallas._sharding import sds as _sds
+from kungfu_tpu.utils.envs import LaunchKnobs
 from kungfu_tpu.utils.jaxcompat import tpu_compiler_params
 
 #: measured on TPU v5e (docs/perf.md): (256, 2048) tiles run the fwd+bwd
@@ -257,21 +259,48 @@ XENT_FWD_MIN_ELEMENTS = 1 << 22
 XENT_TRAIN_XLA_BUDGET_MB = 2048
 
 
+class _Knobs(LaunchKnobs):
+    """The ``KF_TPU_XENT`` / ``KF_XENT_XLA_BUDGET_MB`` /
+    ``KF_XENT_FWD_MIN_ELEMENTS`` routing knobs.
+
+    These were always documented as launch-set (they pick which kernel
+    gets traced for a shape and carry no cluster-size state), but the
+    reads used to execute AT TRACE TIME inside jitted callers, each
+    carrying a ``kflint: allow(recompile-hazard)`` waiver.  Hoisting the
+    reads into the launch-knob base makes the documented semantics
+    real — a mid-run env mutation never silently changes what the next
+    trace compiles — and retires the waivers.  Tests and tools that
+    mutate the environment call ``XENT_ENV.reload()`` afterwards (fresh
+    processes, the normal launcher path, pick the values up at
+    import)."""
+
+    def _read(self) -> None:
+        mode = os.environ.get("KF_TPU_XENT", "auto").lower()
+        if mode == "xla":
+            mode = "plain"  # long-standing alias
+        if mode not in ("fused", "plain", "auto"):
+            # fail loudly AT LOAD: a typo silently auto-routing (or
+            # silently going plain, as pre-round-4 code did) hides the
+            # misconfiguration
+            raise ValueError(
+                f"KF_TPU_XENT={mode!r}: one of fused | plain | xla | auto"
+            )
+        self.mode = mode
+        self.budget_mb = int(os.environ.get(
+            "KF_XENT_XLA_BUDGET_MB", str(XENT_TRAIN_XLA_BUDGET_MB)))
+        self.fwd_min_elements = int(os.environ.get(
+            "KF_XENT_FWD_MIN_ELEMENTS", str(XENT_FWD_MIN_ELEMENTS)))
+
+
+XENT_ENV = _Knobs()
+
+
 def _route_fused(n: int, v: int, itemsize: int, training: bool) -> bool:
     """True = take the Pallas kernel for this (shape, dtype, phase)."""
-    import os
-
-    # launch-set routing knobs, read at trace time BY DESIGN: they pick
-    # which kernel gets traced for a shape and carry no cluster-size
-    # state, so they cannot go stale on resize
     if training:
-        budget_mb = int(os.environ.get("KF_XENT_XLA_BUDGET_MB",  # kflint: allow(recompile-hazard)
-                                       str(XENT_TRAIN_XLA_BUDGET_MB)))
         resid_bytes = n * v * (itemsize + 4)
-        return resid_bytes > (budget_mb << 20)
-    min_el = int(os.environ.get("KF_XENT_FWD_MIN_ELEMENTS",  # kflint: allow(recompile-hazard)
-                                str(XENT_FWD_MIN_ELEMENTS)))
-    return n * v >= min_el
+        return resid_bytes > (XENT_ENV.budget_mb << 20)
+    return n * v >= XENT_ENV.fwd_min_elements
 
 
 def route_fused_lm_head(n_tokens: int, vocab: int) -> bool:
@@ -300,20 +329,12 @@ def token_nll(logits, targets, training: bool = True):
     caller to the kernel, including training shapes where XLA's fused
     backward is ~2x faster.  ``training=False`` lets eval-only callers
     opt into the fwd-only crossover (the kernel wins much earlier
-    there); the default assumes gradients will flow."""
-    import os
+    there); the default assumes gradients will flow.
 
-    # launch-set dispatch mode, a deliberate trace-time constant (it
-    # selects the kernel being traced; no membership state to go stale)
-    mode = os.environ.get("KF_TPU_XENT", "auto").lower()  # kflint: allow(recompile-hazard)
-    if mode == "xla":
-        mode = "plain"  # long-standing alias
-    if mode not in ("fused", "plain", "auto"):
-        # fail loudly: a typo silently auto-routing (or silently going
-        # plain, as pre-round-4 code did) hides the misconfiguration
-        raise ValueError(
-            f"KF_TPU_XENT={mode!r}: one of fused | plain | xla | auto"
-        )
+    The mode is the launch-set :data:`XENT_ENV` knob — read at import,
+    not at trace time; mutate the env then call ``XENT_ENV.reload()``
+    to re-route (tests)."""
+    mode = XENT_ENV.mode
     if mode == "fused":
         fused = True
     elif mode == "plain" or jax.default_backend() != "tpu":

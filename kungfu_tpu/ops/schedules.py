@@ -16,8 +16,12 @@ lower as
   n-1 all-gather steps): every hop is an explicit program point, the
   shape that overlap experiments and the scaling-book recipes reason
   about.
+* ``pallas_ring`` — the ring written BELOW XLA: the Pallas ICI kernels
+  of :mod:`kungfu_tpu.ops.pallas.collectives`, whose RDMA hops overlap
+  the fold math inside one kernel (double-buffered working slots) —
+  compiled on TPU, the bitwise-identical lax emulation elsewhere.
 
-All three produce the same values (sum/mean/min/max; see per-schedule
+All four produce the same values (sum/mean/min/max; see per-schedule
 notes), verified against ``lax.psum`` in ``tests/test_schedules.py``.
 Swapping = re-jitting with a different ``schedule=`` — the moral
 equivalent of the reference's ``SetGlobalStrategy``, with consensus
@@ -39,8 +43,15 @@ from kungfu_tpu.utils.jaxcompat import axis_size
 
 Axis = Union[str, Tuple[str, ...]]
 
-#: selectable device-plane allreduce schedules
-ALLREDUCE_SCHEDULES = ("psum", "two_stage", "ring")
+#: selectable device-plane allreduce schedules (also the device bandit's
+#: arm set — kungfu_tpu.monitor.adapt_device learns a winner per payload
+#: bucket and installs it with Communicator.set_bucket_strategy)
+ALLREDUCE_SCHEDULES = ("psum", "two_stage", "ring", "pallas_ring")
+
+#: schedules selectable for the flat reduce-scatter / all-gather pair
+#: below ("lax" = the psum_scatter/all_gather primitives XLA lowers;
+#: "pallas_ring" = the in-kernel-overlap ring of ops/pallas/collectives)
+FLAT_SCHEDULES = ("lax", "pallas_ring")
 
 #: payload-size buckets for the per-bucket schedule table
 #: (:meth:`kungfu_tpu.comm.device.Communicator.set_bucket_strategy`): the
@@ -151,6 +162,19 @@ def _two_stage_all_reduce_leaf(a, axis_name: str, op: str):
     return out[:size].reshape(a.shape)
 
 
+def _pallas_ring_all_reduce_leaf(a, axis_name: str, op: str):
+    """The ``pallas_ring`` schedule: ring reduce-scatter + ring
+    all-gather through the ICI kernels of
+    :mod:`kungfu_tpu.ops.pallas.collectives` (compiled on TPU, the
+    bitwise-identical lax emulation elsewhere).  Sum-only like the
+    kernels; min/max fall back to the lax ring schedule."""
+    if op in ("min", "max"):
+        return _ring_all_reduce_leaf(a, axis_name, op)
+    from kungfu_tpu.ops.pallas.collectives import ring_all_reduce
+
+    return ring_all_reduce(a, axis_name)
+
+
 _PSUM_FOLD = {"sum": lax.psum, "min": lax.pmin, "max": lax.pmax}
 
 
@@ -224,9 +248,15 @@ def bucket_widths(chunk: int, n: int, itemsize: int,
     return widths
 
 
+def _check_flat_schedule(schedule: str) -> None:
+    if schedule not in FLAT_SCHEDULES:
+        raise ValueError(
+            f"unknown flat schedule {schedule!r}; one of {FLAT_SCHEDULES}")
+
+
 def reduce_scatter_flat(g, axes: Sequence[str], chunk: int,
                         widths: Optional[Sequence[int]] = None,
-                        serial: bool = False):
+                        serial: bool = False, schedule: str = "lax"):
     """Bucketed reduce-scatter of a flat mesh-major buffer.
 
     ``g``: per-device ``[n*chunk]`` (the full fused gradient, VMA-varying
@@ -246,7 +276,16 @@ def reduce_scatter_flat(g, axes: Sequence[str], chunk: int,
     the fence is a value identity, and each bucket's reduction order is
     fixed by its own collective either way.  ``serial`` exists as the
     regression control the overlap bench diffs against — never as a
-    production path."""
+    production path.
+
+    ``schedule="pallas_ring"`` scatters each bucket over the OUTER mesh
+    axis through the in-kernel-overlap ring kernel
+    (:func:`kungfu_tpu.ops.pallas.collectives.ring_reduce_scatter`;
+    inner axes keep the lax primitive) — same mesh-major bucket
+    geometry, so the ZeRO shard layout is byte-identical; the reduction
+    ORDER is the ring's (docs/pallas_collectives.md), so cross-schedule
+    comparisons are allclose, not bitwise."""
+    _check_flat_schedule(schedule)
     if not axes:
         return g[:chunk]
     n = 1
@@ -254,14 +293,20 @@ def reduce_scatter_flat(g, axes: Sequence[str], chunk: int,
         n *= axis_size(ax)
     widths = list(widths) if widths else [chunk]
     g2 = g.reshape(n, chunk)
+    if schedule == "pallas_ring":
+        from kungfu_tpu.ops.pallas.collectives import ring_reduce_scatter
     parts = []
     off = 0
     for w in widths:
         slab = g2[:, off:off + w].reshape(-1)
         if serial and parts:
             slab, _ = _dep_fence((slab, parts[-1]))
-        for ax in axes:
-            slab = lax.psum_scatter(slab, ax, scatter_dimension=0, tiled=True)
+        for i, ax in enumerate(axes):
+            if schedule == "pallas_ring" and i == 0:
+                slab = ring_reduce_scatter(slab, ax)
+            else:
+                slab = lax.psum_scatter(
+                    slab, ax, scatter_dimension=0, tiled=True)
         parts.append(slab)
         off += w
     out = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
@@ -270,7 +315,7 @@ def reduce_scatter_flat(g, axes: Sequence[str], chunk: int,
 
 def all_gather_flat(shard, axes: Sequence[str],
                     widths: Optional[Sequence[int]] = None,
-                    prefetch: bool = False):
+                    prefetch: bool = False, schedule: str = "lax"):
     """Bucketed all-gather: inverse layout of :func:`reduce_scatter_flat`.
 
     ``shard``: this device's ``[chunk]`` slice; returns the mesh-major
@@ -287,7 +332,16 @@ def all_gather_flat(shard, axes: Sequence[str],
     fence is a value identity (bitwise-pinned against ``prefetch=False``)
     and its custom backward applies the same window to the transposed
     reduce-scatters, so the ZeRO-3 gradient path is double-buffered in
-    both directions."""
+    both directions.
+
+    ``schedule="pallas_ring"`` gathers each bucket over the OUTER mesh
+    axis through the in-kernel-overlap ring kernel
+    (:func:`kungfu_tpu.ops.pallas.collectives.ring_all_gather`; inner
+    axes keep the lax primitive).  Gathering is pure data movement, so
+    the result is bitwise-identical to the lax schedule; the kernel's
+    custom vjp IS the ring reduce-scatter, so the ZeRO-3 gradient path
+    keeps its transpose shape."""
+    _check_flat_schedule(schedule)
     if not axes:
         return shard
     n = 1
@@ -295,14 +349,20 @@ def all_gather_flat(shard, axes: Sequence[str],
         n *= axis_size(ax)
     chunk = shard.shape[0]
     widths = list(widths) if widths else [chunk]
+    if schedule == "pallas_ring":
+        from kungfu_tpu.ops.pallas.collectives import ring_all_gather
     slabs = []
     off = 0
     for w in widths:
         piece = shard[off:off + w]
         if prefetch and len(slabs) >= 2:
             piece, _ = _dep_fence((piece, slabs[-2]))
-        for ax in reversed(axes):
-            piece = lax.all_gather(piece, ax, axis=0, tiled=True)
+        rev = tuple(reversed(axes))
+        for i, ax in enumerate(rev):
+            if schedule == "pallas_ring" and i == len(rev) - 1:
+                piece = ring_all_gather(piece, ax)
+            else:
+                piece = lax.all_gather(piece, ax, axis=0, tiled=True)
         slabs.append(piece.reshape(n, w))
         off += w
     full = slabs[0] if len(slabs) == 1 else jnp.concatenate(slabs, axis=1)
@@ -406,8 +466,11 @@ def all_reduce_scheduled(x, axis: Axis, op: str = "sum",
 
         return all_reduce(x, axis, op=op)
     axes = (axis,) if isinstance(axis, str) else tuple(axis)
-    sched_leaf = (_ring_all_reduce_leaf if schedule == "ring"
-                  else _two_stage_all_reduce_leaf)
+    sched_leaf = {
+        "ring": _ring_all_reduce_leaf,
+        "two_stage": _two_stage_all_reduce_leaf,
+        "pallas_ring": _pallas_ring_all_reduce_leaf,
+    }[schedule]
     base = "sum" if op == "mean" else op
 
     def leaf(a):

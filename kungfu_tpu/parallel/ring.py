@@ -32,7 +32,8 @@ from kungfu_tpu.utils.jaxcompat import axis_size
 
 
 def ring_attention(q, k, v, causal: bool = True, axis: str = "sp",
-                   block_impl: str = "auto"):
+                   block_impl: str = "auto",
+                   kv_gather: Optional[str] = None):
     """q, k, v: [B, H, S_local, D] (sequence axis sharded over ``axis``).
 
     Returns [B, H, S_local, D] — the exact softmax attention output as if
@@ -49,14 +50,81 @@ def ring_attention(q, k, v, causal: bool = True, axis: str = "sp",
       (``lax.switch`` — the einsum path pays for them and discards);
     * ``auto`` — flash on TPU, einsum elsewhere (interpret-mode Pallas
       is too slow for the CPU test cluster).
+
+    ``kv_gather`` swaps the n-round K/V *rotation* for ONE ring
+    all-gather up front (:func:`kungfu_tpu.ops.schedules.
+    all_gather_flat` — pass ``"pallas_ring"`` to ride the ICI kernels of
+    :mod:`kungfu_tpu.ops.pallas.collectives`, or ``"lax"`` for the
+    primitive): n ppermute program points collapse into one collective
+    whose backward is the matching reduce-scatter of dK/dV (the gather
+    kernel's custom vjp).  Trades the rotation's O(S_local²) working set
+    for the gathered O(S_local · S_global) block — the short-sequence /
+    bandwidth-rich regime; ``None`` (default) keeps the rotation.
     """
     if block_impl not in ("auto", "flash", "einsum"):
         raise ValueError(f"unknown block_impl {block_impl!r}")
+    if kv_gather is not None:
+        if block_impl == "flash":
+            # the gathered path computes one masked einsum block — an
+            # explicit flash request would be silently downgraded to the
+            # O(S_local * S_global) logits tile the kernel exists to
+            # avoid; refuse instead (auto/einsum opt in knowingly)
+            raise ValueError(
+                "kv_gather is einsum-block attention and cannot honor "
+                "block_impl='flash'; use the ppermute rotation "
+                "(kv_gather=None) for the flash path")
+        from kungfu_tpu.ops.schedules import FLAT_SCHEDULES
+
+        if kv_gather not in FLAT_SCHEDULES:
+            raise ValueError(
+                f"unknown kv_gather {kv_gather!r}; one of {FLAT_SCHEDULES}"
+                " (or None for the ppermute rotation)")
+        return _ring_kv_gather(q, k, v, causal, axis, kv_gather)
     if block_impl == "flash" or (
         block_impl == "auto" and jax.default_backend() == "tpu"
     ):
         return _ring_flash(q, k, v, causal, axis)
     return _ring_einsum(q, k, v, causal, axis)
+
+
+def _ring_kv_gather(q, k, v, causal: bool, axis: str, schedule: str):
+    """Gathered-K/V block attention: one ring all-gather of K and V over
+    ``axis``, then a single masked online-softmax block per device.
+    Exact — global causal positions mask the logits — and
+    differentiable: the gather's transpose reduce-scatters dK/dV back to
+    their owners (with ``schedule="pallas_ring"`` that is the ring
+    kernel's custom vjp)."""
+    from kungfu_tpu.ops.schedules import all_gather_flat
+
+    n_sp = axis_size(axis)
+    my_blk = jax.lax.axis_index(axis)
+    B, H, S, D = q.shape
+
+    def gather(t):
+        flat = all_gather_flat(t.reshape(-1), [axis], schedule=schedule)
+        # mesh-major rows = ring order: device j's [B, H, S, D] block
+        return jnp.moveaxis(
+            flat.reshape((n_sp,) + t.shape), 0, 2
+        ).reshape(B, H, n_sp * S, D)
+
+    kf = gather(k).astype(jnp.float32)
+    vf = gather(v).astype(jnp.float32)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q.astype(jnp.float32) * scale, kf)
+    if causal:
+        q_pos = my_blk * S + jnp.arange(S)
+        k_pos = jnp.arange(n_sp * S)
+        mask = q_pos[:, None] >= k_pos[None, :]
+        logits = jnp.where(mask, logits, -jnp.inf)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    m = jnp.maximum(m, -1e30)  # fully-masked rows stay finite
+    p = jnp.exp(logits - m)
+    if causal:
+        p = jnp.where(mask, p, 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, vf)
+    denom = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    return (out / denom).astype(q.dtype)
 
 
 def _ring_flash(q, k, v, causal: bool, axis: str):

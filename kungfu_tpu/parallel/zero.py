@@ -543,9 +543,14 @@ class ZeroStep:
     """
 
     def __init__(self, loss_fn, inner, comm, stage: int, average: bool,
-                 donate: bool, bucket_bytes: int):
+                 donate: bool, bucket_bytes: int, schedule: str = "lax"):
         if stage not in (1, 2, 3):
             raise ValueError(f"ZeRO stage must be 1, 2 or 3, got {stage}")
+        from kungfu_tpu.ops.schedules import FLAT_SCHEDULES
+
+        if schedule not in FLAT_SCHEDULES:
+            raise ValueError(
+                f"unknown schedule {schedule!r}; one of {FLAT_SCHEDULES}")
         self.stage = stage
         self.comm = comm
         self._loss_fn = loss_fn
@@ -553,6 +558,10 @@ class ZeroStep:
         self._average = average
         self._donate = donate
         self._bucket_bytes = int(bucket_bytes)
+        #: flat-collective schedule compiled into the bucket loops
+        #: ("lax" | "pallas_ring"); the shard GEOMETRY is identical
+        #: either way, so snapshots/re-shards are schedule-agnostic
+        self._schedule = schedule
         self._cache = {}
         self._g3 = None  # stage-3 active geometry (set by init_params)
 
@@ -668,7 +677,8 @@ class ZeroStep:
                         g, (geo.my_offset(),), (chunk,))
                 else:
                     g_shard = reduce_scatter_flat(
-                        g, geo.scatter_axes, chunk, geo.widths)
+                        g, geo.scatter_axes, chunk, geo.widths,
+                        schedule=self._schedule)
                 if average:
                     g_shard = g_shard / n
                 p_shard = lax.dynamic_slice(
@@ -714,7 +724,8 @@ class ZeroStep:
                 # XLA hold every gathered slab live at once — values
                 # bitwise identical (tests/test_schedules.py pins it)
                 full = all_gather_flat(ps, geo.scatter_axes, geo.widths,
-                                       prefetch=True)
+                                       prefetch=True,
+                                       schedule=self._schedule)
                 return loss_fn(defuse(full[:total], geo.spec), batch)
 
             loss, g_shard = jax.value_and_grad(loss_of)(p_loc)
@@ -742,7 +753,8 @@ class ZeroStep:
 def zero_train_step(loss_fn, inner: optax.GradientTransformation, comm,
                     stage: int = 2, average: bool = True,
                     donate: bool = False,
-                    bucket_bytes: int = 4 << 20) -> ZeroStep:
+                    bucket_bytes: int = 4 << 20,
+                    schedule: str = "lax") -> ZeroStep:
     """Build a staged ZeRO data-parallel training step over ``comm``.
 
     ``stage``: 1 = all-reduce grads + sharded update (the classic ZeRO-1
@@ -759,9 +771,16 @@ def zero_train_step(loss_fn, inner: optax.GradientTransformation, comm,
     :func:`zero_snapshot` / :func:`zero_restore` / :func:`zero_reshard` /
     :func:`zero_reshard_p2p` apply unchanged (stage 3's parameter shard
     is re-carved by the same machinery — it is just one more flat
-    state vector)."""
+    state vector).
+
+    ``schedule`` selects the bucket collectives' implementation:
+    ``"lax"`` (default — ``psum_scatter``/``all_gather`` primitives) or
+    ``"pallas_ring"`` (the in-kernel-overlap ICI ring kernels of
+    :mod:`kungfu_tpu.ops.pallas.collectives`; the stage-3 gather's
+    custom vjp keeps the transposed gradient reduce-scatter).  The
+    sharded state geometry is identical either way."""
     return ZeroStep(loss_fn, inner, comm, stage, average, donate,
-                    bucket_bytes)
+                    bucket_bytes, schedule)
 
 
 def zero_comm_bytes(total_params: int, n: int, stage: int,

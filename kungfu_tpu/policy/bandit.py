@@ -7,8 +7,9 @@ interference votes.  This module is the decision core of the TPU-native
 version: a UCB1-style bandit whose **arms are collective strategies**
 (host-plane :class:`~kungfu_tpu.plan.strategy.Strategy` graphs + the
 measured-latency MST tree, or device-plane allreduce schedules
-``psum``/``two_stage``/``ring``) and whose **reward is measured window
-latency** (lower is better).  PAPERS.md 2011.03641 (the best collective
+``psum``/``two_stage``/``ring``/``pallas_ring`` — the last being the
+in-kernel-overlap ICI ring of :mod:`kungfu_tpu.ops.pallas.collectives`)
+and whose **reward is measured window latency** (lower is better).  PAPERS.md 2011.03641 (the best collective
 schedule shifts with scale and payload) and 1909.09756 (report
 adaptation as measured curves, not assumptions) are why the winner is
 measured per regime, online, instead of fixed at startup.

@@ -181,6 +181,9 @@ Kernel / model / data selection envs:
                                (models/nn.py)
 ``KF_PALLAS_BWD``              "pallas" forces the pallas backward kernels
                                even under interpret mode (ops/pallas)
+``KF_PALLAS_COLLECTIVES``      ring-collective impl: "auto"|"pallas"|"lax"
+                               (ops/pallas/collectives.py; launch-set,
+                               read at import)
 ``KF_XENT_FWD_MIN_ELEMENTS``   min logits elements before the fused xent
                                forward engages (ops/pallas/xent.py)
 ``KF_XENT_XLA_BUDGET_MB``      logits-bytes budget under which plain XLA
@@ -203,6 +206,50 @@ from kungfu_tpu.plan.cluster import Cluster
 from kungfu_tpu.plan.peer import PeerID, parse_peer_id
 from kungfu_tpu.plan.peerlist import PeerList
 from kungfu_tpu.plan.strategy import Strategy, parse_strategy
+
+#: launch-set knob objects (import-time env reads with an explicit
+#: ``reload()`` — the recompile-hazard hoist pattern of ops/pallas):
+#: every instance registers here so tooling that mutates the
+#: environment (tests above all) can re-read ALL of them without
+#: enumerating modules by hand
+LAUNCH_KNOBS: list = []
+
+
+def register_launch_knobs(knobs):
+    """Track a reload()-able launch-knob object; returns it."""
+    LAUNCH_KNOBS.append(knobs)
+    return knobs
+
+
+class LaunchKnobs:
+    """Base for a set of launch-set env knobs: subclasses implement
+    ``_read(self)`` — read ``os.environ``, validate loudly (ValueError
+    on a typo beats silently mis-routing), assign attributes.  The env
+    is read at CONSTRUCTION (import time) and on explicit
+    :meth:`reload`, never at trace time — the recompile-hazard hoist —
+    and every instance auto-registers for :func:`reload_launch_knobs`
+    so tooling that mutates the environment can re-read all knobs
+    without enumerating modules."""
+
+    def __init__(self):
+        self._read()
+        register_launch_knobs(self)
+
+    def reload(self):
+        """Re-read the current environment; returns self."""
+        self._read()
+        return self
+
+    def _read(self) -> None:
+        raise NotImplementedError
+
+
+def reload_launch_knobs() -> None:
+    """Re-read every registered launch-set knob from the current
+    environment (test teardowns; config tools)."""
+    for k in LAUNCH_KNOBS:
+        k.reload()
+
 
 # bootstrap envs
 SELF_SPEC = "KF_SELF_SPEC"

@@ -108,6 +108,19 @@ if ! timeout -k 10 150 python3 examples/overlap_pipeline.py \
     fail=1
 fi
 
+echo "== pallas-check (ICI ring kernels bitwise vs the lax references)"
+# the make pallas-check gate: interpreter-path kernels pinned bitwise
+# against the order-matched lax emulation and the psum_scatter/
+# all_gather references (docs/pallas_collectives.md).  Bounded: a hung
+# interpret kernel must fail the gate, not wedge it.
+if ! timeout -k 10 420 env JAX_PLATFORMS=cpu python3 -m pytest \
+        tests/test_pallas_collectives.py -q -m 'not slow' \
+        -p no:cacheprovider > /tmp/_kf_pallas_check.log 2>&1; then
+    echo "ERROR: pallas collectives bitwise suite failed"
+    tail -20 /tmp/_kf_pallas_check.log || true
+    fail=1
+fi
+
 echo "== compileall"
 if ! python3 -m compileall -q kungfu_tpu scripts benchmarks examples tests; then
     fail=1

@@ -31,6 +31,7 @@ run() { # name timeout cmd...
 
 run headline   1800 python bench.py
 run kernels    1500 python bench.py --kernels
+run pallas     1500 python bench.py --pallas
 run xent_cross 1800 python benchmarks/xent_sweep.py --crossover
 run bn_sweep   1800 python benchmarks/bn_sweep.py
 run longctx    1500 python bench.py --kernels --seq-len 8192
@@ -38,6 +39,9 @@ run lm         1500 python bench.py --lm
 
 log "done; fold the results into BENCH_extra.json + docs/perf.md:"
 log " - headline/kernels/lm replace the matching BENCH_extra sections"
+log " - pallas: the compiled-kernel device rows replace the"
+log "   pallas_collectives section's CPU-mesh carry-forward; any failed"
+log "   checks{} entry blocks promotion (docs/pallas_collectives.md)"
 log " - xent_cross: any route_correct=false row -> adjust _route_fused"
 log "   thresholds (ops/pallas/xent.py) and re-run"
 log " - bn_sweep: if a variant beats prod at full shape, promote it in"

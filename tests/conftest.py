@@ -18,3 +18,19 @@ os.environ["XLA_FLAGS"] = (
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _reload_launch_knobs():
+    """Launch-set knobs (KF_TPU_XENT, KF_PALLAS_COLLECTIVES, ...) are
+    read at import, not at trace time (recompile-hazard hoist): tests
+    that monkeypatch them call ``.reload()`` themselves; this teardown
+    re-reads the restored environment through the shared registry so a
+    mutation can never leak into the next test."""
+    yield
+    import kungfu_tpu.ops.pallas  # noqa: F401 — registers its knobs
+    from kungfu_tpu.utils.envs import reload_launch_knobs
+
+    reload_launch_knobs()

@@ -580,3 +580,132 @@ class TestHostPoolScaling:
                                   pool="p2p").value == 3
         finally:
             stop()
+
+
+class TestPallasRingArm:
+    """ISSUE 12: ``pallas_ring`` — the in-kernel-overlap ICI ring of
+    ``ops/pallas/collectives.py`` — as a first-class device-bandit arm:
+    default arm set, per-bucket install through the consensus-fenced
+    lockstep swap, and the reset-on-resize contract."""
+
+    def test_pallas_ring_in_default_arm_set(self):
+        import jax
+
+        from kungfu_tpu.comm.device import Communicator
+        from kungfu_tpu.monitor.adapt_device import DeviceBanditDriver
+
+        comm = Communicator(devices=jax.devices()[:4], local_size=4)
+        d = DeviceBanditDriver(comm, check_every=2)
+        assert "pallas_ring" in d.table.arms
+        comm.set_latency_hook(None)
+
+    def test_pallas_ring_installs_per_bucket(self):
+        """Synthetic latencies make pallas_ring the measured winner of
+        the LARGE bucket only: the driver installs it there via
+        set_bucket_strategy and leaves the small bucket alone."""
+        import jax
+
+        from kungfu_tpu.comm.device import Communicator
+        from kungfu_tpu.monitor.adapt_device import DeviceBanditDriver
+
+        comm = Communicator(devices=jax.devices()[:4], local_size=4)
+        d = DeviceBanditDriver(comm, check_every=1, min_pulls=1)
+        lat = {"psum": 0.05, "two_stage": 0.04, "ring": 0.06,
+               "pallas_ring": 0.001}
+        small, large = 1 << 10, 1 << 20
+        for _ in range(12):
+            # both buckets measure every arm: pallas_ring wins the
+            # large payloads, psum the latency-bound small ones
+            for arm, t in lat.items():
+                d._on_collective(large, arm, t)
+                d._on_collective(small, arm,
+                                 0.0001 if arm == "psum" else 0.01)
+            d.step()
+        assert comm.strategy_for_bucket(1) == "pallas_ring"
+        assert d.table.active[1] == "pallas_ring"
+        assert comm.strategy_for_bucket(0) == "psum"
+        # the installed arm really routes: a large eager collective now
+        # compiles the pallas_ring schedule (cache key carries it)
+        x = np.random.default_rng(0).standard_normal((4, large // 4)) \
+            .astype(np.float32)
+        out = np.asarray(comm.all_reduce(x))
+        np.testing.assert_allclose(
+            out, np.broadcast_to(x.sum(0), x.shape), rtol=1e-4, atol=1e-4)
+        assert any(k[-1] == "pallas_ring" for k in comm._fns
+                   if k[0] == "ar"), list(comm._fns)
+        comm.set_latency_hook(None)
+
+    def test_fenced_lockstep_install_across_ranks(self, monkeypatch):
+        """3-rank in-process cluster, each rank owning its own device
+        communicator + driver: identical window exchanges must install
+        pallas_ring on EVERY rank at the same seq, through the
+        consensus_bytes digest + barrier fence."""
+        import jax
+
+        from kungfu_tpu.comm.device import Communicator
+        from kungfu_tpu.monitor.adapt_device import DeviceBanditDriver
+
+        monkeypatch.setenv("KF_NATIVE_ENGINE", "0")
+        peers = _make_peers(27561)
+        try:
+            comms = [Communicator(devices=jax.devices()[:4], local_size=4)
+                     for _ in peers]
+            drivers = [DeviceBanditDriver(c, peer=p, check_every=2,
+                                          min_pulls=1)
+                       for c, p in zip(comms, peers)]
+
+            def one(rank, d):
+                # rank-skewed locals (only the allreduced window can
+                # agree), pallas_ring clearly fastest on large payloads
+                skew = 1 + 0.3 * rank
+                for arm, t in (("psum", 0.05), ("two_stage", 0.04),
+                               ("ring", 0.06), ("pallas_ring", 0.002)):
+                    d._on_collective(1 << 20, arm, t * skew)
+                return d.step()
+
+            for step in range(10):
+                flags = run_all([
+                    lambda r=r, d=d: one(r, d)
+                    for r, d in enumerate(drivers)
+                ], timeout=120)
+                assert len(set(flags)) == 1, f"non-lockstep at {step}"
+            installed = {c.strategy_for_bucket(1) for c in comms}
+            assert installed == {"pallas_ring"}, installed
+            seqs = {d._seq for d in drivers}
+            assert len(seqs) == 1
+        finally:
+            for p in peers:
+                p.close()
+
+    def test_reset_on_live_resize(self):
+        """A mesh-epoch rebuild (the resize simulation the strategy
+        tests use: retire the communicator, bump the version) rebinds
+        the driver, zeroes every bucket table, and drops the installed
+        pallas_ring override — a new membership is a new regime."""
+        from kungfu_tpu.peer import Peer
+        from kungfu_tpu.monitor.adapt_device import DeviceBanditDriver
+        from kungfu_tpu.utils import envs as E
+
+        peer = Peer(config=E.parse_config_from_env({}))
+        comm0 = peer.communicator()
+        d = DeviceBanditDriver(comm0, peer=peer, check_every=1,
+                               min_pulls=1)
+        for _ in range(6):
+            for arm, t in (("psum", 0.05), ("two_stage", 0.04),
+                           ("ring", 0.06), ("pallas_ring", 0.001)):
+                d._on_collective(1 << 20, arm, t)
+            d.step()
+        assert comm0.strategy_for_bucket(1) == "pallas_ring"
+        assert sum(d.table.tables[1].counts) > 0
+        with peer._lock:
+            peer._retire_comm()
+        peer.cluster_version += 1
+        d.step()  # detects the version move and rebinds
+        comm1 = peer.communicator()
+        assert d.comm is comm1 and comm1 is not comm0
+        # re-explore from scratch on the new epoch: table zeroed, no
+        # bucket override carried (deliberately NOT persisted — the
+        # bandit must re-measure the new regime)
+        assert sum(sum(t.counts) for t in d.table.tables) == 0
+        assert comm1.bucket_strategies() == {}
+        assert d.table.active[1] == comm1.strategy_for_bucket(1)

@@ -257,9 +257,13 @@ class TestFusedCrossEntropy:
         params = model.init(jax.random.PRNGKey(0))
         data = np.random.default_rng(0).integers(0, 256, size=(2, 33))
         batch = (jnp.asarray(data[:, :-1], jnp.int32), jnp.asarray(data[:, 1:], jnp.int32))
+        from kungfu_tpu.ops.pallas.xent import XENT_ENV
+
         monkeypatch.setenv("KF_TPU_XENT", "xla")
+        XENT_ENV.reload()
         ref = model.loss(params, batch)
         monkeypatch.setenv("KF_TPU_XENT", "fused")
+        XENT_ENV.reload()
         got = model.loss(params, batch)
         np.testing.assert_allclose(float(ref), float(got), atol=1e-5)
 
@@ -288,11 +292,18 @@ class TestXentRouting:
         assert _route_fused(128, 1024, 4, training=False) is False
 
     def test_env_budget_override(self, monkeypatch):
-        from kungfu_tpu.ops.pallas.xent import _route_fused
+        """The knobs are launch-set (read at import — the
+        recompile-hazard hoist): env mutations take effect through
+        ``XENT_ENV.reload()``, never at trace time."""
+        from kungfu_tpu.ops.pallas.xent import XENT_ENV, _route_fused
 
         monkeypatch.setenv("KF_XENT_XLA_BUDGET_MB", "1")
+        XENT_ENV.reload()
         assert _route_fused(1024, 1024, 2, training=True) is True
         monkeypatch.setenv("KF_XENT_XLA_BUDGET_MB", "1048576")
+        # without a reload the mutation is invisible — launch-set for real
+        assert _route_fused(1024, 1024, 2, training=True) is True
+        XENT_ENV.reload()
         assert _route_fused(16384, 50304, 2, training=True) is False
 
     def test_forced_modes_bypass_routing(self, monkeypatch):
@@ -304,8 +315,10 @@ class TestXentRouting:
             np.random.default_rng(0).standard_normal((4, 64)), jnp.float32)
         targets = jnp.asarray([1, 2, 3, 4], jnp.int32)
         monkeypatch.setenv("KF_TPU_XENT", "plain")
+        X.XENT_ENV.reload()
         ref = float(X.token_nll(logits, targets))
         monkeypatch.setenv("KF_TPU_XENT", "fused")
+        X.XENT_ENV.reload()
         got = float(X.token_nll(logits, targets))
         np.testing.assert_allclose(ref, got, atol=1e-5)
 

@@ -197,15 +197,20 @@ def test_sharded_loss_fused_xent_matches(monkeypatch):
     """KF_TPU_XENT=fused routes the sharded head through the Pallas
     kernel (interpret mode off-TPU); the loss must match the plain
     log_softmax path — both per-stage masking and the mean reduction."""
+    from kungfu_tpu.ops.pallas.xent import XENT_ENV
+
     monkeypatch.setenv("KF_TPU_XENT", "fused")
+    XENT_ENV.reload()
     plan = MeshPlan(dp=2, pp=2, sp=1, tp=2)
     cfg = TransformerConfig(**CFG)
     model = Transformer(cfg)
     tparams = model.init(jax.random.PRNGKey(0))
     batch = _batch()
     monkeypatch.setenv("KF_TPU_XENT", "plain")
+    XENT_ENV.reload()
     ref_loss = model.loss(tparams, batch, train=False)
     monkeypatch.setenv("KF_TPU_XENT", "fused")
+    XENT_ENV.reload()
 
     trainer = ShardedTrainer(cfg, plan, n_micro=2)
     params = trainer.from_transformer_params(tparams)
