@@ -6,7 +6,7 @@ PY ?= python3
 BASELINE := tests/lint_baseline.json
 
 .PHONY: lint verify shardcheck pallas-check check test native trace-demo \
-    zero-demo multislice-demo adapt-demo overlap-demo help
+    zero-demo multislice-demo adapt-demo overlap-demo serve-demo help
 
 ## lint: all fourteen kf-lint rules — the Python suite (env-contract,
 ## jit-sync, blocking-io, retry-discipline, handle-discipline,
@@ -103,6 +103,18 @@ multislice-demo:
 ## is `python bench.py --adapt`, recorded in BENCH_extra.json).
 adapt-demo:
 	$(PY) examples/adapt_interference.py
+
+## serve-demo: kf-serve fault drill (3 in-process serving workers + a
+## router over real host channels): a steady request stream while chaos
+## kills worker 1 at its 10th decode iteration — the router's
+## progress-deadline ladder excludes it and replays its in-flight
+## requests from their committed positions on the survivors.  Asserts
+## zero lost accepted requests, >=1 replay, replayed tokens equal to
+## the greedy reference, and measured prefix reuse (docs/serving.md;
+## the full SLO A/B incl. a slice kill is `python bench.py --serve`,
+## recorded in BENCH_extra.json).
+serve-demo:
+	$(PY) examples/serve_demo.py
 
 ## overlap-demo: kf-overlap A/B (3 in-process ranks, chaos `delay`
 ## injecting 25 ms wire latency on every send): the ZeRO-2 bucket loop

@@ -1866,6 +1866,236 @@ def payload_pallas(args) -> dict:
     }
 
 
+def payload_serve(args) -> dict:
+    """kf-serve SLO row (ISSUE 13 gate): a 7-peer in-process deployment
+    — 6 continuous-batching serving workers over 3 emulated 2-rank
+    slices + 1 router — takes a FIXED offered load (one request per
+    50 ms, shared 16-token system prompt, 24 new tokens each) while the
+    chaos layer kills one worker mid-decode (``die``) and later a whole
+    slice (``die_slice``).  The router's progress-deadline ladder
+    excludes the victims at slice grain and replays their in-flight
+    requests from the committed decode positions on survivors.
+
+    Measured: p50/p99 e2e latency per phase — before / during / after
+    each kill, where "during" = requests whose lifetime overlaps the
+    kill-to-recovery window — with the gate p99(after) <= 2 x p99(pre)
+    and ZERO lost accepted requests; plus the prefix-reuse prefill
+    delta (computed tokens vs the no-cache prefill cost) and the
+    kf_kv_cache_bytes -> aggregator-snapshot -> serving-rollup flow.
+
+    Decode cadence is pinned at 10 ms/step (ServeWorker.step_period_s):
+    the toy transformer's sub-ms CPU steps would make every latency
+    queue-free noise — the row measures latency STRUCTURE under
+    failure, like every other tunnel-proof CPU-mesh row measures
+    protocol structure, not chip speed."""
+    import os
+    import time as _time
+
+    import numpy as np
+
+    os.environ["KF_NATIVE_ENGINE"] = "0"   # chaos rides the py path
+    os.environ.setdefault("KF_CONFIG_LOG_LEVEL", "WARNING")
+    os.environ["KF_TPU_HOST_TRANSPORT"] = "python"
+    # worker rank 1 dies alone; slice 1 (worker ranks 2,3) dies whole.
+    # step = the worker's decode iteration (10 ms cadence), so the kills
+    # land ~2.5 s and ~6 s into the loaded run
+    os.environ["KF_CHAOS_SPEC"] = (
+        "die:rank=1,step=250,mode=raise;"
+        "die_slice:slice=1,step=600,mode=raise,rps=2")
+
+    import jax
+
+    from kungfu_tpu.elastic.slices import SliceTopology
+    from kungfu_tpu.models.transformer import Transformer, TransformerConfig
+    from kungfu_tpu.monitor.aggregator import (ClusterAggregator,
+                                               RankReporter, field)
+    from kungfu_tpu.monitor.registry import REGISTRY
+    from kungfu_tpu.peer import Peer
+    from kungfu_tpu.plan import Cluster, PeerList
+    from kungfu_tpu.serve.engine import InferenceEngine
+    from kungfu_tpu.serve.kvcache import KVCachePool, PageSpec
+    from kungfu_tpu.serve.router import ServeRouter, ServeWorker
+    from kungfu_tpu.utils.envs import Config
+
+    quick = bool(args.quick)
+    period_s = 0.05                      # offered load: 20 req/s
+    step_period_s = 0.010                # pinned decode cadence
+    new_tokens = 24
+    load_seconds = 6.0 if quick else 12.0
+    base_port = 24910
+
+    cfg = TransformerConfig(vocab_size=96, d_model=32, n_layers=2,
+                            n_heads=2, d_ff=64, max_seq=128,
+                            dtype="float32")
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    system_prompt = list(range(1, 17))   # 2 full 8-token pages shared
+
+    workers = PeerList.parse(
+        ",".join(f"127.0.0.1:{base_port + i}" for i in range(7)))
+    runners = PeerList.parse(f"127.0.0.1:{base_port + 99}")
+    cluster = Cluster(runners, workers)
+    peers = [Peer(Config(self_id=w, cluster=cluster)) for w in workers]
+    for p in peers:
+        p.start()
+    servers = []
+    for p in peers[:6]:
+        eng = InferenceEngine(
+            model, params,
+            pool=KVCachePool(PageSpec.for_model(cfg, page_tokens=8), 256),
+            max_batch=4, max_seq=cfg.max_seq, rank=p.chaos_rank())
+        eng.warmup(prompt_lens=(len(system_prompt) + 4,))
+        servers.append(ServeWorker(p, eng, commit_every=4,
+                                   step_period_s=step_period_s).start())
+    router = ServeRouter(peers[6], worker_ranks=list(range(6)),
+                         queue_depth=512, deadline_s=2.0, strike_limit=2,
+                         topology=SliceTopology(3, 2))
+
+    # recovery observer: samples the victim flags + the router's dead
+    # set so kill/recovery walls come from the OBSERVED ladder, not from
+    # guessed chaos timing
+    marks = {}
+    stop_poll = [False]
+
+    def poll():
+        while not stop_poll[0]:
+            t = _time.perf_counter()
+            if "k1" not in marks and servers[1].dead:
+                marks["k1"] = t
+            if "r1" not in marks and 1 in router.dead_workers:
+                marks["r1"] = t
+            if "k2" not in marks and (servers[2].dead or servers[3].dead):
+                marks["k2"] = t
+            if "r2" not in marks and {2, 3} <= set(router.dead_workers):
+                marks["r2"] = t
+            _time.sleep(0.005)
+
+    import threading
+
+    poller = threading.Thread(target=poll, daemon=True)
+    poller.start()
+
+    handles = []
+    t_start = _time.perf_counter()
+    i = 0
+    while _time.perf_counter() - t_start < load_seconds:
+        handles.append(router.submit(system_prompt + [20 + (i % 70)],
+                                     new_tokens))
+        i += 1
+        _time.sleep(period_s)
+    outs = [h.wait(120) for h in handles]
+    stop_poll[0] = True
+    poller.join(1.0)
+
+    lost = sum(1 for o in outs if len(o) != new_tokens)
+    k1, r1 = marks.get("k1"), marks.get("r1")
+    k2, r2 = marks.get("k2"), marks.get("r2")
+
+    def overlaps(h, lo, hi):
+        return lo is not None and hi is not None \
+            and h.submitted_s <= hi and h.done_s >= lo
+
+    phases = {"pre": [], "during_worker_kill": [], "between": [],
+              "during_slice_kill": [], "after": []}
+    for h in handles:
+        e2e = h.done_s - h.submitted_s
+        if overlaps(h, k1, r1):
+            phases["during_worker_kill"].append(e2e)
+        elif overlaps(h, k2, r2):
+            phases["during_slice_kill"].append(e2e)
+        elif k1 is not None and h.done_s < k1:
+            phases["pre"].append(e2e)
+        elif r2 is not None and h.submitted_s > r2:
+            phases["after"].append(e2e)
+        else:
+            phases["between"].append(e2e)
+
+    def pct(xs, q):
+        return float(np.percentile(np.asarray(xs), q)) if xs else None
+
+    rows = {
+        name: {"n": len(xs),
+               "p50_ms": round(pct(xs, 50) * 1e3, 2) if xs else None,
+               "p99_ms": round(pct(xs, 99) * 1e3, 2) if xs else None}
+        for name, xs in phases.items()
+    }
+    p99_pre = pct(phases["pre"], 99)
+    p99_after = pct(phases["after"], 99)
+    recovery_ratio = (p99_after / p99_pre
+                      if p99_pre and p99_after else None)
+
+    # prefix reuse: without the paged cache every admission prefills its
+    # whole prompt; with it, only the un-cached suffix computes
+    reused = REGISTRY.counter("kf_serve_prefill_tokens_total",
+                              what="reused").value
+    computed = REGISTRY.counter("kf_serve_prefill_tokens_total",
+                                what="computed").value
+    naive = sum(len(h.prompt) for h in handles) \
+        + sum(len(h.committed) for h in handles)  # replays re-prefill too
+
+    # observability flow: the kv gauge + serve counters must ride a real
+    # snapshot into the aggregator's serving rollup (the kftop view)
+    rep = RankReporter(rank=0, server_url="http://127.0.0.1:1",
+                       slice_id=None)
+    agg = ClusterAggregator(stale_after=60.0)
+    agg.ingest(rep.snapshot_once())
+    srv = field(agg.cluster_view(), "serving")
+    kv_flow = bool(srv) and field(srv, "kv_bytes") >= 0 \
+        and field(srv, "completed") > 0
+
+    router.close()
+    for s in servers:
+        if not s.dead:
+            s.stop()
+    for p in peers:
+        try:
+            p.close()
+        except Exception:  # noqa: BLE001 — victims already closed
+            pass
+
+    checks = {
+        "zero_lost_accepted_requests": lost == 0,
+        "worker_kill_observed": k1 is not None and r1 is not None,
+        "slice_kill_observed": k2 is not None and r2 is not None,
+        "slice_excluded_whole": {2, 3} <= set(router.dead_workers),
+        "replays_happened": router.replayed >= 1,
+        "recovery_within_2x": (recovery_ratio is not None
+                               and recovery_ratio <= 2.0),
+        "prefix_reuse_engaged": reused > 0 and computed < naive,
+        "kv_gauge_flows_to_cluster_view": kv_flow,
+    }
+    return {
+        "metric": "serve_slo_p99_recovery_ratio_post_vs_pre",
+        "value": round(recovery_ratio, 3) if recovery_ratio else 0.0,
+        "unit": "x",
+        "vs_baseline": round(recovery_ratio, 3) if recovery_ratio else 0.0,
+        "vs_baseline_meaning": ("post-kill p99 over pre-kill p99 at fixed "
+                                "offered load (gate: <= 2.0)"),
+        "n_devices": 6,
+        "platform": "cpu-hostplane",
+        "model": (f"6 serve workers (3x2-rank slices) + router, 20 req/s "
+                  f"offered, {new_tokens} tokens/req, 10 ms decode "
+                  "cadence, worker kill @ step 250 + slice kill @ 600"),
+        "rows": {
+            "phases": rows,
+            "requests": {"accepted": len(handles), "lost": lost,
+                         "completed": router.completed,
+                         "replayed": router.replayed,
+                         "dead_workers": router.dead_workers},
+            "prefill_tokens": {"computed": int(computed),
+                               "reused": int(reused),
+                               "no_cache_cost": int(naive)},
+        },
+        "checks": checks,
+        "note": ("tunnel-proof CPU-mesh SLO row: the chaos `die` kill "
+                 "excludes the victim's slice (training-ladder "
+                 "semantics), the `die_slice` kill removes slice 1 "
+                 "whole, and every in-flight request replays from its "
+                 "last committed decode position — greedy decode makes "
+                 "the replayed continuation deterministic"),
+    }
+
+
 PAYLOADS = {
     "resnet": payload_resnet,
     "kernels": payload_kernels,
@@ -1876,6 +2106,7 @@ PAYLOADS = {
     "adapt": payload_adapt,
     "overlap": payload_overlap,
     "pallas": payload_pallas,
+    "serve": payload_serve,
 }
 
 
@@ -1914,6 +2145,11 @@ def main() -> None:
                         "ZeRO-2/3 bucket loops under injected wire "
                         "latency, plus the bare shard_map+psum row "
                         "(host-plane CPU; tunnel-proof)")
+    p.add_argument("--serve", action="store_true",
+                   help="kf-serve SLO row: p50/p99 e2e at fixed offered "
+                        "load before/during/after a chaos worker kill "
+                        "AND a slice kill, with replay-from-committed "
+                        "recovery (host-plane CPU; tunnel-proof)")
     p.add_argument("--pallas", action="store_true",
                    help="Pallas ICI ring collectives: interpret-kernel "
                         "bitwise A/B vs the lax references + traced-"
@@ -1934,6 +2170,7 @@ def main() -> None:
              else "multislice" if args.multislice
              else "adapt" if args.adapt
              else "overlap" if args.overlap
+             else "serve" if args.serve
              else "pallas" if args.pallas else "resnet")
     pallas_tpu = False
     if which == "pallas" and not args.cpu and not args.cpu_mesh:
@@ -1970,7 +2207,7 @@ def main() -> None:
     # veto measurements.
     pre_err = backend_preflight(
         cpu=args.cpu or bool(args.cpu_mesh)
-        or which in ("multislice", "adapt", "overlap")
+        or which in ("multislice", "adapt", "overlap", "serve")
         or pallas_tpu)
     if pre_err is None:
         out = run_guarded(fwd, timeout=args.timeout)
@@ -2030,6 +2267,8 @@ def main() -> None:
                         "overlap_cpu_mesh"),
             "pallas": ("pallas_ring_bitwise_and_parity_gate", "pass",
                        "pallas_collectives"),
+            "serve": ("serve_slo_p99_recovery_ratio_post_vs_pre", "x",
+                      "serve_slo_cpu_mesh"),
         }
         metric, unit, section = payload_info[which]
         out = {

@@ -39,10 +39,16 @@ from kungfu_tpu.analysis.core import (
 
 CHECKER = "blocking-io"
 
-#: modules whose handlers run on background threads owned elsewhere
+#: modules whose handlers run on background threads owned elsewhere.
+#: The serve modules spawn threads today (auto-detected), but their
+#: channel handlers ALSO run on the host channel's receive threads —
+#: pinned here so a refactor that moves the spawns out cannot silently
+#: drop the rule from the serving plane
 EXTRA_THREAD_MODULES = {
     "kungfu_tpu/comm/engine.py",
     "kungfu_tpu/runner/watch.py",
+    "kungfu_tpu/serve/engine.py",
+    "kungfu_tpu/serve/router.py",
 }
 
 _SUBPROCESS_FNS = {"run", "check_output", "check_call"}

@@ -55,6 +55,10 @@ _FENCE_CALLS = {
     "elastic_step", "shrink_to_survivors", "recover_from_peer_failure",
     "recover_from_failure", "propose_new_size", "resize_cluster",
     "resize_cluster_from_url", "_propose",
+    # the serving plane's membership boundary (kf-serve): excluding a
+    # worker/slice re-dispatches its in-flight requests — a live async
+    # handle must not straddle that either
+    "mark_worker_dead",
 }
 
 _WAIT_ATTRS = {"wait"}

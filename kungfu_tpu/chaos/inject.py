@@ -151,6 +151,34 @@ class ChaosController:
             if c.kind == "delay" and c.get("on") == "ping":
                 self._maybe_delay(ci, c, to_rank)
 
+    def on_serve_request(self, rid: str) -> bool:
+        """Serving request-path hook (kf-serve worker handler).  Applies
+        ``delay:on=serve`` stragglers, then ``drop_request``: True = the
+        frame is lost (the worker must ignore it; the router's deadline
+        ladder re-admits the request, docs/serving.md).  Deterministic:
+        counted in MATCHING requests, like every other clause."""
+        dropped = False
+        for ci, c in enumerate(self._clauses):
+            if c.kind == "delay" and c.get("on") == "serve":
+                self._maybe_delay(ci, c, -1)
+            elif c.kind == "drop_request":
+                with self._lock:
+                    n = self._matched[ci] = self._matched.get(ci, 0) + 1
+                if n % max(1, c.get("every", 1)) != 0:
+                    continue
+                budget = c.get("count")
+                if budget is not None:
+                    with self._lock:
+                        used = self._fanout_dropped.get(("req", ci), 0)
+                        if used >= budget:
+                            continue
+                        self._fanout_dropped[("req", ci)] = used + 1
+                _log.warning("chaos: dropping serve request %s", rid)
+                timeline.event("chaos", "drop_request", rank=self.rank,
+                               rid=rid)
+                dropped = True
+        return dropped
+
     def on_recv(self, from_rank: int, name: str) -> None:
         """Engine receive hook (``delay:on=recv`` stragglers)."""
         with self._lock:

@@ -50,7 +50,17 @@ without scoping a clause applies everywhere):
     ``every=K`` delays only every Kth matching send (default 1 = all);
     ``on=recv`` delays the receive side instead, ``on=ping`` the
     latency-probe pings (``get_peer_latencies``) — a throttled link
-    must look slow to the MST re-carve, not just to the data path.
+    must look slow to the MST re-carve, not just to the data path —
+    and ``on=serve`` the serving request path (the worker straggles
+    ``ms`` before admitting each matching request, kf-serve).
+``drop_request``
+    The serving plane loses an incoming request frame: this rank's
+    serve handler silently discards every matching request
+    (``every=K`` strides over matching requests, ``count=N`` bounds
+    the total dropped; both default to all) — the router's per-request
+    deadline then re-admits it elsewhere, exactly the lost-frame /
+    half-open-connection failure the strike ladder exists for
+    (docs/serving.md).
 ``drop_fanout``
     The failure detector's cross-host fan-out silently loses its POST to
     ``host=H`` (absent = every host); ``count=N`` drops only the first N
@@ -70,7 +80,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-KINDS = ("die", "die_slice", "reset", "delay", "drop_fanout", "config_down")
+KINDS = ("die", "die_slice", "reset", "delay", "drop_fanout",
+         "drop_request", "config_down")
 
 _INT_PARAMS = {
     "rank", "step", "coll", "send", "peer", "every", "count", "after",
@@ -84,6 +95,7 @@ _ALLOWED = {
     "reset": {"rank", "send", "peer"},
     "delay": {"rank", "ms", "jitter", "peer", "every", "on"},
     "drop_fanout": {"host", "count"},
+    "drop_request": {"rank", "count", "every"},
     "config_down": {"rank", "after", "count"},
 }
 
@@ -140,9 +152,10 @@ def _parse_clause(text: str) -> Clause:
     if kind == "die_slice" and params.get("slice") is None:
         raise ValueError("die_slice needs slice=S (the slice to kill)")
     if kind == "delay" and params.get("on") not in (None, "send", "recv",
-                                                    "ping"):
+                                                    "ping", "serve"):
         raise ValueError(
-            f"delay on= must be send|recv|ping, got {params.get('on')!r}")
+            f"delay on= must be send|recv|ping|serve, got "
+            f"{params.get('on')!r}")
     return Clause(kind, tuple(sorted(params.items())))
 
 

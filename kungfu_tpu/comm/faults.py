@@ -70,6 +70,38 @@ class SliceExcludedError(RuntimeError):
         )
 
 
+class ServeOverloadError(RuntimeError):
+    """Typed admission rejection (kf-serve router): accepted-but-
+    unfinished requests already fill the bounded queue
+    (``KF_SERVE_QUEUE_DEPTH``).  Overload must surface as an immediate,
+    client-visible rejection the caller can back off on — not as an
+    unbounded queue whose tail latency quietly eats the e2e SLO
+    (docs/serving.md)."""
+
+    def __init__(self, depth: int, limit: int):
+        self.depth = depth
+        self.limit = limit
+        super().__init__(
+            f"serving queue at capacity ({depth}/{limit} accepted "
+            "requests in flight); rejecting admission"
+        )
+
+
+class RequestLostError(RuntimeError):
+    """A replayed serving request ran out of live workers (or replay
+    attempts): the router could not honor its zero-loss contract for
+    this request.  Carries the request id and the committed tokens so
+    the caller can resubmit without losing the paid-for prefix."""
+
+    def __init__(self, rid: str, committed, why: str = ""):
+        self.rid = rid
+        self.committed = list(committed)
+        super().__init__(
+            f"request {rid!r} lost after {len(self.committed)} committed "
+            f"token(s): {why or 'no live workers remain'}"
+        )
+
+
 class QuorumLostError(RuntimeError):
     """Shrink-to-survivors cannot proceed: the surviving set is not a
     strict majority of the current membership.  The caller's last resort

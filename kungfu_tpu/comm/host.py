@@ -76,6 +76,14 @@ USE_UNIXSOCK = "KF_TPU_USE_UNIXSOCK"
 #: default ceiling on the load-scaled pools (``KF_CONFIG_HOST_POOL_MAX``)
 HOST_POOL_CAP_DEFAULT = 16
 
+#: PEER_TO_PEER name space reserved for the serving plane (kf-serve
+#: request/progress/completion frames, serve/router.py).  Defined here —
+#: the transport layer both planes import — because the blob store's
+#: p2p handler must SKIP these names (its own responder would race a
+#: _FAIL reply onto a serve request id): one constant, two readers,
+#: zero drift (docs/serving.md)
+SERVE_NAME_PREFIX = "req.srv"
+
 
 def host_pool_size(n_peers: int, floor: int = 2,
                    pool: str = "host") -> int:

@@ -118,6 +118,11 @@ VIEW_FIELDS = frozenset({
     # skew-row subfields (monitor/skew.py row dicts)
     "op", "tag", "slowest_rank", "slowest_s", "fastest_rank",
     "fastest_s", "skew_s", "total_s",
+    # serving summary (kf-serve; None on deployments with no serve
+    # metrics): cluster-wide sums of the per-rank serve gauges/counters
+    # plus window-mean latencies from the pushed histogram deltas
+    "serving", "active", "queued", "kv_bytes", "completed", "rejected",
+    "replayed", "ttft_ms", "e2e_ms",
 })
 
 
@@ -175,6 +180,15 @@ def field(obj: dict, name: str, default=None):
     kflint rule) — so a typo'd field fails lint instead of silently
     rendering an empty ``kftop`` column."""
     return obj.get(name, default)
+
+
+def sum_metric(mapping: Optional[dict], name: str) -> float:
+    """Sum of a pushed counter/gauge over its label variants (the
+    registry renders ``kf_x_total{what="y"}`` per label set).  The ONE
+    implementation of the label-key match — the serving rollup here and
+    kftop's per-rank columns must never disagree on it."""
+    return sum(v for k, v in (mapping or {}).items()
+               if k == name or k.startswith(name + "{"))
 
 
 def control_event(kind: str, rank: Optional[int] = None, **attrs) -> dict:
@@ -299,6 +313,48 @@ class ClusterAggregator:
                 win.append(ev)
 
     # -- views -----------------------------------------------------------
+    @staticmethod
+    def _serving_summary(rows: List[dict]) -> Optional[dict]:
+        """Cluster-wide serving rollup from per-rank rows (the kf-serve
+        gauges/counters/histogram-deltas every snapshot already
+        carries); ``None`` when no rank serves, so a training-only
+        deployment renders no serving section."""
+
+        def gauge_sum(name: str) -> float:
+            return sum(sum_metric(row.get("gauges"), name) for row in rows)
+
+        def counter_sum(name: str, what: str) -> int:
+            sel = f'{name}{{what="{what}"}}'
+            return sum((row.get("counters") or {}).get(sel, 0)
+                       for row in rows)
+
+        def window_ms(hist: str) -> Optional[float]:
+            count = total = 0.0
+            for row in rows:
+                for k, d in (row.get("latency") or {}).items():
+                    if k == hist or k.startswith(hist + "{"):
+                        count += d.get("count", 0)
+                        total += d.get("sum", 0.0)
+            return (total / count * 1e3) if count else None
+
+        serving = any(
+            k.startswith(("kf_serve_", "kf_kv_cache_bytes"))
+            for row in rows
+            for k in list(row.get("gauges") or {})
+            + list(row.get("counters") or {}))
+        if not serving:
+            return None
+        return {
+            "active": int(gauge_sum("kf_serve_active_requests")),
+            "queued": int(gauge_sum("kf_serve_queue_depth")),
+            "kv_bytes": int(gauge_sum("kf_kv_cache_bytes")),
+            "completed": counter_sum("kf_serve_requests_total", "complete"),
+            "rejected": counter_sum("kf_serve_requests_total", "reject"),
+            "replayed": counter_sum("kf_serve_requests_total", "replay"),
+            "ttft_ms": window_ms("kf_serve_ttft_seconds"),
+            "e2e_ms": window_ms("kf_serve_e2e_seconds"),
+        }
+
     def _all_events(self) -> List[dict]:
         with self._lock:
             return [e for win in self._events.values() for e in win]
@@ -381,6 +437,7 @@ class ClusterAggregator:
             "stale": stale,
             "slices": slice_groups,
             "stale_slices": stale_slices,
+            "serving": self._serving_summary(rows),
             "skew": skewlib.skew_rows(events)[:top],
             "slowest_per_step": skewlib.slowest_rank_per_step(events)[-top:],
             "straggler": skewlib.straggler_verdict(events),
@@ -407,6 +464,22 @@ class ClusterAggregator:
                 "is stale (slice-loss signature)",
                 "# TYPE kf_cluster_stale_slices gauge",
                 f"kf_cluster_stale_slices {len(view['stale_slices'])}",
+            ]
+        if view["serving"]:
+            srv = view["serving"]
+            lines += [
+                "# HELP kf_cluster_serve_active decode slots occupied "
+                "across the serving deployment",
+                "# TYPE kf_cluster_serve_active gauge",
+                f"kf_cluster_serve_active {srv['active']}",
+                "# HELP kf_cluster_serve_queued accepted-but-unfinished "
+                "requests across routers",
+                "# TYPE kf_cluster_serve_queued gauge",
+                f"kf_cluster_serve_queued {srv['queued']}",
+                "# HELP kf_cluster_kv_cache_bytes paged KV-cache "
+                "footprint summed over serving ranks",
+                "# TYPE kf_cluster_kv_cache_bytes gauge",
+                f"kf_cluster_kv_cache_bytes {srv['kv_bytes']}",
             ]
         version = (view["cluster"] or {}).get("version")
         if version is not None:

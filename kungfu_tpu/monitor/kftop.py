@@ -42,6 +42,7 @@ from kungfu_tpu.monitor.aggregator import (
     field,
     make_snapshot,
     server_base,
+    sum_metric,
 )
 
 DEFAULT_SERVER = "http://127.0.0.1:9100"
@@ -74,11 +75,9 @@ def _fmt_bytes(n) -> str:
 
 
 def _counter(row: dict, name: str) -> int:
-    """Sum of a pushed counter over its label variants (the registry
-    renders ``kf_chaos_injections_total{what="delay"}`` per label set)."""
-    counters = field(row, "counters") or {}
-    return sum(v for k, v in counters.items()
-               if k == name or k.startswith(name + "{"))
+    """Sum of a pushed counter over its label variants (the shared
+    aggregator ``sum_metric`` match — one implementation)."""
+    return int(sum_metric(field(row, "counters"), name))
 
 
 def _window_latency_s(row: dict) -> Optional[float]:
@@ -88,6 +87,53 @@ def _window_latency_s(row: dict) -> Optional[float]:
     count = sum(d.get("count", 0) for d in lat.values())
     total = sum(d.get("sum", 0.0) for d in lat.values())
     return (total / count) if count else None
+
+
+def _gauge(row: dict, name: str) -> float:
+    """Sum of a pushed gauge over its label variants (like _counter)."""
+    return sum_metric(field(row, "gauges"), name)
+
+
+def _serving_lines(view: dict) -> List[str]:
+    """The serving section (kf-serve deployments): the cluster rollup
+    the aggregator computes plus per-rank serve columns from the same
+    gauges/counters every snapshot carries (docs/serving.md)."""
+    srv = field(view, "serving")
+    if not srv:
+        return []
+    ttft = field(srv, "ttft_ms")
+    e2e = field(srv, "e2e_ms")
+    lines = [
+        "",
+        "== serving (continuous batching; docs/serving.md)",
+        f"  active {field(srv, 'active')} | queued {field(srv, 'queued')} | "
+        f"kv-cache {_fmt_bytes(field(srv, 'kv_bytes'))} | "
+        f"completed {field(srv, 'completed')} | "
+        f"rejected {field(srv, 'rejected')} | "
+        f"replayed {field(srv, 'replayed')} | "
+        f"window ttft {_fmt_s(ttft / 1e3, 'ms') if ttft is not None else '-'}"
+        f" e2e {_fmt_s(e2e / 1e3, 'ms') if e2e is not None else '-'}",
+        f"  {'rank':>4} {'active':>7} {'kv-cache':>9} {'done':>6} "
+        f"{'replay':>7} {'reuse-tok':>10}",
+    ]
+    done_key = 'kf_serve_requests_total{what="complete"}'
+    replay_key = 'kf_serve_requests_total{what="replay"}'
+    reuse_key = 'kf_serve_prefill_tokens_total{what="reused"}'
+    for row in field(view, "ranks") or []:
+        if not (_gauge(row, "kf_serve_active_requests")
+                or _gauge(row, "kf_kv_cache_bytes")
+                or _counter(row, "kf_serve_requests_total")
+                or _counter(row, "kf_serve_prefill_tokens_total")):
+            continue
+        counters = field(row, "counters") or {}
+        lines.append(
+            f"  {field(row, 'rank'):>4} "
+            f"{int(_gauge(row, 'kf_serve_active_requests')):>7} "
+            f"{_fmt_bytes(int(_gauge(row, 'kf_kv_cache_bytes'))):>9} "
+            f"{counters.get(done_key, 0):>6} "
+            f"{counters.get(replay_key, 0):>7} "
+            f"{counters.get(reuse_key, 0):>10}")
+    return lines
 
 
 def render_view(view: dict, top: int = 10) -> str:
@@ -178,6 +224,7 @@ def render_view(view: dict, top: int = 10) -> str:
     if not skew:
         lines.append("  (no cross-rank collective spans in the window — "
                      "is KF_CONFIG_ENABLE_TRACE on?)")
+    lines.extend(_serving_lines(view))
     return "\n".join(lines) + "\n"
 
 
@@ -197,13 +244,23 @@ def self_check() -> int:
 
     for rank in range(3):
         dur = 0.10 if rank == 2 else 0.01
+        counters = {"kf_engine_retries_total": rank}
+        gauges = {"kf_stat_gns": 1.5}
+        latency = {"kf_collective_latency_seconds": {"count": 2, "sum": dur}}
+        if rank == 1:  # one serving rank proves the serving rollup
+            counters['kf_serve_requests_total{what="complete"}'] = 7
+            counters['kf_serve_requests_total{what="replay"}'] = 2
+            counters['kf_serve_prefill_tokens_total{what="reused"}'] = 64
+            gauges["kf_serve_active_requests"] = 3.0
+            gauges["kf_kv_cache_bytes"] = float(1 << 20)
+            latency["kf_serve_e2e_seconds"] = {"count": 4, "sum": 2.0}
         agg.ingest(make_snapshot(
             rank=rank, pid=100 + rank, wall=999.5, step=3,
             step_time_s=0.25,
             slice=rank // 2,  # 2-rank slice 0 + 1-rank slice 1
-            counters={"kf_engine_retries_total": rank},
-            gauges={"kf_stat_gns": 1.5},
-            latency={"kf_collective_latency_seconds": {"count": 2, "sum": dur}},
+            counters=counters,
+            gauges=gauges,
+            latency=latency,
             events=[span(rank, dur, "grad3")],
             net={"egress_bytes": 1 << 20, "ingress_bytes": 1 << 20},
             strategy="RING",
@@ -231,9 +288,19 @@ def self_check() -> int:
           and [field(g, "slice") for g in field(view, "slices")] == [0, 1]
           and field(field(view, "slices")[0], "all_stale")
           and field(view, "stale_slices") == [0, 1])
+    # serving rollup: the one serving rank's gauges/counters/deltas must
+    # surface as the cluster serving summary (docs/serving.md)
+    srv = field(view, "serving")
+    ok = (ok and srv is not None
+          and field(srv, "active") == 3
+          and field(srv, "kv_bytes") == (1 << 20)
+          and field(srv, "completed") == 7
+          and field(srv, "replayed") == 2
+          and abs(field(srv, "e2e_ms") - 500.0) < 1e-9)
     text = render_view(view)
     ok = (ok and "STALE" in text and "all_reduce/grad3" in text
-          and "coll-lat" in text and "SLICE LOSS" in text)
+          and "coll-lat" in text and "SLICE LOSS" in text
+          and "== serving" in text and "replay" in text)
     if not ok:
         print("kftop: self-check FAILED (view schema/round-trip mismatch)",
               file=sys.stderr)

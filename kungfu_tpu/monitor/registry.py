@@ -202,6 +202,27 @@ METRIC_HELP: Dict[str, str] = {
         "per-handle hidden-wire fraction observed at wait(): 1.0 = the "
         "collective finished before the caller needed it (fully hidden), "
         "0.0 = the caller blocked for the whole wire time",
+    "kf_kv_cache_bytes":
+        "per-rank paged KV-cache footprint (allocated pages x page "
+        "bytes; the serving analog of kf_opt_state_bytes)",
+    "kf_serve_requests_total":
+        "serving request lifecycle events (kf-serve router), by outcome "
+        "(accept / reject / complete / replay / lost)",
+    "kf_serve_prefill_tokens_total":
+        "prefill tokens by source: computed ran the forward, reused "
+        "came from the paged KV cache's prefix chain",
+    "kf_serve_ttft_seconds":
+        "time to first token (admission to first decode), worker-side",
+    "kf_serve_token_seconds":
+        "decode-step latency per generated token, worker-side",
+    "kf_serve_e2e_seconds":
+        "end-to-end request latency (submit to completion incl. "
+        "routing, queueing, and any post-failure replay), router-side",
+    "kf_serve_queue_depth":
+        "router accepted-but-unfinished requests (admission bound: "
+        "KF_SERVE_QUEUE_DEPTH)",
+    "kf_serve_active_requests":
+        "decode slots occupied on this engine (continuous batching)",
     "kf_net_egress_bytes":
         "aggregate egress bytes (mirrored from NetMonitor)",
     "kf_net_ingress_bytes":

@@ -84,6 +84,16 @@ EVENT_KINDS = frozenset({
                    # kind: recorded only when tracing is on — the
                    # always-on surfaces are the kf_overlap_inflight
                    # gauge and the kf_overlap_efficiency histogram
+    "serve",       # serving-plane engine/router lifecycle (kf-serve,
+                   # serve/engine.py + serve/router.py: prefill/decode
+                   # spans — hot, ring-only — plus the rare worker-dead/
+                   # slice-dead/readmit marks of the serving fault
+                   # ladder)
+    "request",     # serving request lifecycle mark (kf-serve router:
+                   # "accept" / "reject" / "complete" / "replay" /
+                   # "lost").  A counted kind: every mark ticks
+                   # kf_serve_requests_total{what=<name>} even with
+                   # tracing off, like the chaos/shrink counters
     "step",        # training-step mark
     "mark",        # generic one-shot annotation
 })
@@ -100,8 +110,9 @@ _COUNTED_KINDS = {
     "shrink": "kf_shrink_events_total",
     "slice": "kf_slice_events_total",
     "swap": "kf_strategy_swaps_total",
+    "request": "kf_serve_requests_total",
 }
-_LABELED_KINDS = ("chaos", "shrink", "slice", "swap")
+_LABELED_KINDS = ("chaos", "shrink", "slice", "swap", "request")
 
 _lock = threading.Lock()
 _ring: collections.deque = collections.deque()

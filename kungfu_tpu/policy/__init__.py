@@ -21,3 +21,15 @@ from kungfu_tpu.policy.policies import (  # noqa: F401
     ScheduledSizePolicy,
 )
 from kungfu_tpu.policy.runner import PolicyRunner  # noqa: F401
+
+
+def __getattr__(name):
+    # the serving policies pull in serve/slo (and its registry/env
+    # stack); lazy like monitor/__init__'s bandit drivers so importing
+    # the policy package never costs the serving plane
+    if name in ("BatchWidthController", "ServeAutoscalePolicy",
+                "serve_signals"):
+        from kungfu_tpu.policy import serve as _serve
+
+        return getattr(_serve, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

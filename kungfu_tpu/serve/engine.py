@@ -1,0 +1,501 @@
+"""Continuous-batching inference engine over the flagship transformer.
+
+One engine = one replica: it owns the params, a device-side KV slab of
+``max_batch`` decode slots, and a :class:`~kungfu_tpu.serve.kvcache.
+KVCachePool` for host-side page accounting.  The loop discipline is
+**decode-priority continuous batching** (the Orca/vLLM scheduling
+shape): every :meth:`step` first admits at most ``admit_per_step``
+pending prefills into free slots, then runs ONE jit-compiled decode
+step for ALL active slots — new requests join the running batch between
+decode steps instead of waiting for a batch boundary, and long prompts
+cannot starve in-flight decodes.
+
+Phases are jit-compiled with static shapes (one trace per prefill
+length bucket + one decode trace — the recompile-hazard discipline):
+
+* **prefill** — forward over the un-cached prompt suffix, writing K/V
+  into the slab at ``[cached, prompt_len)`` and emitting the first
+  generated token.  The cached prefix comes straight out of the paged
+  pool (prefix-chain hit), so a shared system prompt costs its pages'
+  load, not its FLOPs — the measured delta in ``bench.py --serve``.
+* **decode** — one token for every active slot: write K/V at each
+  slot's position, attend over ``[0, pos]``, greedy argmax (greedy on
+  purpose: a replayed request deterministically re-derives the same
+  continuation from its committed prefix, docs/serving.md).
+
+Fault surface: the engine is process-local and carries no collective
+state — worker death is handled ABOVE it by the router's replay ladder
+(serve/router.py); the engine only guarantees that completed requests
+committed their full pages to the pool first.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kungfu_tpu.models import nn
+from kungfu_tpu.models.transformer import Transformer, _rope
+from kungfu_tpu.monitor import timeline
+from kungfu_tpu.serve import slo
+from kungfu_tpu.serve.kvcache import CacheExhausted, KVCachePool, PageSpec
+from kungfu_tpu.utils import envs
+
+DEFAULT_MAX_BATCH = 8
+DEFAULT_MAX_TOKENS = 256
+
+
+class _Req:
+    __slots__ = ("rid", "tokens", "max_new", "generated", "slot", "pages",
+                 "reused", "computed", "submitted_s", "admitted_s",
+                 "first_token_s", "canceled")
+
+    def __init__(self, rid: str, tokens: Sequence[int], max_new: int):
+        self.rid = rid
+        self.tokens = tuple(int(t) for t in tokens)
+        self.max_new = int(max_new)
+        self.generated: List[int] = []
+        self.slot = -1
+        self.pages: List[int] = []
+        self.reused = 0
+        self.computed = 0
+        self.submitted_s = time.perf_counter()
+        self.admitted_s = 0.0
+        self.first_token_s = 0.0
+        self.canceled = False
+
+    @property
+    def total_len(self) -> int:
+        return len(self.tokens) + len(self.generated)
+
+
+class InferenceEngine:
+    """Single-replica continuous-batching decode loop (one per serving
+    worker; thread-safe submit, single-threaded :meth:`step`)."""
+
+    def __init__(self, model: Transformer, params, *,
+                 pool: Optional[KVCachePool] = None,
+                 max_batch: Optional[int] = None,
+                 max_seq: Optional[int] = None,
+                 page_tokens: Optional[int] = None,
+                 eos_id: Optional[int] = None,
+                 admit_per_step: int = 1,
+                 rank: Optional[int] = None):
+        cfg = model.cfg
+        self.model = model
+        self.params = params
+        self.rank = rank
+        self.eos_id = eos_id
+        self.admit_per_step = max(1, int(admit_per_step))
+        self.max_batch = int(max_batch if max_batch is not None
+                             else envs.parse_int_env(envs.SERVE_MAX_BATCH,
+                                                     DEFAULT_MAX_BATCH))
+        self.max_seq = int(max_seq or cfg.max_seq)
+        self.pool = pool if pool is not None else KVCachePool(
+            PageSpec.for_model(cfg, page_tokens=page_tokens))
+        self._page_tokens = self.pool.spec.page_tokens
+        self._width = self.max_batch  # admitted width (policy-adjustable)
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._pending: "deque[_Req]" = deque()
+        self._active: Dict[int, _Req] = {}       # slot -> request
+        self._free_slots = list(range(self.max_batch - 1, -1, -1))
+        self._steps = 0
+        # device KV slab: [L, B, H, S, D] in compute dtype
+        L, B, H, S, D = (cfg.n_layers, self.max_batch, cfg.n_heads,
+                        self.max_seq, cfg.head_dim)
+        dt = cfg.compute_dtype
+        self._k = jnp.zeros((L, B, H, S, D), dt)
+        self._v = jnp.zeros((L, B, H, S, D), dt)
+        # no donate_argnums: the CPU backend ignores donation (with a
+        # warning per compile); on chip the slab update is small next to
+        # the model math and the jit cache keys per prefill bucket shape
+        self._decode_j = jax.jit(self._decode_fn)
+        self._prefill_j = jax.jit(self._prefill_fn)
+
+    # -- forward passes --------------------------------------------------
+    def _layer_qkv(self, lp, x, positions):
+        cfg = self.model.cfg
+        dt = cfg.compute_dtype
+
+        def heads(t):
+            b, s, _ = t.shape
+            return t.reshape(b, s, cfg.n_heads, cfg.head_dim
+                             ).transpose(0, 2, 1, 3)
+
+        q = heads(nn.dense_apply(lp["wq"], x, dtype=dt))
+        k = heads(nn.dense_apply(lp["wk"], x, dtype=dt))
+        v = heads(nn.dense_apply(lp["wv"], x, dtype=dt))
+        if cfg.pos == "rope":
+            q, k = _rope(q, k, positions)
+        return q, k, v
+
+    @staticmethod
+    def _attend(q, keys, values, mask):
+        """q [B,H,Q,D] over keys/values [B,H,S,D]; mask [B,1,Q,S] (or
+        broadcastable) True = attend.  f32 logits/softmax like the
+        training path."""
+        d = q.shape[-1]
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, keys
+                            ).astype(jnp.float32) / jnp.sqrt(d)
+        logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs, values)
+
+    def _merge(self, x):
+        b, h, s, d = x.shape
+        return x.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+
+    def _prefill_fn(self, params, k_slab, v_slab, ids, n, start, slot):
+        """ids [S_pad] (suffix, zero-padded past ``n``); writes K/V at
+        positions ``[start, start + S_pad)`` of ``slot`` and returns the
+        greedy next token after the last REAL row (``n - 1``)."""
+        cfg = self.model.cfg
+        dt = cfg.compute_dtype
+        s_pad = ids.shape[0]
+        s_max = k_slab.shape[3]
+        positions = start + jnp.arange(s_pad)
+        h = nn.embedding_apply(params["embed"], ids[None], dtype=dt)
+        if cfg.pos == "learned":
+            h = h + nn.embedding_apply(params["pos_embed"], positions[None],
+                                       dtype=dt)
+        q_pos = positions
+        key_pos = jnp.arange(s_max)
+        mask = (key_pos[None, :] <= q_pos[:, None])[None, None]  # [1,1,Q,S]
+        for li in range(cfg.n_layers):
+            lp = params[f"layer_{li}"]
+            x = nn.layernorm_apply(lp["ln1"], h)
+            q, k, v = self._layer_qkv(lp, x, positions[None])
+            k_slab = jax.lax.dynamic_update_slice(
+                k_slab, k[None], (li, slot, 0, start, 0))
+            v_slab = jax.lax.dynamic_update_slice(
+                v_slab, v[None], (li, slot, 0, start, 0))
+            keys = jax.lax.dynamic_index_in_dim(k_slab[li], slot, 0,
+                                                keepdims=True)
+            values = jax.lax.dynamic_index_in_dim(v_slab[li], slot, 0,
+                                                  keepdims=True)
+            o = self._merge(self._attend(q, keys, values, mask))
+            h = h + nn.dense_apply(lp["wo"], o, dtype=dt)
+            x = nn.layernorm_apply(lp["ln2"], h)
+            y = nn.gelu(nn.dense_apply(lp["ffn_in"], x, dtype=dt))
+            h = h + nn.dense_apply(lp["ffn_out"], y, dtype=dt)
+        h = nn.layernorm_apply(params["ln_f"], h)
+        last = jax.lax.dynamic_index_in_dim(h, n - 1, axis=1, keepdims=False)
+        logits = nn.dense_apply(params["head"], last).astype(jnp.float32)
+        return k_slab, v_slab, jnp.argmax(logits[0], axis=-1).astype(jnp.int32)
+
+    def _decode_fn(self, params, k_slab, v_slab, last_ids, pos):
+        """One token for every slot: ``last_ids``/``pos`` are [B]; the
+        new K/V lands at each slot's ``pos`` and attention covers
+        ``[0, pos]``.  Inactive slots compute garbage nobody reads."""
+        cfg = self.model.cfg
+        dt = cfg.compute_dtype
+        s_max = k_slab.shape[3]
+        positions = pos[:, None]                     # [B, 1]
+        h = nn.embedding_apply(params["embed"], last_ids[:, None], dtype=dt)
+        if cfg.pos == "learned":
+            h = h + nn.embedding_apply(params["pos_embed"], positions,
+                                       dtype=dt)
+        mask = (jnp.arange(s_max)[None, :] <= positions)[:, None, None, :]
+
+        def upd(slab_b, new_b, p):  # [H,S,D], [H,1,D], scalar
+            return jax.lax.dynamic_update_slice(slab_b, new_b, (0, p, 0))
+
+        for li in range(cfg.n_layers):
+            lp = params[f"layer_{li}"]
+            x = nn.layernorm_apply(lp["ln1"], h)
+            q, k, v = self._layer_qkv(lp, x, positions)
+            k_l = jax.vmap(upd)(k_slab[li], k, pos)
+            v_l = jax.vmap(upd)(v_slab[li], v, pos)
+            k_slab = k_slab.at[li].set(k_l)
+            v_slab = v_slab.at[li].set(v_l)
+            o = self._merge(self._attend(q, k_l, v_l, mask))
+            h = h + nn.dense_apply(lp["wo"], o, dtype=dt)
+            x = nn.layernorm_apply(lp["ln2"], h)
+            y = nn.gelu(nn.dense_apply(lp["ffn_in"], x, dtype=dt))
+            h = h + nn.dense_apply(lp["ffn_out"], y, dtype=dt)
+        h = nn.layernorm_apply(params["ln_f"], h)
+        logits = nn.dense_apply(params["head"], h[:, 0]).astype(jnp.float32)
+        return k_slab, v_slab, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def _prefill_bucket(self, n: int) -> int:
+        """Static prefill length: the smallest power-of-two multiple of
+        the page size holding ``n`` (one compile per bucket, ever)."""
+        b = max(self._page_tokens, 1)
+        while b < n:
+            b *= 2
+        return min(b, self.max_seq)
+
+    def warmup(self, prompt_lens: Sequence[int] = (8,)) -> None:
+        """Compile the decode step and EVERY prefill bucket up to the
+        one covering ``max(prompt_lens)`` before serving starts.
+        Cold-start compiles otherwise land on a live request's clock —
+        long enough to stall the worker loop (decode AND its liveness
+        keepalives) and read as a dead worker.  The smaller rungs are
+        not optional: a prefix-cache hit prefills only its SUFFIX, so
+        the first reuse of a warmed long prompt would otherwise compile
+        the smallest bucket mid-service — exactly the stall this method
+        exists to pay up front."""
+        top = self._prefill_bucket(max(max(prompt_lens), 1))
+        buckets, b = [], max(self._page_tokens, 1)
+        while b < top:
+            buckets.append(b)
+            b *= 2
+        buckets.append(top)
+        for s_pad in buckets:
+            ids = jnp.zeros(s_pad, jnp.int32)
+            # results discarded: jit populates its trace cache, the live
+            # slabs are untouched (functional updates on copies)
+            self._prefill_j(self.params, self._k, self._v, ids,
+                            jnp.int32(1), jnp.int32(0), jnp.int32(0)
+                            )[2].block_until_ready()
+        self._decode_j(self.params, self._k, self._v,
+                       jnp.zeros(self.max_batch, jnp.int32),
+                       jnp.zeros(self.max_batch, jnp.int32)
+                       )[2].block_until_ready()
+
+    # -- scheduling ------------------------------------------------------
+    @property
+    def width(self) -> int:
+        return self._width
+
+    def set_width(self, w: int) -> int:
+        """Admitted decode width (<= max_batch); the policy layer's
+        batch-width controller moves this, never the slab shape."""
+        with self._lock:
+            self._width = max(1, min(int(w), self.max_batch))
+            return self._width
+
+    def submit(self, rid: str, tokens: Sequence[int], max_new: int) -> None:
+        if not tokens:
+            raise ValueError("empty prompt")
+        if len(tokens) + max_new > self.max_seq:
+            raise ValueError(
+                f"request {rid!r}: {len(tokens)} prompt + {max_new} new "
+                f"tokens exceeds max_seq {self.max_seq}")
+        req = _Req(rid, tokens, max_new)
+        with self._wake:
+            self._pending.append(req)
+            self._wake.notify_all()
+
+    def cancel(self, rid: str) -> bool:
+        """Drop a request.  Pending requests leave immediately; an
+        ACTIVE (or mid-admission) request is only FLAGGED — the step
+        thread retires it at the next boundary.  Retirement must stay
+        single-threaded: a cross-thread release here would race
+        ``_complete``'s page commit (put_page_data on a freed page)."""
+        with self._lock:
+            for i, r in enumerate(self._pending):
+                if r.rid == rid:
+                    del self._pending[i]
+                    return True
+            for r in self._active.values():
+                if r.rid == rid:
+                    r.canceled = True
+                    return True
+        return False
+
+    @property
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    @property
+    def active_count(self) -> int:
+        with self._lock:
+            return len(self._active)
+
+    def wait_for_work(self, timeout: float) -> bool:
+        """Park the loop thread until work arrives (bounded)."""
+        with self._wake:
+            if self._pending or self._active:
+                return True
+            return self._wake.wait(timeout)
+
+    # -- admission (prefill phase) ---------------------------------------
+    def _try_admit(self, req: _Req) -> bool:
+        T = self._page_tokens
+        budget = len(req.tokens) + req.max_new
+        n_pages = -(-budget // T)
+        cached_pages, n_cached = self.pool.lookup(req.tokens)
+        # at least one prompt token must run the forward — the last row's
+        # hidden state is where the first generated token comes from
+        max_reuse = ((len(req.tokens) - 1) // T) * T
+        while n_cached > max_reuse:
+            self.pool.release([cached_pages.pop()])
+            n_cached -= T
+        # the padded prefill must FIT the slab past the cached offset:
+        # start + bucket(suffix) > max_seq would make dynamic_update_slice
+        # silently clamp the write over the restored prefix (corrupt K/V
+        # that _complete would then commit into the prefix chain).  Give
+        # reuse back until the rounded suffix fits — n_cached = 0 always
+        # does, since submit() bounds the prompt by max_seq
+        while n_cached > 0 and (
+                n_cached + self._prefill_bucket(len(req.tokens) - n_cached)
+                > self.max_seq):
+            self.pool.release([cached_pages.pop()])
+            n_cached -= T
+        try:
+            fresh = self.pool.alloc(n_pages - len(cached_pages))
+        except CacheExhausted:
+            self.pool.release(cached_pages)
+            return False
+        req.pages = cached_pages + fresh
+        req.reused = n_cached
+        with self._lock:
+            slot = self._free_slots.pop()
+        req.slot = slot
+        req.admitted_s = time.perf_counter()
+        if n_cached:
+            ks = np.stack([self.pool.page_data(p)[0] for p in cached_pages],
+                          axis=2)  # [L, H, n_pages, T, D] stacked on axis 2
+            vs = np.stack([self.pool.page_data(p)[1] for p in cached_pages],
+                          axis=2)
+            L, H = ks.shape[0], ks.shape[1]
+            ks = ks.reshape(L, H, n_cached, -1)
+            vs = vs.reshape(L, H, n_cached, -1)
+            dt = self.model.cfg.compute_dtype
+            self._k = self._k.at[:, slot, :, :n_cached, :].set(
+                jnp.asarray(ks, dt))
+            self._v = self._v.at[:, slot, :, :n_cached, :].set(
+                jnp.asarray(vs, dt))
+        suffix = req.tokens[n_cached:]
+        s_pad = self._prefill_bucket(len(suffix))
+        ids = np.zeros(s_pad, np.int32)
+        ids[:len(suffix)] = suffix
+        with timeline.span("serve", "prefill", rank=self.rank,
+                           tokens=len(suffix), reused=n_cached):
+            self._k, self._v, tok = self._prefill_j(
+                self.params, self._k, self._v, jnp.asarray(ids),
+                jnp.int32(len(suffix)), jnp.int32(n_cached), jnp.int32(slot))
+        req.computed = len(suffix)
+        req.first_token_s = time.perf_counter()
+        req.generated.append(int(tok))
+        slo.count_prefill(computed=len(suffix), reused=n_cached)
+        with self._lock:
+            self._active[slot] = req
+        return True
+
+    # -- completion ------------------------------------------------------
+    def _retire_locked(self, slot: int, req: _Req) -> None:
+        # idempotent: a cancel() racing the decode loop must not free a
+        # slot twice or double-release pages
+        if self._active.pop(slot, None) is None:
+            return
+        self._free_slots.append(slot)
+        if req.pages:
+            self.pool.release(req.pages)
+            req.pages = []
+
+    def _complete(self, slot: int, req: _Req) -> dict:
+        T = self._page_tokens
+        # commit the full pages this request produced (beyond the reused
+        # prefix) so the next shared-prefix request skips their prefill
+        seq = list(req.tokens) + req.generated
+        # K/V exists for positions [0, total_len - 1): the final token
+        # was emitted but never ran through the stack
+        full = (req.total_len - 1) // T
+        first_new = req.reused // T
+        if full > first_new and req.pages:
+            kb = np.asarray(jax.device_get(
+                self._k[:, req.slot, :, first_new * T:full * T, :]))
+            vb = np.asarray(jax.device_get(
+                self._v[:, req.slot, :, first_new * T:full * T, :]))
+            for p in range(first_new, full):
+                lo = (p - first_new) * T
+                self.pool.put_page_data(req.pages[p],
+                                        kb[:, :, lo:lo + T, :],
+                                        vb[:, :, lo:lo + T, :])
+            self.pool.commit_chain(seq[:full * T], req.pages[:full])
+        done_s = time.perf_counter()
+        stats = {
+            "rid": req.rid,
+            "tokens": list(req.generated),
+            "ttft_s": req.first_token_s - req.submitted_s,
+            "queue_s": req.admitted_s - req.submitted_s,
+            "engine_s": done_s - req.submitted_s,
+            "reused_tokens": req.reused,
+            "computed_tokens": req.computed,
+        }
+        slo.observe_ttft(stats["ttft_s"])
+        with self._lock:
+            self._retire_locked(slot, req)
+        return stats
+
+    def _is_done(self, req: _Req) -> bool:
+        if len(req.generated) >= req.max_new:
+            return True
+        return self.eos_id is not None and req.generated[-1] == self.eos_id
+
+    # -- the step --------------------------------------------------------
+    def step(self) -> List[dict]:
+        """One continuous-batching iteration: admit (bounded), decode
+        every active slot, retire finished requests.  Returns events:
+        ``{"kind": "admit"|"token"|"done", ...}`` in occurrence order."""
+        events: List[dict] = []
+        self._steps += 1
+        admitted = 0
+        while admitted < self.admit_per_step:
+            with self._lock:
+                can = (self._pending and self._free_slots
+                       and len(self._active) < self._width)
+                req = self._pending.popleft() if can else None
+            if req is None:
+                break
+            if not self._try_admit(req):
+                with self._lock:
+                    self._pending.appendleft(req)  # FCFS: keep its turn
+                break
+            admitted += 1
+            events.append({"kind": "admit", "rid": req.rid,
+                           "reused": req.reused, "computed": req.computed})
+            events.append({"kind": "token", "rid": req.rid,
+                           "tok": req.generated[-1], "n": 1})
+            if self._is_done(req):
+                events.append({"kind": "done", **self._complete(req.slot, req)})
+        # consume cancel flags on the step thread (the only retirer)
+        with self._lock:
+            doomed = [(s, r) for s, r in self._active.items() if r.canceled]
+            for s, r in doomed:
+                self._retire_locked(s, r)
+        with self._lock:
+            active = dict(self._active)
+        if active:
+            B = self.max_batch
+            last = np.zeros(B, np.int32)
+            pos = np.zeros(B, np.int32)
+            for slot, r in active.items():
+                last[slot] = r.generated[-1]
+                pos[slot] = r.total_len - 1
+            t0 = time.perf_counter()
+            with timeline.span("serve", "decode", rank=self.rank,
+                               batch=len(active)):
+                self._k, self._v, nxt = self._decode_j(
+                    self.params, self._k, self._v,
+                    jnp.asarray(last), jnp.asarray(pos))
+            nxt = np.asarray(jax.device_get(nxt))
+            slo.observe_token(time.perf_counter() - t0)
+            for slot, r in active.items():
+                r.generated.append(int(nxt[slot]))
+                events.append({"kind": "token", "rid": r.rid,
+                               "tok": int(nxt[slot]), "n": len(r.generated)})
+                if self._is_done(r):
+                    events.append({"kind": "done", **self._complete(slot, r)})
+        slo.note_active(self.active_count)
+        return events
+
+    def drain(self, max_steps: int = 10_000) -> List[dict]:
+        """Run steps until idle (tests / local mode); bounded so a
+        non-terminating request cannot wedge the caller."""
+        out: List[dict] = []
+        for _ in range(max_steps):
+            if not (self.pending_count or self.active_count):
+                break
+            out.extend(self.step())
+        return out

@@ -1,0 +1,302 @@
+"""Paged KV-cache block manager: fixed-size pages, free-list allocation,
+prefix-hash reuse, LRU eviction.
+
+The serving plane's memory system, deliberately **pure** (numpy +
+stdlib, no jax, no sockets) so its invariants are unit-testable the way
+:mod:`kungfu_tpu.elastic.slices` is: the decode engine holds the device
+slab; this pool owns the *host-side* pages — capacity accounting,
+prefix-reuse bookkeeping, and the replay source of truth.
+
+Model: a page holds ``page_tokens`` consecutive tokens' K and V for
+every layer (``[n_layers, n_heads, page_tokens, head_dim]`` each).  A
+request reserves ``ceil(total_tokens / page_tokens)`` pages at
+admission — admission control is capacity-real, not optimistic — and
+releases them at completion.  Completed *full* pages are committed
+under a **prefix chain hash** (hash of all tokens up to and including
+the page), so a later request sharing the prefix re-acquires the same
+pages instead of recomputing their prefill: the classic shared-system-
+prompt win.  Committed pages with no live reference park in an LRU;
+allocation evicts from it when the free list runs dry.
+
+Footprint contract: every allocation/release updates the
+``kf_kv_cache_bytes`` gauge (allocated pages x page bytes) — the
+serving analog of ``kf_opt_state_bytes``, flowing through aggregator
+snapshots to the kftop serving view (docs/serving.md).
+
+Invariants (tests/test_kvcache.py):
+
+* a released, recycled page is never referenced by a live request;
+* refcounts balance: acquire/release round-trips return the pool to
+  its starting footprint;
+* eviction only ever takes zero-reference committed pages;
+* the gauge equals ``(capacity - free) * page_bytes`` at all times.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kungfu_tpu.monitor.registry import REGISTRY
+from kungfu_tpu.utils import envs
+
+#: default tokens per page (KF_SERVE_PAGE_TOKENS overrides)
+DEFAULT_PAGE_TOKENS = 16
+#: default pool capacity in pages (KF_SERVE_KV_PAGES overrides)
+DEFAULT_CAPACITY_PAGES = 512
+
+GAUGE = "kf_kv_cache_bytes"
+
+
+class CacheExhausted(RuntimeError):
+    """Allocation failed: free list empty and nothing evictable.  The
+    typed admission-control signal — the scheduler keeps the request
+    queued instead of thrashing live requests' pages."""
+
+
+@dataclass(frozen=True)
+class PageSpec:
+    """Geometry of one page: K+V for every layer of a model."""
+
+    n_layers: int
+    n_heads: int
+    head_dim: int
+    page_tokens: int
+    dtype: str = "float32"
+
+    @property
+    def page_bytes(self) -> int:
+        # K and V, all layers, page_tokens rows of [n_heads, head_dim]
+        return (2 * self.n_layers * self.n_heads * self.page_tokens
+                * self.head_dim * np.dtype(self.dtype).itemsize)
+
+    @classmethod
+    def for_model(cls, cfg, page_tokens: Optional[int] = None,
+                  dtype: Optional[str] = None) -> "PageSpec":
+        """Spec from a :class:`~kungfu_tpu.models.transformer.
+        TransformerConfig`; ``page_tokens`` defaults from the
+        ``KF_SERVE_PAGE_TOKENS`` env."""
+        if page_tokens is None:
+            page_tokens = envs.parse_int_env(envs.SERVE_PAGE_TOKENS,
+                                             DEFAULT_PAGE_TOKENS)
+        return cls(n_layers=cfg.n_layers, n_heads=cfg.n_heads,
+                   head_dim=cfg.head_dim, page_tokens=int(page_tokens),
+                   dtype=dtype or cfg.dtype)
+
+
+def chain_hashes(tokens: Sequence[int], page_tokens: int) -> List[bytes]:
+    """One digest per FULL page of ``tokens``: digest *i* covers tokens
+    ``[0, (i+1)*page_tokens)`` — a chain, so two sequences share page
+    *i* exactly when their whole prefixes up to it agree (page-local
+    hashing would alias different contexts onto one K/V block, which is
+    silent cross-request corruption, not reuse)."""
+    out: List[bytes] = []
+    h = hashlib.blake2b(b"kf-kv-chain", digest_size=16)
+    for i in range(len(tokens) // page_tokens):
+        page = tokens[i * page_tokens:(i + 1) * page_tokens]
+        h = h.copy()
+        h.update(np.asarray(page, np.int64).tobytes())
+        out.append(h.digest())
+    return out
+
+
+class _Page:
+    __slots__ = ("k", "v", "key", "refs")
+
+    def __init__(self):
+        self.k: Optional[np.ndarray] = None   # [L, H, T, D]
+        self.v: Optional[np.ndarray] = None
+        self.key: Optional[bytes] = None      # chain hash when committed
+        self.refs = 0
+
+
+class KVCachePool:
+    """Thread-safe page pool (the worker's engine loop and the channel
+    handler both touch it)."""
+
+    def __init__(self, spec: PageSpec,
+                 capacity_pages: Optional[int] = None):
+        if capacity_pages is None:
+            capacity_pages = envs.parse_int_env(envs.SERVE_KV_PAGES,
+                                                DEFAULT_CAPACITY_PAGES)
+        self.spec = spec
+        self.capacity = int(capacity_pages)
+        self._lock = threading.Lock()
+        self._free: List[int] = list(range(self.capacity - 1, -1, -1))
+        self._pages: Dict[int, _Page] = {}
+        #: chain hash -> page id, for committed pages (live or parked)
+        self._by_key: Dict[bytes, int] = {}
+        #: zero-ref committed pages, LRU order (oldest first)
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        self._evictions = 0
+        self._update_gauge()
+
+    # -- accounting ------------------------------------------------------
+    def _update_gauge(self) -> None:
+        REGISTRY.gauge(GAUGE).set(
+            (self.capacity - len(self._free)) * self.spec.page_bytes)
+
+    @property
+    def footprint_bytes(self) -> int:
+        with self._lock:
+            return (self.capacity - len(self._free)) * self.spec.page_bytes
+
+    @property
+    def free_pages(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def cached_pages(self) -> int:
+        """Committed pages currently parked with zero references."""
+        with self._lock:
+            return len(self._lru)
+
+    @property
+    def evictions(self) -> int:
+        with self._lock:
+            return self._evictions
+
+    # -- allocation ------------------------------------------------------
+    def _take_one_locked(self) -> int:
+        if self._free:
+            pid = self._free.pop()
+        elif self._lru:
+            # evict the coldest zero-ref committed page — committed
+            # data is a recomputable cache, live requests' pages are not
+            pid, _ = self._lru.popitem(last=False)
+            page = self._pages.pop(pid)
+            assert page.refs == 0, "evicting a referenced page"
+            if page.key is not None:
+                self._by_key.pop(page.key, None)
+            self._evictions += 1
+        else:
+            raise CacheExhausted(
+                f"kv cache exhausted: {self.capacity} pages all referenced "
+                f"by live requests (page={self.spec.page_tokens} tokens)")
+        self._pages[pid] = _Page()
+        self._pages[pid].refs = 1
+        return pid
+
+    def alloc(self, n: int) -> List[int]:
+        """Reserve ``n`` fresh pages (refcount 1 to the caller), evicting
+        cold committed pages as needed.  All-or-nothing: on
+        :class:`CacheExhausted` no page moved."""
+        with self._lock:
+            if n > len(self._free) + len(self._lru):
+                raise CacheExhausted(
+                    f"need {n} pages, {len(self._free)} free + "
+                    f"{len(self._lru)} evictable of {self.capacity}")
+            out = [self._take_one_locked() for _ in range(n)]
+            self._update_gauge()
+            return out
+
+    def release(self, page_ids: Sequence[int]) -> None:
+        """Drop one reference per page.  Zero-ref committed pages park
+        in the LRU (reusable); zero-ref uncommitted pages return to the
+        free list — their data is dead and must never be served."""
+        with self._lock:
+            for pid in page_ids:
+                page = self._pages.get(pid)
+                if page is None or page.refs <= 0:
+                    raise ValueError(f"release of non-live page {pid}")
+                page.refs -= 1
+                if page.refs == 0:
+                    if page.key is not None:
+                        self._lru[pid] = None
+                        self._lru.move_to_end(pid)
+                    else:
+                        del self._pages[pid]
+                        self._free.append(pid)
+            self._update_gauge()
+
+    # -- page data -------------------------------------------------------
+    def put_page_data(self, pid: int, k: np.ndarray, v: np.ndarray) -> None:
+        """Fill a reserved page's host copy (``[L, H, T, D]`` each)."""
+        want = (self.spec.n_layers, self.spec.n_heads,
+                self.spec.page_tokens, self.spec.head_dim)
+        if tuple(k.shape) != want or tuple(v.shape) != want:
+            raise ValueError(f"page data shape {k.shape} != {want}")
+        with self._lock:
+            page = self._pages.get(pid)
+            if page is None or page.refs <= 0:
+                raise ValueError(f"put_page_data on non-live page {pid}")
+            page.k = np.ascontiguousarray(k)
+            page.v = np.ascontiguousarray(v)
+
+    def page_data(self, pid: int) -> Tuple[np.ndarray, np.ndarray]:
+        with self._lock:
+            page = self._pages.get(pid)
+            if page is None or page.refs <= 0:
+                raise ValueError(f"page_data on non-live page {pid}")
+            if page.k is None or page.v is None:
+                raise ValueError(f"page {pid} holds no data")
+            return page.k, page.v
+
+    # -- prefix reuse ----------------------------------------------------
+    def commit_chain(self, tokens: Sequence[int],
+                     page_ids: Sequence[int]) -> int:
+        """Register the caller's filled pages under the prefix chain of
+        ``tokens`` (only FULL pages commit).  A chain link already
+        committed keeps the incumbent page (first writer wins — both
+        hold identical K/V by construction).  Returns committed count.
+        The caller still holds its references; release() parks the
+        committed ones in the LRU."""
+        digests = chain_hashes(tokens, self.spec.page_tokens)
+        committed = 0
+        with self._lock:
+            for digest, pid in zip(digests, page_ids):
+                page = self._pages.get(pid)
+                if page is None or page.refs <= 0:
+                    raise ValueError(f"commit of non-live page {pid}")
+                if page.k is None:
+                    break  # pages are filled in order; stop at the gap
+                if digest in self._by_key:
+                    continue
+                page.key = digest
+                self._by_key[digest] = pid
+                committed += 1
+        return committed
+
+    def lookup(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
+        """Longest committed prefix of ``tokens``: ``(page_ids,
+        n_cached_tokens)``.  Returned pages are RETAINED for the caller
+        (refcount +1, pulled out of the LRU) — they cannot be evicted
+        under the request that is about to attend to them."""
+        digests = chain_hashes(tokens, self.spec.page_tokens)
+        out: List[int] = []
+        with self._lock:
+            for digest in digests:
+                pid = self._by_key.get(digest)
+                if pid is None:
+                    break
+                page = self._pages[pid]
+                page.refs += 1
+                if page.refs == 1:
+                    self._lru.pop(pid, None)
+                out.append(pid)
+            return out, len(out) * self.spec.page_tokens
+
+    # -- introspection ---------------------------------------------------
+    def live_refs(self) -> Dict[int, int]:
+        """``{page id: refcount}`` for referenced pages (tests)."""
+        with self._lock:
+            return {pid: p.refs for pid, p in self._pages.items()
+                    if p.refs > 0}
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "free": len(self._free),
+                "cached": len(self._lru),
+                "live": sum(1 for p in self._pages.values() if p.refs > 0),
+                "evictions": self._evictions,
+                "bytes": (self.capacity - len(self._free))
+                * self.spec.page_bytes,
+            }

@@ -16,7 +16,7 @@ import queue
 import threading
 from typing import Optional
 
-from kungfu_tpu.comm.host import ConnType, HostChannel
+from kungfu_tpu.comm.host import SERVE_NAME_PREFIX, ConnType, HostChannel
 from kungfu_tpu.plan.peer import PeerID, parse_peer_id
 from kungfu_tpu.store.store import get_local_store
 from kungfu_tpu.utils.log import get_logger
@@ -124,7 +124,12 @@ def install_p2p_handler(channel: HostChannel, store=None,
 
     def handle(name: str, payload: bytes, src: str):
         # runs on the channel's receive path — hand off and return so the
-        # stream keeps draining
+        # stream keeps draining.  Names under the reserved serve prefix
+        # are the serving plane's (kf-serve request/progress/completion
+        # frames, serve/router.py): its own responder pool answers them,
+        # and the blob store must not race a _FAIL reply onto the same id.
+        if name.startswith(SERVE_NAME_PREFIX):
+            return
         serve_q.put((name, payload, src))
 
     channel.on_p2p_request(handle)

@@ -148,12 +148,46 @@ slice topology layer (:mod:`kungfu_tpu.elastic.slices`):
                                    the bootstrap worker count
 =================================  ============================================
 
+Serving envs (the kf-serve inference plane, :mod:`kungfu_tpu.serve`;
+see docs/serving.md):
+
+=============================  ================================================
+``KF_SERVE_QUEUE_DEPTH``       router admission bound: accepted-but-unfinished
+                               requests past it are rejected with the typed
+                               ``ServeOverloadError`` instead of queueing
+                               unboundedly; default 64 (serve/router.py)
+``KF_SERVE_PAGE_TOKENS``       tokens per KV-cache page, default 16
+                               (serve/kvcache.py)
+``KF_SERVE_KV_PAGES``          KV-cache pool capacity in pages, default 512;
+                               the per-rank footprint is the
+                               ``kf_kv_cache_bytes`` gauge (serve/kvcache.py)
+``KF_SERVE_MAX_BATCH``         decode batch width (continuous-batching slots)
+                               per engine, default 8; the policy layer's
+                               BatchWidthController moves the *admitted* width
+                               under this cap (serve/engine.py)
+``KF_SERVE_MAX_TOKENS``        per-request new-token cap, default 256
+                               (serve/engine.py)
+``KF_SERVE_COMMIT_EVERY``      decode positions between progress commits to
+                               the router (the replay boundary after a worker
+                               death), default 8 (serve/router.py)
+``KF_SERVE_REQUEST_DEADLINE``  router per-request progress deadline seconds
+                               (no progress/completion within it = a strike
+                               against the worker; strikes escalate to the
+                               dead-worker ladder), default 60
+                               (serve/router.py)
+``KF_SERVE_SLO_TTFT_MS``       time-to-first-token SLO target ms, default 500
+                               (serve/slo.py)
+``KF_SERVE_SLO_E2E_MS``        end-to-end request SLO target ms, default 5000
+                               (serve/slo.py)
+=============================  ================================================
+
 Fault-injection envs (the chaos layer, :mod:`kungfu_tpu.chaos`; see
 docs/fault_tolerance.md for the full matrix):
 
 =============================  ================================================
 ``KF_CHAOS_SPEC``              deterministic fault clauses
-                               (``die``/``reset``/``delay``/``drop_fanout``/
+                               (``die``/``die_slice``/``reset``/``delay``/
+                               ``drop_fanout``/``drop_request``/
                                ``config_down``; grammar in chaos/spec.py).
                                Unset = every injection hook is a zero-cost
                                no-op and behavior is byte-identical to an
@@ -306,6 +340,19 @@ MEGASCALE_COORDINATOR = "MEGASCALE_COORDINATOR_ADDRESS"
 MEGASCALE_SLICE_ID = "MEGASCALE_SLICE_ID"
 MEGASCALE_NUM_SLICES = "MEGASCALE_NUM_SLICES"
 SLICE_RANKS = "KF_SLICE_RANKS"
+
+# serving envs (read by kungfu_tpu/serve via these constants; registered
+# here so the env-contract scan anchors the kf-serve knobs to the same
+# registry as every other KF_* token)
+SERVE_QUEUE_DEPTH = "KF_SERVE_QUEUE_DEPTH"
+SERVE_PAGE_TOKENS = "KF_SERVE_PAGE_TOKENS"
+SERVE_KV_PAGES = "KF_SERVE_KV_PAGES"
+SERVE_MAX_BATCH = "KF_SERVE_MAX_BATCH"
+SERVE_MAX_TOKENS = "KF_SERVE_MAX_TOKENS"
+SERVE_COMMIT_EVERY = "KF_SERVE_COMMIT_EVERY"
+SERVE_REQUEST_DEADLINE = "KF_SERVE_REQUEST_DEADLINE"
+SERVE_SLO_TTFT_MS = "KF_SERVE_SLO_TTFT_MS"
+SERVE_SLO_E2E_MS = "KF_SERVE_SLO_E2E_MS"
 
 # fault-injection envs (read by kungfu_tpu/chaos/inject.py at controller
 # creation; registered here so the env-contract scan anchors them to the

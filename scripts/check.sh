@@ -94,6 +94,23 @@ if ! timeout -k 10 150 python3 examples/adapt_interference.py \
     fail=1
 fi
 
+echo "== serve-demo (request completes through a chaos worker kill)"
+# kf-serve end to end: continuous-batching workers + router over real
+# host channels, chaos kills a worker mid-decode, the router replays
+# its in-flight requests from their committed positions on survivors —
+# zero lost accepted requests, replayed tokens bitwise-equal to the
+# greedy reference (docs/serving.md).  Bounded: a wedged replay must
+# fail the gate, not hang it.
+rm -f /tmp/_kf_serve_demo.log
+if ! timeout -k 10 240 python3 examples/serve_demo.py \
+        > /tmp/_kf_serve_demo.log 2>&1 \
+        || ! grep -q "serve-demo: survived worker kill" \
+        /tmp/_kf_serve_demo.log; then
+    echo "ERROR: serve demo did not survive the worker kill"
+    tail -40 /tmp/_kf_serve_demo.log || true
+    fail=1
+fi
+
 echo "== overlap-demo (bucketed communication/computation overlap measured)"
 # kf-overlap end to end: chaos-injected wire latency, serial vs depth-k
 # pipelined ZeRO-2 bucket loop — asserts measured overlap > 0,

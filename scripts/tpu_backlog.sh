@@ -32,6 +32,7 @@ run() { # name timeout cmd...
 run headline   1800 python bench.py
 run kernels    1500 python bench.py --kernels
 run pallas     1500 python bench.py --pallas
+run serve      1500 python bench.py --serve
 run xent_cross 1800 python benchmarks/xent_sweep.py --crossover
 run bn_sweep   1800 python benchmarks/bn_sweep.py
 run longctx    1500 python bench.py --kernels --seq-len 8192
@@ -42,6 +43,9 @@ log " - headline/kernels/lm replace the matching BENCH_extra sections"
 log " - pallas: the compiled-kernel device rows replace the"
 log "   pallas_collectives section's CPU-mesh carry-forward; any failed"
 log "   checks{} entry blocks promotion (docs/pallas_collectives.md)"
+log " - serve: on-chip SLO row (p50/p99 through worker+slice kills)"
+log "   replaces serve_slo_cpu_mesh's carry-forward; any failed"
+log "   checks{} entry blocks promotion (docs/serving.md)"
 log " - xent_cross: any route_correct=false row -> adjust _route_fused"
 log "   thresholds (ops/pallas/xent.py) and re-run"
 log " - bn_sweep: if a variant beats prod at full shape, promote it in"

@@ -43,6 +43,12 @@ def held_across_shrink(engine, peer, x):
     return h.wait()
 
 
+def held_across_worker_dead(engine, router, x):
+    h = engine.all_reduce_async(x)
+    router.mark_worker_dead(2)           # line 48: serving fence in flight
+    return h.wait()
+
+
 def elastic_step(peer, state, schedule, params):
     return state, params, False
 

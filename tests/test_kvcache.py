@@ -1,0 +1,185 @@
+"""Paged KV-cache block manager invariants (kf-serve, pure unit).
+
+The pool is the serving plane's memory system: admission control is
+only as real as these invariants — a freed page served to a live
+request is silent cross-request corruption, and a wrong footprint gauge
+lies to the autoscaler.  Everything here runs without jax.
+"""
+
+import numpy as np
+import pytest
+
+from kungfu_tpu.monitor.registry import REGISTRY
+from kungfu_tpu.serve.kvcache import (CacheExhausted, KVCachePool, PageSpec,
+                                      chain_hashes)
+
+SPEC = PageSpec(n_layers=2, n_heads=2, head_dim=4, page_tokens=4,
+                dtype="float32")
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    shape = (SPEC.n_layers, SPEC.n_heads, SPEC.page_tokens, SPEC.head_dim)
+    return (rng.standard_normal(shape).astype(np.float32),
+            rng.standard_normal(shape).astype(np.float32))
+
+
+class TestSpec:
+    def test_page_bytes(self):
+        # 2 (K+V) * 2 layers * 2 heads * 4 tokens * 4 dim * 4 bytes
+        assert SPEC.page_bytes == 2 * 2 * 2 * 4 * 4 * 4
+
+    def test_chain_hashes_only_full_pages(self):
+        assert chain_hashes([1, 2, 3], 4) == []
+        assert len(chain_hashes([1, 2, 3, 4, 5], 4)) == 1
+        assert len(chain_hashes(list(range(8)), 4)) == 2
+
+    def test_chain_property(self):
+        """Digest i covers the WHOLE prefix: two sequences agreeing only
+        on page 1's local tokens must not share page 1."""
+        a = chain_hashes([1, 2, 3, 4, 5, 6, 7, 8], 4)
+        b = chain_hashes([9, 9, 9, 9, 5, 6, 7, 8], 4)
+        assert a[0] != b[0]
+        assert a[1] != b[1]  # same local tokens, different context
+        c = chain_hashes([1, 2, 3, 4, 5, 6, 7, 8, 0], 4)
+        assert c[:2] == a[:2]  # true shared prefix DOES share
+
+
+class TestAllocation:
+    def test_alloc_release_round_trip_and_gauge(self):
+        pool = KVCachePool(SPEC, capacity_pages=8)
+        assert pool.footprint_bytes == 0
+        pages = pool.alloc(3)
+        assert len(set(pages)) == 3
+        assert pool.footprint_bytes == 3 * SPEC.page_bytes
+        assert REGISTRY.gauge("kf_kv_cache_bytes").value == 3 * SPEC.page_bytes
+        pool.release(pages)
+        assert pool.footprint_bytes == 0
+        assert REGISTRY.gauge("kf_kv_cache_bytes").value == 0
+        assert pool.free_pages == 8
+
+    def test_all_or_nothing(self):
+        pool = KVCachePool(SPEC, capacity_pages=4)
+        held = pool.alloc(3)
+        with pytest.raises(CacheExhausted):
+            pool.alloc(2)
+        # the failed alloc moved nothing
+        assert pool.free_pages == 1
+        pool.release(held)
+
+    def test_double_release_raises(self):
+        pool = KVCachePool(SPEC, capacity_pages=4)
+        pages = pool.alloc(1)
+        pool.release(pages)
+        with pytest.raises(ValueError):
+            pool.release(pages)
+
+
+class TestPrefixReuse:
+    def test_commit_then_lookup_shares_pages(self):
+        pool = KVCachePool(SPEC, capacity_pages=8)
+        tokens = [1, 2, 3, 4, 5, 6, 7, 8, 9]  # 2 full pages + 1 spare
+        pages = pool.alloc(3)
+        for i in range(2):
+            k, v = _data(i)
+            pool.put_page_data(pages[i], k, v)
+        assert pool.commit_chain(tokens[:8], pages[:2]) == 2
+        pool.release(pages)
+        assert pool.cached_pages == 2  # parked, not freed
+        got, n = pool.lookup(tokens)
+        assert n == 8 and got == pages[:2]
+        k0, _ = pool.page_data(got[0])
+        np.testing.assert_array_equal(k0, _data(0)[0])
+        # retained under the caller: refcounts live again
+        assert pool.live_refs() == {pages[0]: 1, pages[1]: 1}
+        pool.release(got)
+
+    def test_lookup_stops_at_divergence(self):
+        pool = KVCachePool(SPEC, capacity_pages=8)
+        tokens = list(range(8))
+        pages = pool.alloc(2)
+        for i in range(2):
+            pool.put_page_data(pages[i], *_data(i))
+        pool.commit_chain(tokens, pages)
+        pool.release(pages)
+        got, n = pool.lookup([0, 1, 2, 3, 99, 5, 6, 7])
+        assert n == 4 and got == pages[:1]
+        pool.release(got)
+
+    def test_commit_dedupes_first_writer_wins(self):
+        pool = KVCachePool(SPEC, capacity_pages=8)
+        tokens = list(range(4))
+        a = pool.alloc(1)
+        pool.put_page_data(a[0], *_data(0))
+        assert pool.commit_chain(tokens, a) == 1
+        b = pool.alloc(1)
+        pool.put_page_data(b[0], *_data(1))
+        assert pool.commit_chain(tokens, b) == 0  # incumbent kept
+        got, n = pool.lookup(tokens)
+        assert got == a
+        pool.release(a + b + got)
+
+
+class TestEviction:
+    def test_lru_eviction_of_cold_committed_pages(self):
+        pool = KVCachePool(SPEC, capacity_pages=2)
+        a = pool.alloc(1)
+        pool.put_page_data(a[0], *_data(0))
+        pool.commit_chain([1, 2, 3, 4], a)
+        pool.release(a)
+        b = pool.alloc(1)
+        pool.put_page_data(b[0], *_data(1))
+        pool.commit_chain([5, 6, 7, 8], b)
+        pool.release(b)
+        assert pool.cached_pages == 2
+        # both free slots are parked caches; a 2-page alloc evicts the
+        # OLDEST ([1,2,3,4]) first
+        c = pool.alloc(2)
+        assert pool.evictions == 2
+        assert pool.lookup([1, 2, 3, 4]) == ([], 0)
+        assert pool.lookup([5, 6, 7, 8]) == ([], 0)
+        pool.release(c)
+
+    def test_referenced_pages_never_evicted(self):
+        pool = KVCachePool(SPEC, capacity_pages=2)
+        a = pool.alloc(1)
+        pool.put_page_data(a[0], *_data(0))
+        pool.commit_chain([1, 2, 3, 4], a)
+        pool.release(a)
+        got, n = pool.lookup([1, 2, 3, 4])  # retained by a "request"
+        assert n == 4
+        held = pool.alloc(1)
+        with pytest.raises(CacheExhausted):
+            pool.alloc(1)  # the retained cache page is NOT evictable
+        pool.release(got)
+        pool.alloc(1)  # now it is
+        pool.release(held)
+
+
+class TestFreedPageNeverLive:
+    def test_regression_recycled_page_not_referenced_by_live_request(self):
+        """The corruption invariant: across a churny workload, no page
+        id ever appears in two live requests' page lists, and a
+        released page's id only ever comes back through a fresh alloc
+        or a cache hit on committed data."""
+        pool = KVCachePool(SPEC, capacity_pages=6)
+        rng = np.random.default_rng(7)
+        live = {}  # rid -> page list
+        for step in range(200):
+            if live and rng.random() < 0.45:
+                rid = list(live)[int(rng.integers(len(live)))]
+                pool.release(live.pop(rid))
+            else:
+                try:
+                    pages = pool.alloc(int(rng.integers(1, 3)))
+                except CacheExhausted:
+                    continue
+                live[f"r{step}"] = pages
+            # no page is held by two live requests
+            flat = [p for ps in live.values() for p in ps]
+            assert len(flat) == len(set(flat)), f"shared page at {step}"
+            # pool refcounts agree exactly with what requests hold
+            assert pool.live_refs() == {p: 1 for p in flat}
+        for ps in live.values():
+            pool.release(ps)
+        assert pool.footprint_bytes == 0

@@ -152,13 +152,15 @@ class TestHandleDiscipline:
     def test_bad_fixture_all_shapes_caught(self, tmp_path):
         got = sorted((v.line, v.message)
                      for v in self._violations(tmp_path, "handle_bad.py"))
-        assert [line for line, _ in got] == [6, 11, 17, 24, 34, 42], got
+        assert [line for line, _ in got] == [6, 11, 17, 24, 34, 42, 48], got
         assert "dropped" in got[0][1]
         assert "never waited" in got[1][1]
         assert "every control-flow path" in got[2][1]
         assert "every control-flow path" in got[3][1]
         assert "elastic_step" in got[4][1]
         assert "shrink_to_survivors" in got[5][1]
+        # the serving plane's membership boundary fences handles too
+        assert "mark_worker_dead" in got[6][1]
 
     def test_good_fixture_clean(self, tmp_path):
         got = self._violations(tmp_path, "handle_good.py")
