@@ -6,7 +6,8 @@ PY ?= python3
 BASELINE := tests/lint_baseline.json
 
 .PHONY: lint verify shardcheck pallas-check check test native trace-demo \
-    zero-demo multislice-demo adapt-demo overlap-demo serve-demo help
+    zero-demo multislice-demo adapt-demo overlap-demo serve-demo \
+    xray-gate help
 
 ## lint: all fourteen kf-lint rules — the Python suite (env-contract,
 ## jit-sync, blocking-io, retry-discipline, handle-discipline,
@@ -54,6 +55,18 @@ test:
 ## native: production build of the native transport.
 native:
 	$(MAKE) -C kungfu_tpu/native
+
+## xray-gate: the kf-xray attribution + perf-budget gate (the same
+## stanza scripts/check.sh runs): 3-rank chaos mesh with a planted
+## 30 ms link delay — offline `kftrace --critical-path` and the online
+## aggregator verdict must be identical and name the planted edge, and
+## the per-phase medians must sit inside tests/xray_budget.json
+## (docs/xray.md; the recorded row is BENCH_extra.json xray_cpu_mesh).
+xray-gate:
+	$(PY) bench.py --xray --quick > /tmp/_kf_xray_gate.json
+	grep -q '"vs_baseline": 1.0' /tmp/_kf_xray_gate.json
+	grep -q '"budget_ok": true' /tmp/_kf_xray_gate.json
+	@echo "xray-gate: all checks green"
 
 ## trace-demo: 4-peer local run with an injected 400 ms straggler on
 ## rank 2 (every 9th matching send, so most collectives stay clean and

@@ -83,7 +83,12 @@ def _traced_collective(name: str, op: str, n: int, version: int, fn,
     needs real execution times, not dispatch times."""
     if not timeline.enabled() and hook is None:
         return fn()
-    attrs = {"op": op, "n": n, "version": version}
+    attrs = {"op": op, "n": n, "version": version,
+             # kf-xray derived cross-rank trace id: every process of the
+             # mesh computes the identical id from (version, step, op,
+             # name) — zero extra wire bytes (docs/xray.md)
+             "trace": timeline.collective_trace_id(
+                 version, timeline.current_step(), op, name)}
     if nbytes is not None:
         attrs["nbytes"] = nbytes
     if sched is not None:

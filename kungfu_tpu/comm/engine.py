@@ -416,6 +416,7 @@ class CollectiveEngine:
         with timeline.span(
             "collective", f"engine.all_reduce[{flat.nbytes}B]",
             rank=self._timeline_rank, op="all_reduce", tag=tag, nbytes=flat.nbytes,
+            trace=self._trace_id("all_reduce", tag),
         ):
             out = self._run_over_graphs(
                 flat, eff_op, tag, self._graphs, record=record, inplace=inplace
@@ -445,6 +446,16 @@ class CollectiveEngine:
         if self._chaos is not None:
             self._chaos.on_collective(tag)
 
+    def _trace_id(self, op: str, tag: str) -> str:
+        """kf-xray derived cross-rank trace id: every participant
+        computes the identical id from the cluster version (the
+        channel's epoch token), the current step, and the collective's
+        op/tag — the same logical collective links across ranks in a
+        merged trace with no extra wire bytes (docs/xray.md)."""
+        return timeline.collective_trace_id(
+            getattr(self.channel, "token", 0), timeline.current_step(),
+            op, tag)
+
     def broadcast(self, x: np.ndarray, root: int = 0, name: str = "") -> np.ndarray:
         self._begin_collective(name or "broadcast")
         with self._lock:
@@ -456,6 +467,7 @@ class CollectiveEngine:
         with timeline.span(
             "collective", "engine.broadcast", rank=self._timeline_rank,
             op="broadcast", tag=tag, nbytes=flat.nbytes,
+            trace=self._trace_id("broadcast", tag),
         ):
             out = self._run_bcast(flat.copy(), f"{tag}", bcast_g)
         return out.reshape(x.shape)
@@ -471,7 +483,8 @@ class CollectiveEngine:
         me = self.rank
         acc = flat.copy()
         with timeline.span("collective", "engine.reduce", rank=self._timeline_rank,
-                           op="reduce", tag=tag, nbytes=flat.nbytes):
+                           op="reduce", tag=tag, nbytes=flat.nbytes,
+                           trace=self._trace_id("reduce", tag)):
             for prev in reduce_g.prevs(me):
                 data = np.frombuffer(self._recv(prev, tag), dtype=flat.dtype)
                 acc = native.transform2(acc, data, eff_op)
@@ -488,7 +501,8 @@ class CollectiveEngine:
         tag = (name or f"ga{self._next_seq()}") + ".g"
         flat = np.ascontiguousarray(x).reshape(-1)
         with timeline.span("collective", "engine.gather", rank=self._timeline_rank,
-                           op="gather", tag=tag, nbytes=flat.nbytes):
+                           op="gather", tag=tag, nbytes=flat.nbytes,
+                           trace=self._trace_id("gather", tag)):
             if self.rank == root:
                 parts = []
                 for r in range(len(self.peers)):
@@ -510,7 +524,8 @@ class CollectiveEngine:
         flat = np.ascontiguousarray(x).reshape(-1)
         me = self.rank
         with timeline.span("collective", "engine.all_gather", rank=self._timeline_rank,
-                           op="all_gather", tag=tag, nbytes=flat.nbytes):
+                           op="all_gather", tag=tag, nbytes=flat.nbytes,
+                           trace=self._trace_id("all_gather", tag)):
             for r in range(len(self.peers)):
                 if r != me:
                     self._send(r, tag, flat.tobytes())
@@ -548,7 +563,7 @@ class CollectiveEngine:
         with timeline.span(
             "collective", f"engine.reduce_scatter[{flat.nbytes}B]",
             rank=self._timeline_rank, op="reduce_scatter", tag=tag,
-            nbytes=flat.nbytes,
+            nbytes=flat.nbytes, trace=self._trace_id("reduce_scatter", tag),
         ):
             for r in range(n):
                 if r != me:
@@ -760,7 +775,8 @@ class CollectiveEngine:
         root = min(ranks)
         with timeline.span("collective", "engine.local_reduce",
                            rank=self._timeline_rank, op="local_reduce", tag=tag,
-                           nbytes=flat.nbytes):
+                           nbytes=flat.nbytes,
+                           trace=self._trace_id("local_reduce", tag)):
             acc = self._subset_reduce(
                 flat, ranks, root, "sum" if op == "mean" else op, tag)
         if self.rank == root:
@@ -777,7 +793,8 @@ class CollectiveEngine:
         ranks = self._local_ranks()
         with timeline.span("collective", "engine.local_broadcast",
                            rank=self._timeline_rank, op="local_broadcast", tag=tag,
-                           nbytes=flat.nbytes):
+                           nbytes=flat.nbytes,
+                           trace=self._trace_id("local_broadcast", tag)):
             out = self._subset_bcast(flat, ranks, min(ranks), tag)
         return out.reshape(x.shape)
 
@@ -795,6 +812,7 @@ class CollectiveEngine:
         with timeline.span(
             "collective", "engine.cross_all_reduce", rank=self._timeline_rank,
             op="cross_all_reduce", tag=base, nbytes=flat.nbytes,
+            trace=self._trace_id("cross_all_reduce", base),
         ):
             acc = self._subset_reduce(
                 flat, local, local_root, eff_op, base + ".lr")

@@ -76,12 +76,19 @@ def prefetch_to_device(it: Iterable, size: int = 2,
     t.start()
 
     def gen():
+        from kungfu_tpu.monitor import timeline
+
         try:
             while True:
                 # consumer-side wait: the worker always terminates the
                 # stream (sentinel or exception object), so an unbounded
-                # block here ends exactly when the producer does
-                item = q.get()  # kflint: allow(blocking-io)
+                # block here ends exactly when the producer does.  The
+                # kf-xray `input` span times this block — the
+                # input-pipeline stall the step-time attribution charges
+                # to `input_stall` (docs/xray.md); a warm queue records
+                # ~0, an empty one records exactly the stall
+                with timeline.span("input", "prefetch.next"):
+                    item = q.get()  # kflint: allow(blocking-io)
                 if item is _SENTINEL:
                     return
                 if isinstance(item, BaseException):

@@ -59,6 +59,7 @@ from collections import deque
 from typing import Callable, Dict, List, Optional
 
 from kungfu_tpu.monitor import skew as skewlib
+from kungfu_tpu.monitor import xray as xraylib
 from kungfu_tpu.monitor.registry import REGISTRY, _escape_label_value
 from kungfu_tpu.utils.log import get_logger
 
@@ -123,6 +124,13 @@ VIEW_FIELDS = frozenset({
     # plus window-mean latencies from the pushed histogram deltas
     "serving", "active", "queued", "kv_bytes", "completed", "rejected",
     "replayed", "ttft_ms", "e2e_ms",
+    # kf-xray section (None when the window holds nothing attributable):
+    # the step-time attribution + verdict computed by monitor/xray.py —
+    # the SAME implementation `kftrace --critical-path` runs offline —
+    # plus the MFU / model-FLOPs rollup from the pushed gauges
+    "xray", "verdict", "phases", "steps", "culprit", "critical_rank",
+    "dominant", "steps_seen", "wall_s", "mfu", "model_flops_s",
+    "phase_seconds", "dropped_events",
 })
 
 
@@ -355,6 +363,55 @@ class ClusterAggregator:
             "e2e_ms": window_ms("kf_serve_e2e_seconds"),
         }
 
+    @staticmethod
+    def _xray_summary(rows: List[dict],
+                      events: List[dict]) -> Optional[dict]:
+        """The ``/cluster`` ``xray`` section: step-time attribution +
+        verdict from the pushed event windows (:func:`kungfu_tpu.monitor.
+        xray.online_view` — the same implementation ``kftrace
+        --critical-path`` runs offline, so the two cannot disagree) plus
+        the MFU / model-FLOPs / per-phase-gauge / trace-loss rollup from
+        the per-rank snapshots.  ``None`` when nothing is attributable
+        and no rank exports xray gauges."""
+        body = xraylib.online_view(events)
+        mfu: Dict[int, float] = {}
+        flops_s = 0.0
+        phase_sums: Dict[str, List[float]] = {}
+        dropped: Dict[int, int] = {}
+        for row in rows:
+            gauges = row.get("gauges") or {}
+            m = gauges.get("kf_mfu")
+            if m is not None:
+                mfu[row["rank"]] = float(m)
+            flops_s += sum_metric(gauges, "kf_model_flops_s")
+            prefix = 'kf_step_phase_seconds{phase="'
+            for key, val in gauges.items():
+                if key.startswith(prefix) and key.endswith('"}'):
+                    phase = key[len(prefix):-2]
+                    phase_sums.setdefault(phase, []).append(float(val))
+            drops = sum_metric(row.get("counters"),
+                               "kf_timeline_dropped_total")
+            if drops:
+                dropped[row["rank"]] = int(drops)
+        # MEAN over the ranks exporting each phase, never the rank-sum:
+        # kftop renders this under a per-step label, and an N-rank sum
+        # would read as an N-fold-inflated step (FLOP/s sums honestly —
+        # rates add across ranks; per-step seconds do not)
+        phase_seconds = {ph: sum(vs) / len(vs)
+                         for ph, vs in phase_sums.items()}
+        # a lossy ring alone still warrants the section: the TRACE LOSS
+        # signal must not vanish just because the surviving window holds
+        # nothing attributable (that is exactly when drops matter most)
+        if (body is None and not mfu and not flops_s and not phase_seconds
+                and not dropped):
+            return None
+        out = dict(body or {"verdict": None, "steps": []})
+        out["mfu"] = mfu or None
+        out["model_flops_s"] = flops_s or None
+        out["phase_seconds"] = phase_seconds or None
+        out["dropped_events"] = dropped or None
+        return out
+
     def _all_events(self) -> List[dict]:
         with self._lock:
             return [e for win in self._events.values() for e in win]
@@ -438,6 +495,7 @@ class ClusterAggregator:
             "slices": slice_groups,
             "stale_slices": stale_slices,
             "serving": self._serving_summary(rows),
+            "xray": self._xray_summary(rows, events),
             "skew": skewlib.skew_rows(events)[:top],
             "slowest_per_step": skewlib.slowest_rank_per_step(events)[-top:],
             "straggler": skewlib.straggler_verdict(events),
@@ -481,6 +539,36 @@ class ClusterAggregator:
                 "# TYPE kf_cluster_kv_cache_bytes gauge",
                 f"kf_cluster_kv_cache_bytes {srv['kv_bytes']}",
             ]
+        if view["xray"]:
+            xr = view["xray"]
+            if xr.get("mfu"):
+                lines += [
+                    "# HELP kf_cluster_mfu model-FLOPs utilization per "
+                    "rank (analytic FLOPs / detected chip peak)",
+                    "# TYPE kf_cluster_mfu gauge",
+                ]
+                for r in sorted(xr["mfu"]):
+                    lines.append(
+                        f'kf_cluster_mfu{{rank="{r}"}} {xr["mfu"][r]:.6g}')
+            if xr.get("model_flops_s"):
+                lines += [
+                    "# HELP kf_cluster_model_flops_s analytic model "
+                    "FLOP/s summed over reporting ranks",
+                    "# TYPE kf_cluster_model_flops_s gauge",
+                    f"kf_cluster_model_flops_s {xr['model_flops_s']:.6g}",
+                ]
+            if xr.get("phase_seconds"):
+                lines += [
+                    "# HELP kf_cluster_step_phase_seconds per-phase step-"
+                    "time decomposition, mean over reporting ranks "
+                    "(kf-xray taxonomy)",
+                    "# TYPE kf_cluster_step_phase_seconds gauge",
+                ]
+                for ph in sorted(xr["phase_seconds"]):
+                    lines.append(
+                        f'kf_cluster_step_phase_seconds'
+                        f'{{phase="{_esc_label(ph)}"}} '
+                        f'{xr["phase_seconds"][ph]:.6g}')
         version = (view["cluster"] or {}).get("version")
         if version is not None:
             lines += [
@@ -539,7 +627,12 @@ REPORT_KINDS = (frozenset(skewlib.COLLECTIVE_KINDS)
                 | frozenset(skewlib.FAULT_KINDS)
                 # kf-adapt swap events ride the same push so kftop's
                 # control/event surfaces see lockstep strategy changes
-                | frozenset({"swap"}))
+                | frozenset({"swap"})
+                # kf-xray attribution feedstock: REPORT_KINDS must stay
+                # a superset of xray.XRAY_KINDS (asserted in tests) or
+                # the online verdict would compute from fewer kinds than
+                # the offline report and the two could disagree
+                | xraylib.XRAY_KINDS | frozenset({"xray"}))
 
 #: EMA weight for the step-time estimate (~5-push memory)
 _STEP_EMA_ALPHA = 0.2

@@ -136,6 +136,67 @@ def _serving_lines(view: dict) -> List[str]:
     return lines
 
 
+def _fmt_flops(v) -> str:
+    if not v:
+        return "-"
+    for suffix, scale in (("PFLOP/s", 1e15), ("TFLOP/s", 1e12),
+                          ("GFLOP/s", 1e9), ("MFLOP/s", 1e6)):
+        if v >= scale:
+            return f"{v / scale:.2f}{suffix}"
+    return f"{v:.0f}FLOP/s"
+
+
+def _xray_lines(view: dict) -> List[str]:
+    """The XRAY section: continuous step-time decomposition + MFU /
+    model-FLOPs rate + the causal verdict (culprit rank and edge) the
+    aggregator computes with the SAME monitor/xray.py implementation as
+    the offline ``kftrace --critical-path`` report (docs/xray.md)."""
+    xr = field(view, "xray")
+    if not xr:
+        return []
+    lines = ["", "== XRAY (step-time decomposition; same math as "
+                 "`kftrace --critical-path`)"]
+    phases = field(xr, "phase_seconds")
+    v = field(xr, "verdict")
+    label = "phases/step"
+    if not phases and v:
+        # no rank exports the per-step gauges (no MFUMeter in the loop):
+        # fall back to the verdict's WINDOW TOTALS, divided back to a
+        # per-step mean — rendering a 32-step total under a per-step
+        # label would read as a 32x-inflated step
+        steps_seen = max(1, field(v, "steps_seen") or 1)
+        totals = field(v, "phases") or {}
+        phases = {ph: sec / steps_seen for ph, sec in totals.items()}
+        label = "phases/step (window mean)"
+    if phases:
+        lines.append(f"  {label}: " + " | ".join(
+            f"{ph} {_fmt_s(sec, 'ms')}" for ph, sec in sorted(
+                phases.items(), key=lambda kv: -kv[1])))
+    mfu = field(xr, "mfu")
+    flops = field(xr, "model_flops_s")
+    if mfu or flops:
+        mfu_txt = ("-" if not mfu else " ".join(
+            f"r{r}:{m:.3f}" for r, m in sorted(mfu.items())))
+        lines.append(f"  mfu {mfu_txt} | model rate {_fmt_flops(flops)}")
+    if v:
+        verdict_bits = []
+        if field(v, "straggler") is not None:
+            verdict_bits.append(f"straggler rank {field(v, 'straggler')}")
+        if field(v, "dominant") is not None:
+            verdict_bits.append(f"dominant {field(v, 'dominant')}")
+        c = field(v, "culprit")
+        if c:
+            verdict_bits.append(
+                f"culprit {field(c, 'op')}/{field(c, 'tag')} "
+                f"rank {field(c, 'slowest_rank')} -> "
+                f"rank {field(c, 'fastest_rank')} "
+                f"(skew {_fmt_s(field(c, 'skew_s'), 'ms')})")
+        if verdict_bits:
+            lines.append("  verdict: " + " | ".join(verdict_bits))
+        lines.append(f"  window: {field(v, 'steps_seen')} step(s)")
+    return lines
+
+
 def render_view(view: dict, top: int = 10) -> str:
     lines: List[str] = []
     wall = field(view, "wall")
@@ -224,6 +285,18 @@ def render_view(view: dict, top: int = 10) -> str:
     if not skew:
         lines.append("  (no cross-rank collective spans in the window — "
                      "is KF_CONFIG_ENABLE_TRACE on?)")
+    lines.extend(_xray_lines(view))
+    # a silently-lossy flight recorder must not look complete: the
+    # aggregator's ONE per-rank drop rollup (xray.dropped_events, from
+    # kf_timeline_dropped_total) becomes an explicit alarm line
+    lossy = field(field(view, "xray") or {}, "dropped_events") or {}
+    if lossy:
+        lines.append("")
+        lines.append(
+            "!! TRACE LOSS: flight-recorder ring evicted events — "
+            + ", ".join(f"rank {r}: {n}" for r, n in sorted(lossy.items()))
+            + " (raise KF_CONFIG_TIMELINE_CAP; skew/xray windows are "
+              "incomplete)")
     lines.extend(_serving_lines(view))
     return "\n".join(lines) + "\n"
 
@@ -247,6 +320,13 @@ def self_check() -> int:
         counters = {"kf_engine_retries_total": rank}
         gauges = {"kf_stat_gns": 1.5}
         latency = {"kf_collective_latency_seconds": {"count": 2, "sum": dur}}
+        if rank == 0:  # one rank exporting the kf-xray gauges
+            gauges["kf_mfu"] = 0.41
+            gauges["kf_model_flops_s"] = 1.2e12
+            gauges['kf_step_phase_seconds{phase="compute"}'] = 0.2
+            gauges['kf_step_phase_seconds{phase="comm_exposed"}'] = 0.05
+        if rank == 2:  # one lossy ring proves the TRACE LOSS alarm
+            counters["kf_timeline_dropped_total"] = 5
         if rank == 1:  # one serving rank proves the serving rollup
             counters['kf_serve_requests_total{what="complete"}'] = 7
             counters['kf_serve_requests_total{what="replay"}'] = 2
@@ -297,10 +377,27 @@ def self_check() -> int:
           and field(srv, "completed") == 7
           and field(srv, "replayed") == 2
           and abs(field(srv, "e2e_ms") - 500.0) < 1e-9)
+    # kf-xray section: the canned spans must attribute, the verdict must
+    # name the slow rank's edge (same monitor/xray.py math as the
+    # offline report), and the pushed gauges must roll up
+    xr = field(view, "xray")
+    xv = field(xr, "verdict") if xr else None
+    ok = (ok and xr is not None and xv is not None
+          and field(xv, "straggler") == 2
+          and field(field(xv, "culprit"), "slowest_rank") == 2
+          and abs(field(field(xv, "culprit"), "skew_s") - 0.09) < 1e-9
+          and field(xv, "steps_seen") == 1
+          and field(xr, "mfu") == {"0": 0.41}
+          and abs(field(xr, "model_flops_s") - 1.2e12) < 1.0
+          and field(xr, "phase_seconds") == {"compute": 0.2,
+                                             "comm_exposed": 0.05}
+          and field(xr, "dropped_events") == {"2": 5})
     text = render_view(view)
     ok = (ok and "STALE" in text and "all_reduce/grad3" in text
           and "coll-lat" in text and "SLICE LOSS" in text
-          and "== serving" in text and "replay" in text)
+          and "== serving" in text and "replay" in text
+          and "== XRAY" in text and "TRACE LOSS" in text
+          and "rank 2: 5" in text)
     if not ok:
         print("kftop: self-check FAILED (view schema/round-trip mismatch)",
               file=sys.stderr)

@@ -191,7 +191,18 @@ METRIC_HELP: Dict[str, str] = {
         "host-plane responder/sender pool size (scaled with peer count)",
     "kf_slice_events_total":
         "slice-granular recovery phase events (multislice), by phase",
-    "kf_timeline_dropped_total": "flight-recorder ring evictions",
+    "kf_timeline_dropped_total":
+        "flight-recorder ring evictions (a nonzero value means the "
+        "skew/xray windows are incomplete — kftop raises TRACE LOSS)",
+    "kf_mfu":
+        "model-FLOPs utilization: analytic model FLOP/s over the "
+        "detected (or KF_XRAY_PEAK_FLOPS-pinned) chip peak (kf-xray)",
+    "kf_model_flops_s":
+        "analytic model FLOP/s actually sustained (EMA; the MFU "
+        "numerator — reported alone on CPU meshes with no honest peak)",
+    "kf_step_phase_seconds":
+        "per-step wall decomposition by kf-xray phase (compute / "
+        "comm_exposed / comm_hidden / input_stall / straggler_wait)",
     "kf_opt_state_bytes":
         "per-rank optimizer-state footprint (worst device; ZeRO shards "
         "count one chunk, replicated state counts fully)",

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import atexit
 import collections
+import itertools
 import json
 import os
 import threading
@@ -83,12 +84,26 @@ EVENT_KINDS = frozenset({
                    # tag, nbytes, and the in-flight queue depth).  A hot
                    # kind: recorded only when tracing is on — the
                    # always-on surfaces are the kf_overlap_inflight
-                   # gauge and the kf_overlap_efficiency histogram
+                   # gauge and the kf_overlap_efficiency histogram.
+                   # Since kf-xray, recorded marks also ride the monitor
+                   # pushes (aggregator.REPORT_KINDS ⊇ xray.XRAY_KINDS:
+                   # the online attribution needs the async-tag set)
     "serve",       # serving-plane engine/router lifecycle (kf-serve,
                    # serve/engine.py + serve/router.py: prefill/decode
                    # spans — hot, ring-only — plus the rare worker-dead/
                    # slice-dead/readmit marks of the serving fault
                    # ladder)
+    "input",       # input-pipeline wait span (kf-xray: the consumer-side
+                   # block for the next batch — datasets/prefetch.py and
+                   # any loader that wants its stall attributed.  A hot
+                   # kind, one span per consumed batch, recorded only
+                   # when tracing is on; recorded spans also ride the
+                   # monitor pushes (REPORT_KINDS) so the online
+                   # input_stall attribution sees them)
+    "xray",        # kf-xray attribution mark (monitor/xray.py /
+                   # ops/costmodel.py: the rank-local per-step phase
+                   # split and MFU sample, so a dump carries the same
+                   # decomposition the live gauges export)
     "request",     # serving request lifecycle mark (kf-serve router:
                    # "accept" / "reject" / "complete" / "replay" /
                    # "lost").  A counted kind: every mark ticks
@@ -120,6 +135,116 @@ _cap: Optional[int] = None  # resolved lazily from CAP_ENV
 _dropped = 0
 _rank: Optional[int] = None
 _step = -1
+
+# -- causal context (kf-xray) ----------------------------------------------
+# Every recorded span carries a ``(trace, span, parent)`` triple in its
+# attrs: ``span`` is a process-unique id allocated at entry, ``trace``
+# groups spans of one logical operation ACROSS ranks/processes, and
+# ``parent`` is the enclosing span (same trace) when one exists.  Two
+# propagation paths, chosen so the hot path ships no extra wire bytes:
+#
+# * **derived** — collective spans compute the SAME trace id on every
+#   rank from values all ranks already agree on
+#   (:func:`collective_trace_id` over (cluster_version, step, op, tag)),
+#   so the cross-rank link costs zero wire bytes;
+# * **explicit** — request/response flows (serve frames, p2p blob pulls)
+#   carry a compact ``tc`` string in their existing JSON meta body; the
+#   receiving side re-enters it via :func:`trace_ctx` so its spans and
+#   events join the requester's trace.
+#
+# Ambient context is a per-thread stack: entering a span (or a
+# :func:`trace_ctx`) pushes ``(trace, span_id)``; events and child spans
+# recorded inside inherit it unless their call site passes explicit
+# ``trace=``/``parent=`` attrs.
+_span_seq = itertools.count(1)
+_tls = threading.local()
+
+
+def new_span_id() -> str:
+    """Process-unique span id (``s<rank>.<n>``); deterministic given the
+    event order, so replayed tests produce stable ids."""
+    r = _rank if _rank is not None else "x"
+    return f"s{r}.{next(_span_seq)}"
+
+
+def collective_trace_id(version, step, op: str, tag: str) -> str:
+    """Deterministic cross-rank trace id for one logical collective:
+    every participating rank derives the identical id from values it
+    already holds — the cluster version (mesh epoch), the current step,
+    and the collective's op/tag — so the same collective links across
+    ranks in a merged trace with NO extra wire bytes."""
+    return f"c{version}.{step}.{op}.{tag}"
+
+
+def _ctx_stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def current_trace() -> Tuple[Optional[str], Optional[str]]:
+    """``(trace_id, span_id)`` of the innermost ambient context on this
+    thread, or ``(None, None)``."""
+    st = _ctx_stack()
+    return st[-1] if st else (None, None)
+
+
+class trace_ctx:
+    """Re-enter a received trace context: spans/events recorded inside
+    join ``trace`` as children of ``parent`` (e.g. the serving worker
+    handling a router frame whose meta carried ``tc``)."""
+
+    __slots__ = ("trace", "parent")
+
+    def __init__(self, trace: Optional[str], parent: Optional[str] = None):
+        self.trace = trace
+        self.parent = parent
+
+    def __enter__(self):
+        _ctx_stack().append((self.trace, self.parent))
+        return self
+
+    def __exit__(self, *exc):
+        _ctx_stack().pop()
+        return False
+
+
+def parse_trace_context(tc) -> Tuple[Optional[str], Optional[str]]:
+    """``(trace, parent)`` from the compact wire form ``"trace"`` or
+    ``"trace@parent"`` (the ``tc`` meta field of serve/p2p frames);
+    ``(None, None)`` on anything malformed — a bad peer must not break
+    the receiver's recording."""
+    if not isinstance(tc, str) or not tc:
+        return None, None
+    trace, sep, parent = tc.partition("@")
+    if not trace:
+        # "@x" and friends: an empty trace id would group unrelated
+        # requests under one bogus "" trace — unlinked beats mislinked
+        return None, None
+    return trace, (parent or None) if sep else None
+
+
+def format_trace_context(trace: Optional[str],
+                         parent: Optional[str] = None) -> Optional[str]:
+    """The compact wire form consumed by :func:`parse_trace_context`."""
+    if not trace:
+        return None
+    return f"{trace}@{parent}" if parent else trace
+
+
+def context_attrs(trace: Optional[str],
+                  parent: Optional[str] = None) -> Dict[str, str]:
+    """Span/event attrs for an explicitly-propagated context: empty when
+    there is no (or an empty) trace, and never a literal ``None`` parent
+    — the dump schema stays uniform with the ambient-merge paths, which
+    omit absent keys entirely."""
+    if not trace:
+        return {}
+    attrs = {"trace": trace}
+    if parent is not None:
+        attrs["parent"] = parent
+    return attrs
 
 
 def enabled() -> bool:
@@ -192,10 +317,18 @@ def _count(kind: str, name: str) -> None:
 def event(kind: str, name: str, rank: Optional[int] = None,
           force: bool = False, **attrs) -> None:
     """One-shot mark.  Counted kinds always tick their registry counter;
-    the ring records only when tracing is enabled (or ``force``)."""
+    the ring records only when tracing is enabled (or ``force``).  An
+    ambient :func:`trace_ctx` (or enclosing span) stamps the mark's
+    ``trace``/``parent`` unless the call site passed its own."""
     _count(kind, name)
     if not (force or trace_enabled()):
         return
+    if "trace" not in attrs:
+        tr, parent = current_trace()
+        if tr is not None:
+            attrs["trace"] = tr
+            if parent is not None and "parent" not in attrs:
+                attrs["parent"] = parent
     _append(time.time(), rank, kind, name, 0.0, attrs)
 
 
@@ -215,7 +348,8 @@ _NOOP_SPAN = _NoopSpan()
 
 
 class _Span:
-    __slots__ = ("kind", "name", "rank", "attrs", "_t0", "_ts")
+    __slots__ = ("kind", "name", "rank", "attrs", "_t0", "_ts",
+                 "span_id", "_trace", "_parent")
 
     def __init__(self, kind, name, rank, attrs):
         self.kind = kind
@@ -224,16 +358,34 @@ class _Span:
         self.attrs = attrs
 
     def __enter__(self):
+        # causal triple: explicit trace= attr wins; else inherit the
+        # thread's ambient context.  The span then BECOMES the ambient
+        # parent for everything recorded inside it.
+        attrs = self.attrs
+        trace = (attrs or {}).get("trace")
+        parent = (attrs or {}).get("parent")
+        if trace is None:
+            trace, ambient_parent = current_trace()
+            if parent is None:
+                parent = ambient_parent
+        self.span_id = new_span_id()
+        self._trace, self._parent = trace, parent
+        _ctx_stack().append((trace, self.span_id))
         self._ts = time.time()
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, et, ev, tb):
         dt = time.perf_counter() - self._t0
-        attrs = self.attrs
+        _ctx_stack().pop()
+        attrs = dict(self.attrs or {})
         if et is not None:
-            attrs = dict(attrs or {})
             attrs["error"] = et.__name__
+        attrs["span"] = self.span_id
+        if self._trace is not None:
+            attrs["trace"] = self._trace
+        if self._parent is not None:
+            attrs["parent"] = self._parent
         _append(self._ts, self.rank, self.kind, self.name, dt, attrs)
         # aggregate parity: spans ARE trace scopes — trace_report() and
         # its histogram percentiles see every span duration, and the live
@@ -302,12 +454,13 @@ def reset(cap: Optional[int] = None) -> None:
     """Clear the ring — tests and long-lived processes re-arming a
     capture.  ``cap`` pins a capacity; without it the next append
     re-resolves ``KF_CONFIG_TIMELINE_CAP``."""
-    global _dropped, _cap, _step
+    global _dropped, _cap, _step, _span_seq
     with _lock:
         _ring.clear()
         _dropped = 0
         _cap = max(1, cap) if cap is not None else None
         _step = -1
+        _span_seq = itertools.count(1)  # stable span ids per capture
 
 
 def dump_path_from_env() -> Optional[str]:

@@ -14,9 +14,17 @@ Consumes the JSONL dumps written by :mod:`kungfu_tpu.monitor.timeline`
   per step window, and the overlap of fault events (chaos injections,
   peer deadlines, down verdicts) with latency spikes — "was a fault in
   flight when this collective stalled?" answered mechanically;
+* ``kftrace --critical-path dumps...`` — the kf-xray report: per-step
+  critical-path attribution (compute / comm_exposed / comm_hidden /
+  input_stall / straggler_wait), the culprit rank and edge, and the
+  longest dependency chain of the widest step
+  (:mod:`kungfu_tpu.monitor.xray` — the SAME implementation the live
+  aggregator serves under ``/cluster``, docs/xray.md);
 * ``kftrace --self-check [dumps...]`` — dump schema validation (with no
-  arguments it synthesizes a dump via the live timeline module and
-  round-trips it), wired into ``scripts/check.sh``.
+  arguments it synthesizes a dump via the live timeline module —
+  covering the collective/chaos/mark kinds AND the serving-plane
+  ``serve``/``request`` kinds — and round-trips it), wired into
+  ``scripts/check.sh``.
 
 Deliberately stdlib-only so the CLI runs in bare CI images (the
 ``scripts/kftrace`` launcher stubs the package like ``scripts/kflint``).
@@ -228,6 +236,17 @@ def self_check(paths: Sequence[str]) -> int:
             pass
         timeline.event("chaos", "delay", rank=0, force=True, ms=1)
         timeline.event("mark", "selfcheck", rank=0, force=True)
+        # serving-plane kinds (kf-serve, PR 13) must round-trip too —
+        # with the explicit trace context a served request carries, so
+        # the recorder/reader agreement covers the causal triple
+        with timeline.trace_ctx("srv.selfcheck", "s0.router"):
+            with timeline.span("serve", "prefill", rank=0, force=True,
+                               tokens=4, reused=0):
+                pass
+            timeline.event("request", "accept", rank=0, force=True,
+                           rid="selfcheck")
+        with timeline.span("input", "prefetch.next", rank=0, force=True):
+            pass
         fd, tmp = tempfile.mkstemp(suffix=".jsonl", prefix="kftrace-")
         os.close(fd)
         try:
@@ -236,11 +255,17 @@ def self_check(paths: Sequence[str]) -> int:
         finally:
             os.unlink(tmp)
             timeline.reset()
-        if header is None or len(events) != 3:
+        srv = [e for e in events if e["kind"] in ("serve", "request")]
+        ok = (header is not None and len(events) == 6
+              and len(srv) == 2
+              and all(e["attrs"].get("trace") == "srv.selfcheck"
+                      for e in srv))
+        if not ok:
             print("kftrace: self-check FAILED (round-trip mismatch)",
                   file=sys.stderr)
             return 1
-        print("kftrace: self-check ok (synthetic round-trip)")
+        print("kftrace: self-check ok (synthetic round-trip incl. "
+              "serve/request kinds + trace context)")
         return 0
     rc = 0
     for p in paths:
@@ -262,6 +287,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if "--self-check" in argv:
         argv.remove("--self-check")
         return self_check(argv)
+    if "--critical-path" in argv:
+        # the kf-xray offline report: same implementation as the live
+        # /cluster xray section (monitor/xray.py), fed from merged dumps
+        from kungfu_tpu.monitor import xray as xraylib
+
+        argv.remove("--critical-path")
+        if not argv:
+            print("kftrace: --critical-path needs at least one dump",
+                  file=sys.stderr)
+            return 2
+        try:
+            events = load_all(argv)
+        except (OSError, DumpError) as e:
+            print(f"kftrace: {e}", file=sys.stderr)
+            return 1
+        sys.stdout.write(xraylib.render_report(events))
+        return 0
     p = argparse.ArgumentParser(
         prog="kftrace",
         description="merge kungfu-tpu flight-recorder dumps; find stragglers",

@@ -43,6 +43,7 @@ import numpy as np
 from kungfu_tpu.models import nn
 from kungfu_tpu.models.transformer import Transformer, _rope
 from kungfu_tpu.monitor import timeline
+from kungfu_tpu.ops import costmodel
 from kungfu_tpu.serve import slo
 from kungfu_tpu.serve.kvcache import CacheExhausted, KVCachePool, PageSpec
 from kungfu_tpu.utils import envs
@@ -54,12 +55,15 @@ DEFAULT_MAX_TOKENS = 256
 class _Req:
     __slots__ = ("rid", "tokens", "max_new", "generated", "slot", "pages",
                  "reused", "computed", "submitted_s", "admitted_s",
-                 "first_token_s", "canceled")
+                 "first_token_s", "canceled", "trace", "parent")
 
-    def __init__(self, rid: str, tokens: Sequence[int], max_new: int):
+    def __init__(self, rid: str, tokens: Sequence[int], max_new: int,
+                 trace=None):
         self.rid = rid
         self.tokens = tuple(int(t) for t in tokens)
         self.max_new = int(max_new)
+        # kf-xray causal context (the router's trace, via the frame meta)
+        self.trace, self.parent = timeline.parse_trace_context(trace)
         self.generated: List[int] = []
         self.slot = -1
         self.pages: List[int] = []
@@ -118,6 +122,10 @@ class InferenceEngine:
         # the model math and the jit cache keys per prefill bucket shape
         self._decode_j = jax.jit(self._decode_fn)
         self._prefill_j = jax.jit(self._prefill_fn)
+        # kf-xray serving MFU: analytic prefill/decode FLOPs accumulate
+        # per step into the kf_model_flops_s gauge (+ kf_mfu when a chip
+        # peak is known; None on the CPU mesh — docs/xray.md)
+        self._mfu = costmodel.MFUMeter(rank=rank)
 
     # -- forward passes --------------------------------------------------
     def _layer_qkv(self, lp, x, positions):
@@ -272,14 +280,15 @@ class InferenceEngine:
             self._width = max(1, min(int(w), self.max_batch))
             return self._width
 
-    def submit(self, rid: str, tokens: Sequence[int], max_new: int) -> None:
+    def submit(self, rid: str, tokens: Sequence[int], max_new: int,
+               trace: Optional[str] = None) -> None:
         if not tokens:
             raise ValueError("empty prompt")
         if len(tokens) + max_new > self.max_seq:
             raise ValueError(
                 f"request {rid!r}: {len(tokens)} prompt + {max_new} new "
                 f"tokens exceeds max_seq {self.max_seq}")
-        req = _Req(rid, tokens, max_new)
+        req = _Req(rid, tokens, max_new, trace=trace)
         with self._wake:
             self._pending.append(req)
             self._wake.notify_all()
@@ -369,12 +378,16 @@ class InferenceEngine:
         s_pad = self._prefill_bucket(len(suffix))
         ids = np.zeros(s_pad, np.int32)
         ids[:len(suffix)] = suffix
+        tc_attrs = timeline.context_attrs(req.trace, req.parent)
         with timeline.span("serve", "prefill", rank=self.rank,
-                           tokens=len(suffix), reused=n_cached):
+                           tokens=len(suffix), reused=n_cached,
+                           rid=req.rid, **tc_attrs):
             self._k, self._v, tok = self._prefill_j(
                 self.params, self._k, self._v, jnp.asarray(ids),
                 jnp.int32(len(suffix)), jnp.int32(n_cached), jnp.int32(slot))
         req.computed = len(suffix)
+        self._mfu.add_flops(costmodel.serve_prefill_flops(
+            self.model.cfg, len(suffix), n_cached))
         req.first_token_s = time.perf_counter()
         req.generated.append(int(tok))
         slo.count_prefill(computed=len(suffix), reused=n_cached)
@@ -440,6 +453,7 @@ class InferenceEngine:
         ``{"kind": "admit"|"token"|"done", ...}`` in occurrence order."""
         events: List[dict] = []
         self._steps += 1
+        t_step0 = time.perf_counter()
         admitted = 0
         while admitted < self.admit_per_step:
             with self._lock:
@@ -481,12 +495,17 @@ class InferenceEngine:
                     jnp.asarray(last), jnp.asarray(pos))
             nxt = np.asarray(jax.device_get(nxt))
             slo.observe_token(time.perf_counter() - t0)
+            cfg = self.model.cfg
+            self._mfu.add_flops(sum(
+                costmodel.serve_decode_flops(cfg, int(pos[slot]) + 1)
+                for slot in active))
             for slot, r in active.items():
                 r.generated.append(int(nxt[slot]))
                 events.append({"kind": "token", "rid": r.rid,
                                "tok": int(nxt[slot]), "n": len(r.generated)})
                 if self._is_done(r):
                     events.append({"kind": "done", **self._complete(slot, r)})
+        self._mfu.step(wall_s=time.perf_counter() - t_step0)
         slo.note_active(self.active_count)
         return events
 

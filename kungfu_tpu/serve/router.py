@@ -157,6 +157,14 @@ class ServeWorker:
         except (ValueError, KeyError) as e:
             _log.warning("bad serve request from %s: %s", src, e)
             return
+        # kf-xray: the frame's meta carries the router's trace context;
+        # this worker's handling mark and the engine's prefill span join
+        # that trace (malformed/absent tc = unlinked, never an error)
+        trace, parent = timeline.parse_trace_context(req.get("tc"))
+        if timeline.enabled():
+            timeline.event("serve", "request-recv",
+                           rank=self.peer.chaos_rank(), rid=rid, att=att,
+                           **timeline.context_attrs(trace, parent))
         ctl = chaos_inject.controller_for(self.peer.chaos_rank())
         if ctl is not None and ctl.on_serve_request(rid):
             return  # injected frame loss: the router's deadline re-admits
@@ -189,7 +197,9 @@ class ServeWorker:
                              queue_s=0.0, reused_tokens=0, computed_tokens=0)
             return
         try:
-            self.engine.submit(f"{rid}#{att}", prompt + committed, remaining)
+            self.engine.submit(f"{rid}#{att}", prompt + committed, remaining,
+                               trace=timeline.format_trace_context(trace,
+                                                                   parent))
         except ValueError as e:
             self._queue_done(rid, [], ok=False, error=str(e))
 
@@ -337,6 +347,12 @@ class RequestHandle:
         self.rid = rid
         self.prompt = list(int(t) for t in prompt)
         self.max_new = int(max_new)
+        #: kf-xray causal trace of this request: one trace id spans the
+        #: router's admission events, the worker's frame handling, and
+        #: the engine's prefill span (docs/xray.md).  The router span id
+        #: is the parent every downstream span hangs off.
+        self.trace = f"srv.{rid}"
+        self.router_span = timeline.new_span_id()
         self.submitted_s = time.perf_counter()
         #: tokens committed across ALL workers (replay restarts here)
         self.committed: List[int] = []
@@ -451,12 +467,13 @@ class ServeRouter:
             depth = len(self._reqs)
             if depth >= self.queue_depth:
                 timeline.event("request", "reject",
-                               rank=self.peer.chaos_rank(), depth=depth)
+                               rank=self.peer.chaos_rank(), depth=depth,
+                               trace=h.trace, parent=h.router_span)
                 raise ServeOverloadError(depth, self.queue_depth)
             self._reqs[rid] = h
             slo.note_queue_depth(len(self._reqs))
         timeline.event("request", "accept", rank=self.peer.chaos_rank(),
-                       rid=rid)
+                       rid=rid, trace=h.trace, span=h.router_span)
         self._dispatch(h)
         return h
 
@@ -497,6 +514,12 @@ class ServeRouter:
                 # double-counted even when the replay landed on the SAME
                 # worker (where the src guard alone is blind)
                 "att": h.replays,
+                # kf-xray trace context in the existing JSON meta (the
+                # HeaderCodec wire header is untouched): the worker
+                # re-enters it so its handling + the engine prefill span
+                # join this request's trace (docs/xray.md)
+                "tc": timeline.format_trace_context(h.trace,
+                                                    h.router_span),
             }).encode()
             try:
                 self.peer.channel.send(addr, f"{REQ_PREFIX}{h.rid}", body,
@@ -574,7 +597,8 @@ class ServeRouter:
         e2e = h.done_s - h.submitted_s
         slo.observe_e2e(e2e)
         timeline.event("request", "complete", rank=self.peer.chaos_rank(),
-                       rid=rid, e2e_ms=e2e * 1e3, replays=h.replays)
+                       rid=rid, e2e_ms=e2e * 1e3, replays=h.replays,
+                       trace=h.trace, parent=h.router_span)
         h._done.set()
 
     def _fail(self, h: RequestHandle, err: BaseException,
@@ -585,7 +609,7 @@ class ServeRouter:
         h.error = err
         h.done_s = time.perf_counter()
         timeline.event("request", count, rank=self.peer.chaos_rank(),
-                       rid=h.rid)
+                       rid=h.rid, trace=h.trace, parent=h.router_span)
         h._done.set()
 
     # -- the fault ladder --------------------------------------------------
@@ -633,7 +657,8 @@ class ServeRouter:
             h.replays += 1
             self._replayed += 1
         timeline.event("request", "replay", rank=self.peer.chaos_rank(),
-                       rid=h.rid, committed=len(h.committed))
+                       rid=h.rid, committed=len(h.committed),
+                       trace=h.trace, parent=h.router_span)
         self._dispatch(h)
 
     def mark_worker_dead(self, rank: int, readmit: bool = True) -> List[int]:

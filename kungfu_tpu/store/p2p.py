@@ -17,6 +17,7 @@ import threading
 from typing import Optional
 
 from kungfu_tpu.comm.host import SERVE_NAME_PREFIX, ConnType, HostChannel
+from kungfu_tpu.monitor import timeline
 from kungfu_tpu.plan.peer import PeerID, parse_peer_id
 from kungfu_tpu.store.store import get_local_store
 from kungfu_tpu.utils.log import get_logger
@@ -47,12 +48,20 @@ def install_p2p_handler(channel: HostChannel, store=None,
 
     def serve(name: str, payload: bytes, src: str):
         # name = "req.<id>"; payload = json {"name":..., "version":...,
-        # "raw": 0|1}
+        # "raw": 0|1, "tc": optional kf-xray trace context}
         req_id = name[len("req."):]
         raw = False
         try:
             req = json.loads(payload.decode())
             blob_name = req["name"]
+            if timeline.enabled():
+                # the requester's trace context rides the request meta:
+                # this mark links the responder side into the same
+                # distributed trace (docs/xray.md)
+                tr, parent = timeline.parse_trace_context(req.get("tc"))
+                timeline.event("mark", "p2p.serve", req=req_id,
+                               blob=str(blob_name),
+                               **timeline.context_attrs(tr, parent))
             raw = bool(req.get("raw"))
             st = (control_store
                   if control_store is not None and blob_name.startswith("kf.")
@@ -143,6 +152,18 @@ def install_p2p_handler(channel: HostChannel, store=None,
     return stop
 
 
+def _req_meta(name: str, version: Optional[str], **extra) -> dict:
+    """The request-frame JSON meta.  An ambient kf-xray trace context on
+    the calling thread rides along as the compact ``tc`` field, so the
+    responder's handling joins the requester's trace — the HeaderCodec
+    wire header carries nothing new."""
+    meta = {"name": name, "version": version or "", **extra}
+    tc = timeline.format_trace_context(*timeline.current_trace())
+    if tc is not None:
+        meta["tc"] = tc
+    return meta
+
+
 def _serve_locally(peer, target: PeerID, name: str, version: Optional[str]):
     """Single-process mode / self-request: answer from the own store.
     Returns ``(True, blob)`` when the request never needs the wire."""
@@ -168,7 +189,7 @@ def remote_request(
         # the gossip hot path uses remote_request_into)
         return blob if blob is None or isinstance(blob, bytes) else bytes(blob)
     req_id = f"{peer.config.self_id.port}-{next(_req_counter)}"
-    body = json.dumps({"name": name, "version": version or ""}).encode()
+    body = json.dumps(_req_meta(name, version)).encode()
     channel.send(target, f"req.{req_id}", body, ConnType.PEER_TO_PEER)
     rsp = channel.recv(target, f"rsp.{req_id}", ConnType.PEER_TO_PEER, timeout=timeout)
     if rsp[:1] != _OK:
@@ -208,9 +229,7 @@ def remote_request_into(
             return buf
         return bytes(src)  # size mismatch: raw bytes, like the wire path
     req_id = f"{peer.config.self_id.port}-{next(_req_counter)}"
-    body = json.dumps(
-        {"name": name, "version": version or "", "raw": 1}
-    ).encode()
+    body = json.dumps(_req_meta(name, version, raw=1)).encode()
     # register the destination BEFORE the request leaves: the responder's
     # writev then streams socket→buf with no queue detour even when it
     # answers faster than we can turn around

@@ -105,6 +105,15 @@ Tuning envs (read anywhere, any time):
                                    colocated workers (utils/affinity.py)
 ``KF_CONFIG_WATCH_GRACE``          runner natural-end grace window s,
                                    default 10 (runner/watch.py)
+``KF_XRAY_WINDOW_STEPS``           steps in the online kf-xray
+                                   attribution window the aggregator
+                                   serves under /cluster -> xray,
+                                   default 32 (monitor/xray.py)
+``KF_XRAY_PEAK_FLOPS``             per-chip peak FLOP/s pinned for the
+                                   kf_mfu gauge, overriding TPU
+                                   device-kind detection; unset on CPU
+                                   meshes = no MFU, model-FLOPs rate
+                                   only (ops/costmodel.py)
 =================================  ============================================
 
 Transport / native-runtime envs:
@@ -328,6 +337,12 @@ TIMELINE_CAP = "KF_CONFIG_TIMELINE_CAP"
 ENABLE_CLUSTER_MONITOR = "KF_CONFIG_ENABLE_CLUSTER_MONITOR"
 MONITOR_PUSH_PERIOD = "KF_CONFIG_MONITOR_PUSH_PERIOD"
 MONITOR_STALE_AFTER = "KF_CONFIG_MONITOR_STALE_AFTER"
+
+# kf-xray envs (monitor/xray.py + ops/costmodel.py define mirror
+# constants next to their readers, like timeline.py's CAP_ENV; the
+# env-contract scan anchors the tokens here)
+XRAY_WINDOW_STEPS = "KF_XRAY_WINDOW_STEPS"
+XRAY_PEAK_FLOPS = "KF_XRAY_PEAK_FLOPS"
 
 # multislice envs.  The MEGASCALE_* names are the TPU runtime's own
 # contract (libtpu/GKE publish them on every pod host; the emulation

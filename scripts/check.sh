@@ -125,6 +125,25 @@ if ! timeout -k 10 150 python3 examples/overlap_pipeline.py \
     fail=1
 fi
 
+echo "== xray-gate (causal attribution + perf budget on the chaos mesh)"
+# kf-xray end to end: 3-rank mesh with a planted 30 ms link delay — the
+# offline kftrace --critical-path verdict and the online aggregator
+# verdict must be IDENTICAL and must name the planted edge, and the
+# per-phase medians must sit inside the checked-in ceilings of
+# tests/xray_budget.json (docs/xray.md).  Bounded: a wedged mesh must
+# fail the gate, not hang it.
+rm -f /tmp/_kf_xray_gate.log
+if ! timeout -k 10 300 python3 bench.py --xray --quick \
+        > /tmp/_kf_xray_gate.log 2>/dev/null \
+        || ! grep -q '"budget_ok": true' /tmp/_kf_xray_gate.log \
+        || ! grep -q '"offline_online_verdict_identical": true' \
+        /tmp/_kf_xray_gate.log \
+        || ! grep -q '"vs_baseline": 1.0' /tmp/_kf_xray_gate.log; then
+    echo "ERROR: xray gate failed (attribution checks or perf budget)"
+    tail -5 /tmp/_kf_xray_gate.log || true
+    fail=1
+fi
+
 echo "== pallas-check (ICI ring kernels bitwise vs the lax references)"
 # the make pallas-check gate: interpreter-path kernels pinned bitwise
 # against the order-matched lax emulation and the psum_scatter/

@@ -33,6 +33,10 @@ run headline   1800 python bench.py
 run kernels    1500 python bench.py --kernels
 run pallas     1500 python bench.py --pallas
 run serve      1500 python bench.py --serve
+# on-chip MFU decomposition: JAX_PLATFORMS=tpu routes the per-rank
+# fwd+bwd onto the chip, chip_peak_flops() detects the device kind, and
+# the mfu_decomp row gains a real kf_mfu next to the phase split
+run xray       1500 env JAX_PLATFORMS=tpu python bench.py --xray
 run xent_cross 1800 python benchmarks/xent_sweep.py --crossover
 run bn_sweep   1800 python benchmarks/bn_sweep.py
 run longctx    1500 python bench.py --kernels --seq-len 8192
