@@ -6,7 +6,7 @@ PY ?= python3
 BASELINE := tests/lint_baseline.json
 
 .PHONY: lint verify shardcheck pallas-check check test native trace-demo \
-    zero-demo multislice-demo adapt-demo overlap-demo serve-demo \
+    zero-demo multislice-demo adapt-demo overlap-demo serve-demo pp-demo \
     xray-gate help
 
 ## lint: all fourteen kf-lint rules — the Python suite (env-contract,
@@ -139,6 +139,18 @@ serve-demo:
 ## `python bench.py --overlap`, recorded in BENCH_extra.json).
 overlap-demo:
 	$(PY) examples/overlap_pipeline.py
+
+## pp-demo: kf-pipeline drill (2 in-process ranks = 2 emulated slices,
+## chaos `delay` injecting 30 ms on every cross-stage send): the same
+## steps run under naive sequential microbatching and under 1F1B with
+## async-handle prefetch — the script asserts BITWISE-identical final
+## params between the schedules, a measured 1F1B win, and a planned
+## 2->1 elastic stage merge restored bitwise from the ring-mirrored
+## StageBoundary (docs/pipeline.md; the full A/B with the xray bubble
+## decomposition is `python bench.py --pp`, recorded in
+## BENCH_extra.json).
+pp-demo:
+	$(PY) examples/pp_demo.py
 
 help:
 	@grep -E '^## ' Makefile | sed 's/^## //'

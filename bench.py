@@ -2096,6 +2096,161 @@ def payload_serve(args) -> dict:
     }
 
 
+def payload_pp(args) -> dict:
+    """kf-pipeline A/B (ISSUE 15 gate): a 2-stage cross-DCN pipeline
+    over a 2-rank in-process host-plane cluster — each rank emulating
+    one SLICE, 30 ms chaos-injected wire latency on every send (every
+    send IS a cross-slice activation/gradient hop at dp=1) — 1F1B with
+    async-handle prefetch vs naive sequential microbatching.  Final
+    params must be BITWISE identical between the schedules (the
+    schedule moves wall clock only), and the bubble fraction comes from
+    the kf-xray step decomposition (the ``pp_bubble`` phase over the
+    recorded ``pp`` spans).
+
+    Pure host-plane CPU (the multislice/adapt/overlap-row technique):
+    cannot be zeroed by a wedged TPU tunnel."""
+    import os
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    os.environ["KF_NATIVE_ENGINE"] = "0"  # chaos hooks ride the py path
+    os.environ.setdefault("KF_CONFIG_LOG_LEVEL", "WARNING")
+    os.environ["KF_CONFIG_ENABLE_TRACE"] = "1"  # xray bubble feedstock
+    wire_ms = 30
+    os.environ["KF_CHAOS_SPEC"] = f"delay:ms={wire_ms},on=send"
+
+    import jax
+    import optax
+
+    from kungfu_tpu.comm.engine import CollectiveEngine
+    from kungfu_tpu.comm.host import HostChannel
+    from kungfu_tpu.models.transformer import TransformerConfig
+    from kungfu_tpu.monitor import timeline, xray
+    from kungfu_tpu.parallel import pp as ppmod
+    from kungfu_tpu.parallel.train import ParallelPlan
+    from kungfu_tpu.plan import PeerID, PeerList, Strategy
+
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=32, n_layers=4, n_heads=2, d_ff=64,
+        max_seq=16, dtype="float32")
+    n_micro = 4 if args.quick else 8
+    steps = 2 if args.quick else 3
+    plan_of = {
+        "1f1b": ParallelPlan(pp=2, n_micro=n_micro, pp_schedule="1f1b"),
+        "sequential": ParallelPlan(pp=2, n_micro=n_micro,
+                                   pp_schedule="sequential"),
+    }
+    full = ppmod.init_stacked_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    B = n_micro * 2
+    ids = rng.integers(0, cfg.vocab_size, (B, 16)).astype(np.int32)
+    tgt = rng.integers(0, cfg.vocab_size, (B, 16)).astype(np.int32)
+
+    def run_arm(name, base_port):
+        plan = plan_of[name]
+        peers = PeerList.of(PeerID("127.0.0.1", base_port),
+                            PeerID("127.0.0.1", base_port + 1))
+        chans = [HostChannel(p, bind_host="127.0.0.1") for p in peers]
+        engines = []
+        try:
+            engines = [CollectiveEngine(c, peers, Strategy.STAR)
+                       for c in chans]
+            pipes = [ppmod.HostPipeline(e, plan, cfg, full_params=full,
+                                        inner=optax.sgd(0.125))
+                     for e in engines]
+
+            losses = []
+
+            def world(k):
+                outs = [None, None]
+                errs = []
+
+                def one(i):
+                    try:
+                        outs[i] = pipes[i].train_step(ids, tgt)
+                    except BaseException as e:  # noqa: BLE001
+                        errs.append(e)
+
+                ts = [threading.Thread(target=one, args=(i,), daemon=True)
+                      for i in range(2)]
+                t0 = _time.perf_counter()
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(600)
+                if errs or any(t.is_alive() for t in ts):
+                    raise RuntimeError(f"{name} step {k} wedged: {errs}")
+                return _time.perf_counter() - t0, outs[1]
+
+            world(0)  # warmup: compiles + socket bring-up
+            cursor, _ = timeline.events_tail(0)
+            walls = []
+            for k in range(steps):
+                dt, loss = world(1 + k)
+                walls.append(dt)
+                losses.append(float(loss))
+            cursor2, evs = timeline.events_tail(cursor)
+            # kf-xray decomposition over the measured window: the
+            # pp_bubble phase per rank / summed wall
+            bubble = wall = 0.0
+            for r in range(2):
+                split = xray.rank_phase_split(
+                    [e for e in evs if e.get("rank") == r])
+                bubble += split["pp_bubble"]
+                wall += split["wall_s"]
+            return {
+                "step_ms": round(1e3 * min(walls), 2),
+                "mean_step_ms": round(1e3 * float(np.mean(walls)), 2),
+                "losses": [round(l, 6) for l in losses],
+                "bubble_fraction_xray": round(bubble / wall, 4)
+                if wall else None,
+                "final": [np.concatenate(
+                    [np.asarray(l, np.float32).ravel()
+                     for l in jax.tree_util.tree_leaves(p.params[0])])
+                    for p in pipes],
+            }
+        finally:
+            # engines own thread pools: the sequential arm's must not
+            # survive into the 1f1b arm's timed window
+            for e in engines:
+                e.close()
+            for c in chans:
+                c.close()
+
+    rows = {}
+    finals = {}
+    for i, name in enumerate(("sequential", "1f1b")):
+        r = run_arm(name, 24500 + 10 * i)
+        finals[name] = r.pop("final")
+        rows[name] = r
+    bitwise = all(
+        np.array_equal(a, b)
+        for a, b in zip(finals["sequential"], finals["1f1b"]))
+    losses_equal = rows["sequential"]["losses"] == rows["1f1b"]["losses"]
+    speedup = rows["sequential"]["step_ms"] / rows["1f1b"]["step_ms"]
+    rows["bitwise_identical_final_params"] = bool(bitwise)
+    rows["losses_equal"] = bool(losses_equal)
+    rows["speedup_1f1b_vs_sequential"] = round(speedup, 3)
+    return {
+        "metric": "pp_1f1b_speedup_vs_naive_sequential",
+        "value": round(speedup, 3),
+        "unit": "x",
+        # the ISSUE 15 gate: >= 1.5x under 30 ms injected DCN latency
+        # with bitwise-identical finals
+        "vs_baseline": round(speedup, 3),
+        "gate_1p5x": bool(speedup >= 1.5 and bitwise and losses_equal),
+        "platform": "cpu-hostplane",
+        "n_devices": 2,
+        "model": (f"transformer d{cfg.d_model} L{cfg.n_layers} "
+                  f"vocab {cfg.vocab_size}, {n_micro} microbatches, "
+                  f"2 stages (1 rank per emulated slice), "
+                  f"{wire_ms} ms chaos delay on every send"),
+        "rows": rows,
+    }
+
+
 def payload_xray(args) -> dict:
     """kf-xray gate (ISSUE 14): causal step-time attribution + the
     mfu_decomp row, tunnel-proof on the CPU mesh.
@@ -2345,6 +2500,7 @@ PAYLOADS = {
     "pallas": payload_pallas,
     "serve": payload_serve,
     "xray": payload_xray,
+    "pp": payload_pp,
 }
 
 
@@ -2391,6 +2547,12 @@ def main() -> None:
     p.add_argument("--xray", action="store_true",
                    help="kf-xray attribution + mfu_decomp row on the "
                         "3-rank chaos CPU mesh (tunnel-proof)")
+    p.add_argument("--pp", dest="pp", action="store_true",
+                   help="kf-pipeline A/B: 1F1B vs naive sequential "
+                        "microbatching over a 2-stage emulated 2-slice "
+                        "pipeline under 30 ms injected DCN latency, "
+                        "bubble fraction from the xray decomposition "
+                        "(host-plane CPU; tunnel-proof)")
     p.add_argument("--pallas", action="store_true",
                    help="Pallas ICI ring collectives: interpret-kernel "
                         "bitwise A/B vs the lax references + traced-"
@@ -2413,6 +2575,7 @@ def main() -> None:
              else "overlap" if args.overlap
              else "serve" if args.serve
              else "xray" if args.xray
+             else "pp" if args.pp
              else "pallas" if args.pallas else "resnet")
     pallas_tpu = False
     if which == "pallas" and not args.cpu and not args.cpu_mesh:
@@ -2449,7 +2612,8 @@ def main() -> None:
     # veto measurements.
     pre_err = backend_preflight(
         cpu=args.cpu or bool(args.cpu_mesh)
-        or which in ("multislice", "adapt", "overlap", "serve", "xray")
+        or which in ("multislice", "adapt", "overlap", "serve", "xray",
+                     "pp")
         or pallas_tpu)
     if pre_err is None:
         out = run_guarded(fwd, timeout=args.timeout)
@@ -2513,6 +2677,8 @@ def main() -> None:
                       "serve_slo_cpu_mesh"),
             "xray": ("xray_comm_share_attributed_to_planted_link",
                      "fraction", "xray_cpu_mesh"),
+            "pp": ("pp_1f1b_speedup_vs_naive_sequential", "x",
+                   "pp_cpu_mesh"),
         }
         metric, unit, section = payload_info[which]
         out = {

@@ -59,6 +59,12 @@ _FENCE_CALLS = {
     # worker/slice re-dispatches its in-flight requests — a live async
     # handle must not straddle that either
     "mark_worker_dead",
+    # kf-pipeline stage re-carve (parallel/pp.py): the boundary's
+    # segment exchange reuses the host channel and the post-carve world
+    # has a different stage map — a handle issued under the old stage
+    # geometry (its tags name the old epoch's virtual stages) must
+    # settle before the carve, exactly like a resize
+    "recarve", "recarve_stages_after_shrink", "recarve_after_shrink",
 }
 
 _WAIT_ATTRS = {"wait"}

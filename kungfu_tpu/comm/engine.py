@@ -581,6 +581,77 @@ class CollectiveEngine:
             acc = acc / n
         return acc
 
+    # -- point-to-point (kf-pipeline) --------------------------------------
+    def send_to(self, rank: int, data, name: str) -> int:
+        """Deadline-bounded point-to-point send to ``rank`` on the
+        engine's wire (same retry/deadline/chaos machinery as the
+        collective sends — a dead receiver raises typed
+        :class:`PeerFailureError` naming the suspect instead of riding
+        the channel's full connect ladder).  ``data`` is an ndarray or
+        bytes; returns the payload size.  The pipeline-parallel
+        activation hop (``parallel/pp.py``) — NOT a collective: it does
+        not tick the collective counter and ``die:coll=N`` clauses do
+        not count it (``delay``/``die`` send-scoped clauses still fire
+        inside ``_send``)."""
+        if isinstance(data, np.ndarray):
+            payload = np.ascontiguousarray(data).tobytes()
+        else:
+            payload = bytes(data)
+        with timeline.span(
+            "collective", f"engine.send[{len(payload)}B]",
+            rank=self._timeline_rank, op="send", tag=name,
+            nbytes=len(payload),
+            # op "p2p" on BOTH halves: sender and receiver must derive
+            # the IDENTICAL trace id or the hop never forms a
+            # cross-rank causal edge in a merged trace
+            trace=self._trace_id("p2p", name),
+        ):
+            self._send(rank, name, payload)
+        return len(payload)
+
+    def recv_from(self, rank: int, name: str, dtype=None, shape=None):
+        """Deadline-bounded point-to-point receive from ``rank``.
+        Returns raw bytes, or an ndarray when ``dtype`` is given
+        (reshaped to ``shape`` when that is too).  Timeouts surface as
+        typed :class:`PeerFailureError` with the suspect rank — the
+        same contract as every collective recv."""
+        with timeline.span(
+            "collective", "engine.recv", rank=self._timeline_rank,
+            op="recv", tag=name, nbytes=0,
+            trace=self._trace_id("p2p", name),
+        ):
+            data = self._recv(rank, name)
+        if dtype is None:
+            return data
+        out = np.frombuffer(data, dtype=dtype)
+        return out.reshape(shape) if shape is not None else out
+
+    def send_async(self, rank: int, data, name: str) -> CollectiveHandle:
+        """Issue a point-to-point send and return immediately with a
+        :class:`CollectiveHandle` (kf-pipeline: the 1F1B activation
+        hop rides the async plane so the wire hides under stage
+        compute).  The tag is fixed HERE, at issue time on the calling
+        thread — the ``handle-discipline`` lint polices the handle's
+        lifetime exactly like the async collectives'."""
+        nbytes = data.nbytes if hasattr(data, "nbytes") else len(data)
+        return self._issue_async(
+            "send", name, nbytes, lambda: self.send_to(rank, data, name))
+
+    def recv_async(self, rank: int, name: str, dtype=None,
+                   shape=None) -> CollectiveHandle:
+        """Issue a point-to-point receive; the payload (and any typed
+        failure) surfaces at ``handle.wait()``.  The 1F1B prefetch
+        primitive: posting the recv one op early hides the DCN hop
+        under the current microbatch's compute.
+
+        Each in-flight async op occupies one async-pool slot until it
+        settles; callers owning MANY handles (a pipeline schedule)
+        must bound their outstanding set below the pool size — see
+        ``parallel/pp.py``'s prefetch discipline."""
+        return self._issue_async(
+            "recv", name, 0,
+            lambda: self.recv_from(rank, name, dtype=dtype, shape=shape))
+
     # -- async collectives (kf-overlap) ------------------------------------
     def all_reduce_async(self, x: np.ndarray, op: str = "sum",
                          name: str = "", record: bool = True

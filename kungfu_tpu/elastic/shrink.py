@@ -377,6 +377,7 @@ def recover_from_peer_failure(
     failure: Optional[BaseException] = None,
     snapshot=None,
     zero_boundary=None,
+    stage_boundary=None,
 ) -> Tuple[bool, Optional[Tuple[int, object, dict]]]:
     """The full survivor-side driver: confirm the dead set, shrink, and
     hand back the replay point.
@@ -400,12 +401,27 @@ def recover_from_peer_failure(
     their ring-buddy mirrors — and the caller restores the sharded state
     for the shrunk epoch with ``zero_boundary.place(new_comm)``.
 
+    ``stage_boundary`` (a :class:`kungfu_tpu.parallel.pp.StageBoundary`,
+    same all-or-none symmetry AND the same snapshot requirement) carries
+    a pipeline stage's params + ZeRO-2 optimizer chunks through the
+    shrink: after the membership is applied, the surviving stages
+    re-balance the LAYERS over themselves via the pure stage re-carve
+    plan — a whole dead stage (= a dead slice under the PP-across-DCN
+    mapping) is restored from the ring-buddy mirror on its predecessor
+    stage instead of aborting the job.  Recovery-ladder rung 10
+    (docs/fault_tolerance.md, docs/pipeline.md).
+
     ``shrunk=False`` means nothing provably died (a transient — the
     caller may simply retry the collective).  On quorum loss this
     signals the failure detector (``otherdown`` → the MonitoredRun
     relaunch, the pre-existing last resort) and re-raises
     :class:`QuorumLostError`.
     """
+    if stage_boundary is not None and snapshot is None:
+        raise ValueError(
+            "stage_boundary needs a StepSnapshot alongside it — the "
+            "leader-agreed replay step gates the stage re-carve against "
+            "survivors whose boundaries committed different steps")
     if zero_boundary is not None and snapshot is None:
         # checked before anything destructive: the recarve must be gated
         # on the leader-agreed replay step (survivors' boundaries can
@@ -459,6 +475,19 @@ def recover_from_peer_failure(
                 "may diverge — escalate to the checkpoint restart")
         recarve_after_shrink(peer, zero_boundary, old_workers,
                              expect_step=replay[0])
+    if shrunk and stage_boundary is not None:
+        from kungfu_tpu.parallel.pp import recarve_stages_after_shrink
+
+        # rung 10: re-balance pipeline stages over the survivors — the
+        # same step gate as the ZeRO re-carve, for the same reason
+        if replay is None:
+            raise RuntimeError(
+                "replay-point sync yielded no agreed step (broadcast "
+                "failed or no boundary was committed): the stage "
+                "re-carve cannot be step-gated and survivors' boundaries "
+                "may diverge — escalate to the checkpoint restart")
+        recarve_stages_after_shrink(peer, stage_boundary, old_workers,
+                                    expect_step=replay[0])
     return shrunk, replay
 
 

@@ -93,6 +93,16 @@ EVENT_KINDS = frozenset({
                    # spans — hot, ring-only — plus the rare worker-dead/
                    # slice-dead/readmit marks of the serving fault
                    # ladder)
+    "pp",          # pipeline-parallel lifecycle (kf-pipeline,
+                   # parallel/pp.py): "fwd"/"bwd" stage-compute spans
+                   # and the "bubble" span — the time a stage blocks on
+                   # a cross-DCN activation/gradient hop — plus the
+                   # rare "buddy-replicate"/"stage-recarve" marks of
+                   # the elastic stage re-carve.  A hot kind, recorded
+                   # only when tracing is on; recorded spans ride the
+                   # monitor pushes (REPORT_KINDS) so kf-xray's online
+                   # step decomposition attributes bubble time as its
+                   # own phase (monitor/xray.py::PHASES pp_bubble)
     "input",       # input-pipeline wait span (kf-xray: the consumer-side
                    # block for the next batch — datasets/prefetch.py and
                    # any loader that wants its stall attributed.  A hot

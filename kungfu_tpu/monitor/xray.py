@@ -54,16 +54,24 @@ from typing import Dict, List, Optional, Tuple
 
 from kungfu_tpu.monitor import skew as skewlib
 
-#: the attribution taxonomy, in render order
+#: the attribution taxonomy, in render order.  ``pp_bubble`` is the
+#: pipeline-parallel fill/drain wait (kf-pipeline "bubble" spans): time
+#: a stage spent blocked on a cross-DCN activation/gradient dependency
+#: — distinct from comm_exposed (the wire itself) because a prefetched
+#: hop's wire can be fully hidden while the stage STILL idles waiting
+#: for work (the schedule's bubble, not the network's).  Bubble time is
+#: EXCLUSIVE: comm intervals inside a bubble span are charged to the
+#: bubble (the wait), not double-counted as exposed wire — the phases
+#: keep tiling the step wall
 PHASES = ("compute", "comm_exposed", "comm_hidden", "input_stall",
-          "straggler_wait")
+          "pp_bubble", "straggler_wait")
 
 #: event kinds the attribution consumes.  Restricting BOTH consumers to
 #: this set is what makes "offline == online" assertable: a dump also
 #: carries send/recv/chaos marks the live plane never forwards, and wall
 #: windows computed over different kind sets would disagree.
 XRAY_KINDS = frozenset(skewlib.COLLECTIVE_KINDS) | frozenset(
-    {"input", "overlap"})
+    {"input", "overlap", "pp"})
 
 #: online attribution window (steps) — mirror constant next to its
 #: reader like timeline.py's CAP_ENV; utils/envs.py registers the token
@@ -131,7 +139,7 @@ def rank_phase_split(events: List[dict],
     t_lo = min(e["ts"] for e in spans + marks)
     t_hi = max(e["ts"] + e.get("dur", 0.0) for e in spans + marks)
     wall = max(0.0, t_hi - t_lo)
-    sync_comm, async_comm, inputs = [], [], []
+    sync_comm, async_comm, inputs, bubbles = [], [], [], []
     for e in spans:
         iv = (e["ts"], e["ts"] + e["dur"])
         if e["kind"] in skewlib.COLLECTIVE_KINDS:
@@ -139,10 +147,24 @@ def rank_phase_split(events: List[dict],
             (async_comm if tag in async_tags else sync_comm).append(iv)
         elif e["kind"] == "input":
             inputs.append(iv)
-    comm_exposed = _union_len(sync_comm)
-    comm_hidden = max(0.0, _union_len(sync_comm + async_comm) - comm_exposed)
+        elif e["kind"] == "pp" and e.get("name") == "bubble":
+            # the dependency wait itself; pp "fwd"/"bwd" spans are
+            # stage COMPUTE and deliberately fall through (subtracting
+            # them would hollow the compute phase out)
+            bubbles.append(iv)
+    # bubble owns its wall time: a blocking pipeline recv records BOTH
+    # a bubble span (the owner-thread wait) and a sync collective span
+    # (the wire) over the same interval — counting that interval in
+    # comm_exposed too would make the phases sum past the wall.  The
+    # comm phases therefore measure comm time OUTSIDE bubbles; with no
+    # bubble spans in the window every value below is byte-identical to
+    # the pre-pp math.
+    pp_bubble = _union_len(bubbles)
+    comm_exposed = max(0.0, _union_len(sync_comm + bubbles) - pp_bubble)
+    comm_hidden = max(0.0, _union_len(sync_comm + async_comm + bubbles)
+                      - _union_len(sync_comm + bubbles))
     input_stall = _union_len(inputs)
-    spanned = _union_len(sync_comm + async_comm + inputs)
+    spanned = _union_len(sync_comm + async_comm + inputs + bubbles)
     compute = max(0.0, wall - spanned)
     return {
         "wall_s": wall,
@@ -150,6 +172,7 @@ def rank_phase_split(events: List[dict],
         "comm_exposed": comm_exposed,
         "comm_hidden": comm_hidden,
         "input_stall": input_stall,
+        "pp_bubble": pp_bubble,
         "straggler_wait": 0.0,
     }
 

@@ -16,7 +16,8 @@ from kungfu_tpu.parallel.tp import (
     tp_region_enter,
     tp_region_exit,
 )
-from kungfu_tpu.parallel.train import ShardedTrainer, dp_train_step
+from kungfu_tpu.parallel.train import (ParallelPlan, ShardedTrainer,
+                                       dp_train_step)
 from kungfu_tpu.parallel.zero import (zero1_reshard, zero1_restore,
                                       zero1_snapshot, zero1_train_step)
 
@@ -27,6 +28,7 @@ __all__ = [
     "AXIS_SP",
     "AXIS_TP",
     "MeshPlan",
+    "ParallelPlan",
     "ShardedTrainer",
     "zero1_reshard",
     "zero1_restore",

@@ -26,7 +26,8 @@ reference handles with its group allreduce after local backprop
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, Optional, Tuple
+from dataclasses import dataclass, replace as _dc_replace
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -41,8 +42,129 @@ from kungfu_tpu.parallel import tp as tpmod
 from kungfu_tpu.parallel.mesh import AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP, MeshPlan
 from kungfu_tpu.parallel.moe import moe_apply
 from kungfu_tpu.parallel.ring import ring_attention
+from kungfu_tpu.utils import envs
 
 MOE_AUX_COEF = 0.01
+
+
+@dataclass(frozen=True)
+class ParallelPlan:
+    """THE parallelism configuration: every axis degree, the ZeRO stage,
+    and the pipeline schedule in one value, consumed by every
+    entrypoint instead of each hand-wiring its own axis combination —
+    :class:`ShardedTrainer` (in-mesh dp/pp/sp/tp), :func:`dp_train_step`
+    / :func:`~kungfu_tpu.parallel.zero.zero_train_step` (host/device DP
+    + ZeRO), :class:`~kungfu_tpu.parallel.pp.HostPipeline` (cross-DCN
+    pipeline), and the serving fleet
+    (:class:`kungfu_tpu.serve.scale.ServeFleet`).
+
+    Axis mapping follows the slice-major hierarchy (PR 8): **pp across
+    the DCN** (one stage per slice — ``pp`` ≡ ``MEGASCALE_NUM_SLICES``
+    on a multislice pod), **tp within the ICI** (never crosses a
+    slice), **dp/ZeRO across the replicas inside a slice** (host world
+    is ``pp × dp`` ranks).  ``to_slice_topology()`` exposes exactly
+    that correspondence; :meth:`HostPipeline.__init__` validates the
+    plan against the peer's live topology.
+    """
+
+    dp: int = 1
+    tp: int = 1
+    pp: int = 1
+    sp: int = 1
+    #: 0 = replicated optimizer; 1/2/3 route the ZeRO family
+    zero_stage: int = 0
+    #: pipeline microbatches (None -> pp, the minimum that fills it)
+    n_micro: Optional[int] = None
+    #: pipeline schedule: "1f1b" | "interleaved" | "sequential"
+    pp_schedule: str = "1f1b"
+    #: model chunks per stage for the interleaved schedule
+    interleave: int = 1
+    #: allreduce decomposition arm (ops.schedules.ALLREDUCE_SCHEDULES)
+    collective_schedule: str = "psum"
+
+    def __post_init__(self):
+        from kungfu_tpu.parallel.pp import SCHEDULES
+
+        for name, v in (("dp", self.dp), ("tp", self.tp),
+                        ("pp", self.pp), ("sp", self.sp)):
+            if v < 1:
+                raise ValueError(f"{name}={v} must be >= 1")
+        if self.zero_stage not in (0, 1, 2, 3):
+            raise ValueError(f"zero_stage={self.zero_stage} not in 0..3")
+        if self.pp_schedule not in SCHEDULES:
+            raise ValueError(
+                f"pp_schedule={self.pp_schedule!r}; one of {SCHEDULES}")
+        if self.interleave < 1:
+            raise ValueError(f"interleave={self.interleave} must be >= 1")
+        if self.interleave > 1 and self.pp_schedule != "interleaved":
+            raise ValueError(
+                "interleave > 1 requires pp_schedule='interleaved'")
+        if self.n_micro is not None and self.n_micro < 1:
+            raise ValueError(f"n_micro={self.n_micro} must be >= 1")
+
+    # -- shape -------------------------------------------------------------
+    @property
+    def size(self) -> int:
+        """Device count of the in-mesh form (dp*pp*sp*tp)."""
+        return self.dp * self.pp * self.sp * self.tp
+
+    @property
+    def host_size(self) -> int:
+        """Host-plane world size of the cross-DCN form: one rank per
+        (stage, dp lane); tp/sp ride each rank's LOCAL device mesh."""
+        return self.dp * self.pp
+
+    def mesh_plan(self) -> MeshPlan:
+        return MeshPlan(dp=self.dp, pp=self.pp, sp=self.sp, tp=self.tp)
+
+    def build_mesh(self, devices=None):
+        return self.mesh_plan().build_mesh(devices)
+
+    # -- pipeline geometry (stage-major = slice-major rank layout) ---------
+    def stage_map(self, n_layers: int) -> List[Tuple[int, int]]:
+        from kungfu_tpu.parallel.pp import stage_partition
+
+        return stage_partition(n_layers, self.pp)
+
+    def stage_of(self, rank: int) -> int:
+        return rank // self.dp
+
+    def dp_index(self, rank: int) -> int:
+        return rank % self.dp
+
+    def stage_ranks(self, stage: int) -> List[int]:
+        return list(range(stage * self.dp, (stage + 1) * self.dp))
+
+    def to_slice_topology(self):
+        """The multislice topology this plan maps onto (PP across DCN
+        slices, dp lanes within each), or None when single-stage."""
+        if self.pp <= 1:
+            return None
+        from kungfu_tpu.elastic.slices import SliceTopology
+
+        return SliceTopology(self.pp, self.dp)
+
+    def with_stages(self, pp: int) -> "ParallelPlan":
+        """The post-re-carve plan: same axes, ``pp`` stages (the
+        elastic stage re-carve shrinks this, never dp/tp)."""
+        return _dc_replace(self, pp=pp)
+
+    # -- env contract ------------------------------------------------------
+    @classmethod
+    def from_env(cls, **overrides) -> "ParallelPlan":
+        """Plan from the launch contract: ``KF_PP_STAGES``,
+        ``KF_PP_MICROBATCHES`` (0 -> pp), ``KF_PP_SCHEDULE``
+        (1f1b | interleaved | sequential); explicit kwargs win."""
+        import os
+
+        vals = dict(
+            pp=envs.parse_int_env(envs.PP_STAGES, 1),
+            n_micro=envs.parse_int_env(envs.PP_MICROBATCHES, 0) or None,
+            pp_schedule=(os.environ.get(envs.PP_SCHEDULE, "")
+                         or "1f1b").strip().lower(),
+        )
+        vals.update(overrides)
+        return cls(**vals)
 
 # parameter kinds → (psum axes, replication denominator axes)
 _KIND_AXES = {
@@ -71,7 +193,7 @@ class ShardedTrainer:
     def __init__(
         self,
         cfg: TransformerConfig,
-        plan: MeshPlan,
+        plan: Union[MeshPlan, "ParallelPlan"],
         n_experts: int = 0,
         n_micro: Optional[int] = None,
         tx: Optional[optax.GradientTransformation] = None,
@@ -80,6 +202,26 @@ class ShardedTrainer:
         schedule: str = "psum",
         fuse_grads: bool = False,
     ):
+        if isinstance(plan, ParallelPlan):
+            # the unified plan: axis degrees, microbatching, and the
+            # collective schedule all come from one value
+            if plan.zero_stage:
+                raise ValueError(
+                    "ShardedTrainer holds one replicated optimizer over "
+                    "the mesh — ZeRO stages route through dp_train_step/"
+                    "zero_train_step (device DP) or HostPipeline "
+                    "(cross-DCN pp)")
+            n_micro = n_micro or plan.n_micro
+            # same disagreement contract as dp_train_step/zero_train_step:
+            # an explicit non-default schedule kwarg must not be silently
+            # clobbered by the plan (nor silently win over it)
+            if schedule != "psum" and schedule != plan.collective_schedule:
+                raise ValueError(
+                    f"schedule={schedule!r} disagrees with "
+                    f"plan.collective_schedule="
+                    f"{plan.collective_schedule!r} — set it in the plan")
+            schedule = plan.collective_schedule
+            plan = plan.mesh_plan()
         if cfg.pos not in ("rope", "learned"):
             raise ValueError(f"unknown position mode {cfg.pos!r}")
         if cfg.n_layers % plan.pp:
@@ -446,6 +588,7 @@ def dp_train_step(
     has_aux: bool = False,
     donate: bool = False,
     zero_stage: Optional[int] = None,
+    plan: Optional[ParallelPlan] = None,
 ):
     """Pure data-parallel training step over a
     :class:`~kungfu_tpu.comm.device.Communicator` mesh.
@@ -482,6 +625,29 @@ def dp_train_step(
     opt_state, loss)`` jitted over the mesh; ``batch`` leading axis must
     be divisible by ``comm.size``.
     """
+    if plan is not None:
+        # the ParallelPlan route: this entrypoint is the pure-DP one —
+        # other axes have their own consumers (ShardedTrainer for the
+        # in-mesh 4-D step, parallel/pp.HostPipeline for cross-DCN pp)
+        if plan.tp != 1 or plan.pp != 1 or plan.sp != 1:
+            raise ValueError(
+                f"dp_train_step is the dp-only entrypoint but the plan "
+                f"carries tp={plan.tp} pp={plan.pp} sp={plan.sp} — use "
+                "ShardedTrainer (one mesh) or HostPipeline (cross-DCN)")
+        if zero_stage is not None and zero_stage != plan.zero_stage:
+            raise ValueError(
+                f"zero_stage={zero_stage} disagrees with "
+                f"plan.zero_stage={plan.zero_stage}")
+        if not plan.zero_stage and plan.collective_schedule != "psum":
+            # the replicated dp step reduces with psum/pmean only —
+            # silently ignoring the requested arm would defeat the
+            # ParallelPlan contract (entrypoints CONSUME the plan)
+            raise ValueError(
+                f"dp_train_step's replicated step has no "
+                f"{plan.collective_schedule!r} arm — use ShardedTrainer "
+                "(in-mesh schedule arms) or a ZeRO stage (bucket "
+                "schedules)")
+        zero_stage = plan.zero_stage or None
     if zero_stage is not None:
         if has_aux or not replicated_params:
             raise ValueError(
@@ -490,8 +656,14 @@ def dp_train_step(
                 "the fused flat buffer)")
         from kungfu_tpu.parallel.zero import zero_train_step
 
+        # zero's bucket collectives speak FLAT_SCHEDULES ("lax" |
+        # "pallas_ring"); the plan's allreduce arm maps onto them —
+        # pallas_ring passes through, everything else is the lax default
+        zsched = ("pallas_ring"
+                  if plan is not None
+                  and plan.collective_schedule == "pallas_ring" else "lax")
         return zero_train_step(loss_fn, tx, comm, stage=zero_stage,
-                               donate=donate)
+                               donate=donate, schedule=zsched)
     mesh, axis = comm.mesh, comm.axis
     pspec = P() if replicated_params else P(axis)
 

@@ -751,10 +751,11 @@ class ZeroStep:
 
 
 def zero_train_step(loss_fn, inner: optax.GradientTransformation, comm,
-                    stage: int = 2, average: bool = True,
+                    stage: Optional[int] = None, average: bool = True,
                     donate: bool = False,
                     bucket_bytes: int = 4 << 20,
-                    schedule: str = "lax") -> ZeroStep:
+                    schedule: Optional[str] = None,
+                    plan=None) -> ZeroStep:
     """Build a staged ZeRO data-parallel training step over ``comm``.
 
     ``stage``: 1 = all-reduce grads + sharded update (the classic ZeRO-1
@@ -778,9 +779,39 @@ def zero_train_step(loss_fn, inner: optax.GradientTransformation, comm,
     ``"pallas_ring"`` (the in-kernel-overlap ICI ring kernels of
     :mod:`kungfu_tpu.ops.pallas.collectives`; the stage-3 gather's
     custom vjp keeps the transposed gradient reduce-scatter).  The
-    sharded state geometry is identical either way."""
-    return ZeroStep(loss_fn, inner, comm, stage, average, donate,
-                    bucket_bytes, schedule)
+    sharded state geometry is identical either way.
+
+    ``plan`` (a :class:`~kungfu_tpu.parallel.train.ParallelPlan`)
+    supplies ``stage`` from ``plan.zero_stage`` and maps
+    ``plan.collective_schedule`` onto the bucket vocabulary — the
+    unified-plan route every entrypoint shares.  Both ``stage`` and
+    ``schedule`` default to None so an EXPLICIT argument is
+    distinguishable from the default: one that disagrees with the plan
+    raises instead of being silently replaced."""
+    if plan is not None:
+        if plan.tp != 1 or plan.pp != 1 or plan.sp != 1:
+            raise ValueError(
+                f"zero_train_step shards over ONE dp axis but the plan "
+                f"carries tp={plan.tp} pp={plan.pp} sp={plan.sp}")
+        if not plan.zero_stage:
+            raise ValueError("plan.zero_stage is 0 — use dp_train_step")
+        if stage is not None and stage != plan.zero_stage:
+            raise ValueError(
+                f"stage={stage} disagrees with plan.zero_stage="
+                f"{plan.zero_stage} — set it in the plan")
+        plan_sched = ("pallas_ring"
+                      if plan.collective_schedule == "pallas_ring"
+                      else "lax")
+        if schedule is not None and schedule != plan_sched:
+            raise ValueError(
+                f"schedule={schedule!r} disagrees with "
+                f"plan.collective_schedule="
+                f"{plan.collective_schedule!r} — set it in the plan")
+        stage = plan.zero_stage
+        schedule = plan_sched
+    return ZeroStep(loss_fn, inner, comm,
+                    2 if stage is None else stage, average, donate,
+                    bucket_bytes, "lax" if schedule is None else schedule)
 
 
 def zero_comm_bytes(total_params: int, n: int, stage: int,

@@ -828,7 +828,8 @@ class Peer:
 
     # -- in-flight fault tolerance (elastic.shrink) ------------------------
     def recover_from_failure(self, failure: Optional[BaseException] = None,
-                             snapshot=None, zero_boundary=None):
+                             snapshot=None, zero_boundary=None,
+                             stage_boundary=None):
         """Survivor-side in-flight recovery after a collective raised
         :class:`~kungfu_tpu.comm.faults.PeerFailureError`: confirm the
         dead set by ping, run the exclusion consensus, apply the shrunk
@@ -843,11 +844,18 @@ class Peer:
         ZeroBoundary`) carries ZeRO-sharded optimizer state through the
         shrink: it is re-carved leaderlessly across the survivors (dead
         ranks' chunks served from ring-buddy mirrors) — see
-        docs/zero.md."""
+        docs/zero.md.
+
+        ``stage_boundary`` (a :class:`kungfu_tpu.parallel.pp.
+        StageBoundary`) carries a pipeline stage through it the same
+        way: the survivors re-balance layers over the remaining stages,
+        a whole dead stage restored from its predecessor's ring-buddy
+        mirror — recovery-ladder rung 10 (docs/pipeline.md)."""
         from kungfu_tpu.elastic.shrink import recover_from_peer_failure
 
         return recover_from_peer_failure(self, failure, snapshot,
-                                         zero_boundary=zero_boundary)
+                                         zero_boundary=zero_boundary,
+                                         stage_boundary=stage_boundary)
 
     # -- monitoring / adaptation (reference peer.hpp GetPeerLatencies /
     # CheckInterference / GetEgressRates / SetTree) ----------------------

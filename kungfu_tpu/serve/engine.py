@@ -90,8 +90,24 @@ class InferenceEngine:
                  page_tokens: Optional[int] = None,
                  eos_id: Optional[int] = None,
                  admit_per_step: int = 1,
-                 rank: Optional[int] = None):
+                 rank: Optional[int] = None,
+                 plan=None):
         cfg = model.cfg
+        if plan is not None:
+            # the unified ParallelPlan route (parallel/train.py): a
+            # serving worker is one dp replica of the whole model —
+            # pipelined/TP-sharded serving engines are future work, so
+            # a plan asking for them must fail loudly here, not
+            # silently serve an unsharded model
+            if plan.pp != 1 or plan.tp != 1 or plan.sp != 1:
+                raise NotImplementedError(
+                    f"InferenceEngine serves one full-model replica per "
+                    f"worker; plan carries pp={plan.pp} tp={plan.tp} "
+                    f"sp={plan.sp} (TP-sharded serving is ROADMAP work)")
+            if plan.zero_stage:
+                raise ValueError("serving holds no optimizer state — "
+                                 "plan.zero_stage must be 0")
+        self.plan = plan
         self.model = model
         self.params = params
         self.rank = rank

@@ -440,6 +440,12 @@ class ServeRouter:
         with self._lock:
             return len(self._reqs)
 
+    def outstanding(self, rank: int) -> int:
+        """Requests currently dispatched to ``rank`` — the autoscaler's
+        drain check before retiring a worker (serve/scale.py)."""
+        with self._lock:
+            return int(self._outstanding.get(int(rank), 0))
+
     @property
     def completed(self) -> int:
         with self._lock:
@@ -660,6 +666,31 @@ class ServeRouter:
                        rid=h.rid, committed=len(h.committed),
                        trace=h.trace, parent=h.router_span)
         self._dispatch(h)
+
+    def admit_worker(self, rank: int) -> bool:
+        """Admit a (newly spawned or recovered) worker into the
+        schedulable set — the autoscale execution path
+        (:class:`kungfu_tpu.serve.scale.ServeFleet` spawns the engine +
+        :class:`ServeWorker`, then admits its rank here).  The rank
+        must exist in the peer's cluster membership; a rank previously
+        excluded by the fault ladder is re-admitted fresh (zero
+        strikes, zero outstanding).  Returns False when already live."""
+        workers = self.peer.config.cluster.workers
+        if not 0 <= rank < len(workers):
+            raise ValueError(
+                f"rank {rank} outside the {len(workers)}-worker cluster")
+        with self._lock:
+            if rank in self._live:
+                return False
+            self._live.add(int(rank))
+            self._dead.discard(int(rank))
+            self._addr[int(rank)] = workers[rank]
+            self._outstanding[int(rank)] = 0
+            self._strikes.pop(int(rank), None)
+        timeline.event("serve", "readmit", rank=self.peer.chaos_rank(),
+                       ranks=[int(rank)])
+        _log.info("serving worker %d admitted", rank)
+        return True
 
     def mark_worker_dead(self, rank: int, readmit: bool = True) -> List[int]:
         """Remove a worker (and, at slice grain, its whole slice) from

@@ -114,6 +114,19 @@ Tuning envs (read anywhere, any time):
                                    device-kind detection; unset on CPU
                                    meshes = no MFU, model-FLOPs rate
                                    only (ops/costmodel.py)
+``KF_PP_STAGES``                   pipeline stages (the cross-DCN pp
+                                   axis degree), default 1;
+                                   ParallelPlan.from_env reads it so
+                                   entrypoints stop hand-wiring the
+                                   axis combination (parallel/train.py)
+``KF_PP_MICROBATCHES``             pipeline microbatches per step, 0 =
+                                   the stage count (the minimum that
+                                   fills the pipe); parallel/train.py
+``KF_PP_SCHEDULE``                 pipeline microbatch schedule: 1f1b
+                                   (default) | interleaved |
+                                   sequential (the naive baseline the
+                                   bench gate measures against);
+                                   parallel/train.py -> parallel/pp.py
 =================================  ============================================
 
 Transport / native-runtime envs:
@@ -343,6 +356,12 @@ MONITOR_STALE_AFTER = "KF_CONFIG_MONITOR_STALE_AFTER"
 # env-contract scan anchors the tokens here)
 XRAY_WINDOW_STEPS = "KF_XRAY_WINDOW_STEPS"
 XRAY_PEAK_FLOPS = "KF_XRAY_PEAK_FLOPS"
+
+# pipeline-parallel envs (kf-pipeline: read by ParallelPlan.from_env in
+# parallel/train.py, consumed by parallel/pp.py)
+PP_STAGES = "KF_PP_STAGES"
+PP_MICROBATCHES = "KF_PP_MICROBATCHES"
+PP_SCHEDULE = "KF_PP_SCHEDULE"
 
 # multislice envs.  The MEGASCALE_* names are the TPU runtime's own
 # contract (libtpu/GKE publish them on every pod host; the emulation

@@ -125,6 +125,22 @@ if ! timeout -k 10 150 python3 examples/overlap_pipeline.py \
     fail=1
 fi
 
+echo "== pp-demo (1F1B beats sequential; elastic stage merge bitwise)"
+# kf-pipeline end to end: 2 emulated slices with 30 ms chaos delay on
+# every cross-stage send — naive sequential vs 1F1B over async p2p
+# handles must produce BITWISE-identical finals with a measured 1F1B
+# win, and the planned 2->1 stage merge must restore bitwise from the
+# ring-mirrored StageBoundary (docs/pipeline.md).  Bounded: a wedged
+# schedule or re-carve must fail the gate, not hang it.
+rm -f /tmp/_kf_pp_demo.log
+if ! timeout -k 10 240 python3 examples/pp_demo.py \
+        > /tmp/_kf_pp_demo.log 2>&1 \
+        || ! grep -q "pp-demo OK" /tmp/_kf_pp_demo.log; then
+    echo "ERROR: pp demo did not pass (schedule A/B or stage merge)"
+    tail -40 /tmp/_kf_pp_demo.log || true
+    fail=1
+fi
+
 echo "== xray-gate (causal attribution + perf budget on the chaos mesh)"
 # kf-xray end to end: 3-rank mesh with a planted 30 ms link delay — the
 # offline kftrace --critical-path verdict and the online aggregator

@@ -37,6 +37,10 @@ run serve      1500 python bench.py --serve
 # fwd+bwd onto the chip, chip_peak_flops() detects the device kind, and
 # the mfu_decomp row gains a real kf_mfu next to the phase split
 run xray       1500 env JAX_PLATFORMS=tpu python bench.py --xray
+# kf-pipeline: the CPU row emulates the 2-slice DCN with chaos delay;
+# first tunnel contact replaces it with stage compute on chip (the
+# host-plane hops and the 1F1B schedule are backend-independent)
+run pp         1500 python bench.py --pp
 run xent_cross 1800 python benchmarks/xent_sweep.py --crossover
 run bn_sweep   1800 python benchmarks/bn_sweep.py
 run longctx    1500 python bench.py --kernels --seq-len 8192

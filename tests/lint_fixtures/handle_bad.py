@@ -49,6 +49,23 @@ def held_across_worker_dead(engine, router, x):
     return h.wait()
 
 
+def held_across_stage_recarve(engine, boundary, peer, x):
+    h = engine.send_async(1, x, "pp.act")
+    boundary.recarve(2, peer=peer)       # stage re-carve fence in flight
+    return h.wait()
+
+
+def held_across_recarve_helper(engine, peer, boundary, old_workers, x):
+    h = engine.recv_async(0, "pp.grad")
+    recarve_stages_after_shrink(          # re-carve driver in flight
+        peer, boundary, old_workers)
+    return h.wait()
+
+
+def recarve_stages_after_shrink(peer, boundary, old_workers):
+    return None
+
+
 def elastic_step(peer, state, schedule, params):
     return state, params, False
 

@@ -48,6 +48,14 @@ def wait_then_resize(engine, peer, x):
     return out
 
 
+def wait_then_stage_recarve(engine, boundary, peer, x):
+    # the pp activation hop settles BEFORE the stage re-carve: fine
+    h = engine.send_async(1, x, "pp.act")
+    h.wait()
+    boundary.recarve(2, peer=peer)
+    return boundary
+
+
 def pipelined_window(engine, xs):
     # the canonical depth-k pipeline: issue nested in an expression
     # flows into the deque — not a tracked bare handle

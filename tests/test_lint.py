@@ -152,7 +152,8 @@ class TestHandleDiscipline:
     def test_bad_fixture_all_shapes_caught(self, tmp_path):
         got = sorted((v.line, v.message)
                      for v in self._violations(tmp_path, "handle_bad.py"))
-        assert [line for line, _ in got] == [6, 11, 17, 24, 34, 42, 48], got
+        assert [line for line, _ in got] == \
+            [6, 11, 17, 24, 34, 42, 48, 54, 60], got
         assert "dropped" in got[0][1]
         assert "never waited" in got[1][1]
         assert "every control-flow path" in got[2][1]
@@ -161,6 +162,10 @@ class TestHandleDiscipline:
         assert "shrink_to_survivors" in got[5][1]
         # the serving plane's membership boundary fences handles too
         assert "mark_worker_dead" in got[6][1]
+        # a kf-pipeline stage re-carve is a membership boundary too: a
+        # p2p handle tagged under the old stage geometry must not cross
+        assert "recarve" in got[7][1]
+        assert "recarve_stages_after_shrink" in got[8][1]
 
     def test_good_fixture_clean(self, tmp_path):
         got = self._violations(tmp_path, "handle_good.py")

@@ -1,0 +1,1067 @@
+"""kf-pipeline: cross-DCN pipeline parallelism (parallel/pp.py).
+
+The bitwise contract is the spine of this file: the distributed 1F1B
+run — any interleaving of stages, async handles, prefetched recvs,
+ZeRO-2 bucketed DP reduce-scatter — must produce byte-identical params
+to the single-process sequential reference built from the SAME stage
+modules, because the schedule and the transport are not allowed to
+change the math.  The elastic half pins the same property through a
+chaos ``die_slice``: one stage re-carve from ring-buddy mirrors, final
+params bitwise vs a fixed-world replay (docs/pipeline.md).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from kungfu_tpu import chaos
+from kungfu_tpu.checkpoint import StepSnapshot
+from kungfu_tpu.comm.engine import CollectiveEngine
+from kungfu_tpu.comm.faults import PeerFailureError
+from kungfu_tpu.comm.host import HostChannel
+from kungfu_tpu.models.transformer import TransformerConfig
+from kungfu_tpu.parallel import pp
+from kungfu_tpu.parallel.train import ParallelPlan
+from kungfu_tpu.plan import Cluster, PeerID, PeerList, Strategy
+
+from tests._util import run_all
+
+CFG = TransformerConfig(vocab_size=64, d_model=16, n_layers=4, n_heads=2,
+                        d_ff=32, max_seq=8, dtype="float32")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_chaos():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _engines(n, base_port, monkeypatch):
+    monkeypatch.setenv("KF_TPU_HOST_TRANSPORT", "python")
+    peers = PeerList.of(
+        *(PeerID("127.0.0.1", base_port + i) for i in range(n)))
+    chans = [HostChannel(p, bind_host="127.0.0.1") for p in peers]
+    return chans, [CollectiveEngine(c, peers, Strategy.STAR)
+                   for c in chans]
+
+
+def _data(seed, B, S=8):
+    rng = np.random.default_rng(seed)
+    return (rng.integers(0, CFG.vocab_size, (B, S)).astype(np.int32),
+            rng.integers(0, CFG.vocab_size, (B, S)).astype(np.int32))
+
+
+def _tree_equal(a, b) -> bool:
+    # host-side compare: the two trees may live on DIFFERENT local
+    # device pairs (per-rank tp meshes), which jnp refuses to mix
+    eqs = jax.tree_util.tree_map(
+        lambda x, y: bool(np.array_equal(np.asarray(x), np.asarray(y))),
+        a, b)
+    return all(jax.tree_util.tree_leaves(eqs))
+
+
+def _run_world(pipes, shards, steps=1):
+    """Drive every rank's train_step in threads; returns per-rank last
+    losses."""
+    n = len(pipes)
+    outs = [None] * n
+    errs = []
+
+    def one(i):
+        try:
+            for _ in range(steps):
+                ids, tgt = shards[i]
+                outs[i] = pipes[i].train_step(ids, tgt)
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            errs.append((i, e))
+
+    ts = [threading.Thread(target=one, args=(i,), daemon=True)
+          for i in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(240)
+    assert not any(t.is_alive() for t in ts), "pipeline hung"
+    assert not errs, errs
+    return outs
+
+
+# -- pure schedule / partition math -----------------------------------------
+class TestPartition:
+    def test_balanced_contiguous(self):
+        assert pp.stage_partition(12, 4) == [(0, 3), (3, 6), (6, 9),
+                                             (9, 12)]
+        assert pp.stage_partition(7, 3) == [(0, 3), (3, 5), (5, 7)]
+
+    def test_tiles_exactly(self):
+        for L in (4, 7, 12, 13):
+            for S in range(1, L + 1):
+                m = pp.stage_partition(L, S)
+                assert m[0][0] == 0 and m[-1][1] == L
+                assert all(a[1] == b[0] for a, b in zip(m, m[1:]))
+                assert all(hi > lo for lo, hi in m)
+
+    def test_empty_stage_rejected(self):
+        with pytest.raises(ValueError, match="cannot fill"):
+            pp.stage_partition(2, 3)
+
+    def test_interleaved_groups(self):
+        part = pp.interleaved_partition(8, 2, 2)
+        # stage s owns virtual stages s, s+S: chunks are non-adjacent
+        assert part == [[(0, 2), (4, 6)], [(2, 4), (6, 8)]]
+
+
+class TestSchedules:
+    @pytest.mark.parametrize("m,S", [(4, 2), (3, 2), (2, 4), (8, 4),
+                                     (1, 1)])
+    def test_1f1b_shape(self, m, S):
+        for s in range(S):
+            ops = pp.schedule_1f1b(m, S, s)
+            fs = [mb for k, mb, _ in ops if k == "F"]
+            bs = [mb for k, mb, _ in ops if k == "B"]
+            assert fs == list(range(m)) and bs == list(range(m))
+            # backward of mb can only run after its forward
+            seen_f = set()
+            for k, mb, _ in ops:
+                if k == "F":
+                    seen_f.add(mb)
+                else:
+                    assert mb in seen_f
+            # steady state: at most warmup+1 forwards outstanding
+            warm = min(S - 1 - s, m)
+            live = 0
+            peak = 0
+            for k, mb, _ in ops:
+                live += 1 if k == "F" else -1
+                peak = max(peak, live)
+            assert peak <= warm + 1
+
+    def test_sequential_is_strict(self):
+        assert pp.schedule_sequential(3, 2, 0) == [
+            ("F", 0, 0), ("B", 0, 0), ("F", 1, 0), ("B", 1, 0),
+            ("F", 2, 0), ("B", 2, 0)]
+
+    @pytest.mark.parametrize("m,S,v", [(4, 2, 2), (3, 2, 3), (2, 3, 2)])
+    def test_interleaved_valid_and_mb_ordered(self, m, S, v):
+        per_stage = [pp.schedule_interleaved(m, S, s, v)
+                     for s in range(S)]
+        V = S * v
+        for s, ops in enumerate(per_stage):
+            for c in range(v):
+                fs = [mb for k, mb, cc in ops if k == "F" and cc == c]
+                bs = [mb for k, mb, cc in ops if k == "B" and cc == c]
+                # strict microbatch order per chunk = the bitwise
+                # gradient-accumulation contract
+                assert fs == list(range(m)) and bs == list(range(m))
+        # global dependency replay: the merged op streams must be
+        # executable with blocking recvs (what the simulator guarantees)
+        f_done = [[False] * m for _ in range(V)]
+        b_done = [[False] * m for _ in range(V)]
+        cursors = [0] * S
+        moved = True
+        while moved:
+            moved = False
+            for s in range(S):
+                while cursors[s] < len(per_stage[s]):
+                    k, mb, c = per_stage[s][cursors[s]]
+                    vs = c * S + s
+                    if k == "F":
+                        ok = vs == 0 or f_done[vs - 1][mb]
+                    else:
+                        ok = f_done[vs][mb] and (
+                            vs == V - 1 or b_done[vs + 1][mb])
+                    if not ok:
+                        break
+                    (f_done if k == "F" else b_done)[vs][mb] = True
+                    cursors[s] += 1
+                    moved = True
+        assert all(c == len(per_stage[s]) for s, c in enumerate(cursors)), \
+            "interleaved schedule deadlocked in replay"
+
+    def test_build_schedule_vocabulary(self):
+        with pytest.raises(ValueError, match="unknown pp schedule"):
+            pp.build_schedule("gpipe", 4, 2, 0)
+        with pytest.raises(ValueError, match="interleave"):
+            pp.build_schedule("1f1b", 4, 2, 0, v=2)
+
+
+class TestRecarvePlans:
+    def test_stage_recarve_plan_units(self):
+        plan = pp.stage_recarve_plan(4, 2, 1)
+        # embed stays with stage 0, the final block moves 1 -> 0, and
+        # stage 1's layers move to the merged stage
+        assert (-1, 0, 0) in plan and (-2, 1, 0) in plan
+        assert (2, 1, 0) in plan and (3, 1, 0) in plan
+
+    @pytest.mark.parametrize("old_n,new_n", [(2, 1), (3, 2), (2, 3),
+                                             (4, 2), (1, 1)])
+    def test_flat_segments_tile_and_preserve_identity(self, old_n, new_n):
+        old_map = pp.stage_partition(CFG.n_layers, old_n) \
+            if old_n <= CFG.n_layers else None
+        if old_map is None:
+            pytest.skip("not enough layers")
+        new_map = pp.stage_partition(CFG.n_layers, new_n)
+        segs = pp.flat_recarve_segments(CFG, old_map, new_map)
+        old_lay, old_totals = pp.stage_flat_layouts(CFG, old_map)
+        new_lay, new_totals = pp.stage_flat_layouts(CFG, new_map)
+
+        def fill(lays, totals):
+            flats = []
+            for s, lay in enumerate(lays):
+                f = np.zeros(totals[s])
+                for key, gr0, rows, rowsize, off in lay:
+                    for r in range(rows):
+                        base = hash((key, gr0 + r)) % 100003
+                        f[off + r * rowsize:off + (r + 1) * rowsize] = \
+                            base + np.arange(rowsize) * 1e-7
+                flats.append(f)
+            return flats
+
+        oldf, want = fill(old_lay, old_totals), fill(new_lay, new_totals)
+        got = [np.full(t, np.nan) for t in new_totals]
+        cover = [np.zeros(t, bool) for t in new_totals]
+        for (os_, oo, ns, no, ln) in segs:
+            assert not cover[ns][no:no + ln].any(), "segment overlap"
+            cover[ns][no:no + ln] = True
+            got[ns][no:no + ln] = oldf[os_][oo:oo + ln]
+        for ns in range(new_n):
+            assert cover[ns].all(), "new stage flat not tiled"
+            assert np.array_equal(got[ns], want[ns])
+
+    def test_chunk_splits_tile(self):
+        out = list(pp._chunk_splits(5, 12, 20, 8, 16))
+        assert sum(l for *_, l in out) == 20
+        pos = 5
+        for jo, jn, oo, no, l in out:
+            assert oo == pos and no == pos + 7
+            assert jo == oo // 8 and jn == no // 16
+            assert oo // 8 == (oo + l - 1) // 8
+            assert no // 16 == (no + l - 1) // 16
+            pos += l
+
+
+# -- ParallelPlan ------------------------------------------------------------
+class TestParallelPlan:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="zero_stage"):
+            ParallelPlan(zero_stage=4)
+        with pytest.raises(ValueError, match="pp_schedule"):
+            ParallelPlan(pp_schedule="gpipe")
+        with pytest.raises(ValueError, match="interleave"):
+            ParallelPlan(interleave=2)  # needs the interleaved schedule
+        p = ParallelPlan(dp=2, tp=2, pp=3, sp=1, zero_stage=2)
+        assert p.size == 12 and p.host_size == 6
+        assert p.mesh_plan().pp == 3
+
+    def test_stage_geometry(self):
+        p = ParallelPlan(dp=2, pp=3)
+        assert p.stage_of(4) == 2 and p.dp_index(4) == 0
+        assert p.stage_ranks(1) == [2, 3]
+        assert p.stage_map(6) == [(0, 2), (2, 4), (4, 6)]
+        topo = p.to_slice_topology()
+        assert topo.num_slices == 3 and topo.ranks_per_slice == 2
+        assert ParallelPlan(dp=4).to_slice_topology() is None
+        assert p.with_stages(2).pp == 2 and p.with_stages(2).dp == 2
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv("KF_PP_STAGES", "3")
+        monkeypatch.setenv("KF_PP_MICROBATCHES", "6")
+        monkeypatch.setenv("KF_PP_SCHEDULE", "sequential")
+        p = ParallelPlan.from_env(dp=2)
+        assert (p.pp, p.n_micro, p.pp_schedule, p.dp) == \
+            (3, 6, "sequential", 2)
+        monkeypatch.delenv("KF_PP_STAGES")
+        monkeypatch.delenv("KF_PP_MICROBATCHES")
+        monkeypatch.delenv("KF_PP_SCHEDULE")
+        p = ParallelPlan.from_env()
+        assert (p.pp, p.n_micro, p.pp_schedule) == (1, None, "1f1b")
+
+    def test_dp_train_step_rejects_other_axes(self):
+        from kungfu_tpu.parallel.train import dp_train_step
+
+        with pytest.raises(ValueError, match="dp-only"):
+            dp_train_step(lambda p, b: 0.0, optax.sgd(0.1), comm=None,
+                          plan=ParallelPlan(pp=2))
+
+    def test_zero_train_step_plan_contract(self):
+        from kungfu_tpu.parallel.zero import zero_train_step
+
+        with pytest.raises(ValueError, match="zero_stage is 0"):
+            zero_train_step(lambda p, b: 0.0, optax.sgd(0.1), comm=None,
+                            plan=ParallelPlan())
+        with pytest.raises(ValueError, match="ONE dp axis"):
+            zero_train_step(lambda p, b: 0.0, optax.sgd(0.1), comm=None,
+                            plan=ParallelPlan(tp=2, zero_stage=2))
+        # an EXPLICIT stage/schedule that disagrees with the plan must
+        # raise, never be silently replaced (None defaults make the
+        # explicit case distinguishable)
+        with pytest.raises(ValueError, match="disagrees with "
+                                             "plan.zero_stage"):
+            zero_train_step(lambda p, b: 0.0, optax.sgd(0.1), comm=None,
+                            stage=2, plan=ParallelPlan(zero_stage=1))
+        with pytest.raises(ValueError, match="disagrees with "
+                                             "plan.collective_schedule"):
+            zero_train_step(lambda p, b: 0.0, optax.sgd(0.1), comm=None,
+                            schedule="lax",
+                            plan=ParallelPlan(
+                                zero_stage=2,
+                                collective_schedule="pallas_ring"))
+
+    def test_dp_train_step_rejects_unconsumable_arm(self):
+        from kungfu_tpu.parallel.train import dp_train_step
+
+        with pytest.raises(ValueError, match="no 'pallas_ring' arm"):
+            dp_train_step(lambda p, b: 0.0, optax.sgd(0.1), comm=None,
+                          plan=ParallelPlan(
+                              collective_schedule="pallas_ring"))
+
+    def test_sharded_trainer_accepts_plan(self):
+        from kungfu_tpu.parallel.train import ShardedTrainer
+
+        t = ShardedTrainer(CFG, ParallelPlan(n_micro=2,
+                                             collective_schedule="psum"))
+        assert t.plan.dp == 1 and t.n_micro == 2
+        with pytest.raises(ValueError, match="ZeRO"):
+            ShardedTrainer(CFG, ParallelPlan(zero_stage=2))
+
+    def test_serve_engine_rejects_sharded_plan(self):
+        from kungfu_tpu.models.transformer import Transformer
+        from kungfu_tpu.serve.engine import InferenceEngine
+
+        model = Transformer(CFG)
+        params = model.init(jax.random.PRNGKey(0))
+        with pytest.raises(NotImplementedError, match="full-model"):
+            InferenceEngine(model, params, plan=ParallelPlan(tp=2),
+                            max_batch=2)
+        eng = InferenceEngine(model, params, plan=ParallelPlan(dp=3),
+                              max_batch=2)
+        assert eng.plan.dp == 3
+
+
+# -- engine p2p ---------------------------------------------------------------
+class TestEngineP2P:
+    def test_sync_roundtrip(self, monkeypatch):
+        chans, engines = _engines(2, 27210, monkeypatch)
+        try:
+            x = np.arange(8, dtype=np.float32)
+
+            def a():
+                engines[0].send_to(1, x, "t.a")
+                return engines[0].recv_from(1, "t.b", dtype=np.int32,
+                                            shape=(2, 2))
+
+            def b():
+                got = engines[1].recv_from(0, "t.a", dtype=np.float32)
+                engines[1].send_to(0, np.arange(4, dtype=np.int32), "t.b")
+                return got
+
+            ra, rb = run_all([a, b])
+            assert np.array_equal(rb, x)
+            assert ra.shape == (2, 2)
+        finally:
+            for c in chans:
+                c.close()
+
+    def test_async_handles_settle(self, monkeypatch):
+        chans, engines = _engines(2, 27220, monkeypatch)
+        try:
+            x = np.arange(16, dtype=np.float32)
+
+            def a():
+                h = engines[0].send_async(1, x, "u.a")
+                return h.wait()
+
+            def b():
+                h = engines[1].recv_async(0, "u.a", dtype=np.float32)
+                return h.wait()
+
+            na, got = run_all([a, b])
+            assert na == x.nbytes
+            assert np.array_equal(got, x)
+        finally:
+            for c in chans:
+                c.close()
+
+    def test_p2p_trace_ids_link(self, monkeypatch):
+        """Sender and receiver of ONE hop must derive the IDENTICAL
+        trace id (op "p2p" on both halves) or the hop never forms a
+        cross-rank causal edge in a merged trace."""
+        from kungfu_tpu.monitor import timeline
+
+        monkeypatch.setenv("KF_CONFIG_ENABLE_TRACE", "1")
+        cursor, _ = timeline.events_tail(0)
+        chans, engines = _engines(2, 27225, monkeypatch)
+        try:
+            x = np.arange(8, dtype=np.float32)
+            run_all([
+                lambda: engines[0].send_to(1, x, "tr.hop"),
+                lambda: engines[1].recv_from(0, "tr.hop",
+                                             dtype=np.float32),
+            ])
+            _, evs = timeline.events_tail(cursor)
+            traces = {(e.get("attrs") or {}).get("trace")
+                      for e in evs
+                      if e.get("kind") == "collective"
+                      and (e.get("attrs") or {}).get("tag") == "tr.hop"}
+            assert len(traces) == 1 and None not in traces, traces
+        finally:
+            for c in chans:
+                c.close()
+
+    def test_typed_failure_at_wait(self, monkeypatch):
+        monkeypatch.setenv("KF_CONFIG_PEER_DEADLINE", "1.0")
+        chans, engines = _engines(2, 27230, monkeypatch)
+        chans[1].close()
+        try:
+            h = engines[0].recv_async(1, "never", dtype=np.float32)
+            with pytest.raises(PeerFailureError) as ei:
+                h.wait(timeout=30)
+            assert ei.value.rank == 1
+        finally:
+            chans[0].close()
+
+
+# -- the bitwise spine --------------------------------------------------------
+class TestPipelineBitwise:
+    @pytest.mark.parametrize("S,m,sched", [
+        (2, 4, "1f1b"),     # aligned
+        (2, 3, "1f1b"),     # ragged microbatch count
+        (4, 4, "1f1b"),     # deeper pipe
+        (4, 6, "1f1b"),     # ragged, deeper
+        (2, 4, "sequential"),
+    ])
+    def test_bitwise_vs_reference(self, S, m, sched, monkeypatch):
+        plan = ParallelPlan(pp=S, n_micro=m, pp_schedule=sched)
+        full = pp.init_stacked_params(CFG, jax.random.PRNGKey(0))
+        inner = optax.sgd(0.05)
+        ids, tgt = _data(7, B=m * 2)
+        ref_full, ref_losses, _ = pp.reference_pipeline_step(
+            CFG, plan, full, [(ids, tgt)], inner)
+        chans, engines = _engines(S, 27240 + 10 * S + m, monkeypatch)
+        try:
+            pipes = [pp.HostPipeline(e, plan, CFG, full_params=full,
+                                     inner=inner) for e in engines]
+            outs = _run_world(pipes, [(ids, tgt)] * S)
+            assert outs[-1] == pytest.approx(float(np.mean(ref_losses)),
+                                             abs=1e-6)
+            for pipe in pipes:
+                lo, hi = pipe.stage_layers()
+                want = pp.slice_stage_params(
+                    CFG, ref_full, lo, hi, pipe.stage == 0,
+                    pipe.stage == S - 1)
+                assert _tree_equal(pipe.params[0], want), \
+                    f"stage {pipe.stage} diverged from the reference"
+        finally:
+            for c in chans:
+                c.close()
+
+    def test_multi_step_bitwise(self, monkeypatch):
+        plan = ParallelPlan(pp=2, n_micro=2)
+        full = pp.init_stacked_params(CFG, jax.random.PRNGKey(1))
+        inner = optax.sgd(0.05, momentum=0.9)
+        ids, tgt = _data(8, B=4)
+        ref, states = dict(full), None
+        for _ in range(3):
+            ref, _, states = pp.reference_pipeline_step(
+                CFG, plan, ref, [(ids, tgt)], inner, opt_states=states)
+        chans, engines = _engines(2, 27280, monkeypatch)
+        try:
+            pipes = [pp.HostPipeline(e, plan, CFG, full_params=full,
+                                     inner=inner) for e in engines]
+            _run_world(pipes, [(ids, tgt)] * 2, steps=3)
+            for pipe in pipes:
+                lo, hi = pipe.stage_layers()
+                want = pp.slice_stage_params(CFG, ref, lo, hi,
+                                             pipe.stage == 0,
+                                             pipe.stage == 1)
+                assert _tree_equal(pipe.params[0], want)
+        finally:
+            for c in chans:
+                c.close()
+
+    def test_tp_within_stage_bitwise(self, monkeypatch):
+        """tp=2 over each rank's LOCAL device pair (conftest forces 8
+        virtual CPU devices): the Megatron column/row stage math under
+        shard_map, bitwise vs the same-tp reference."""
+        plan = ParallelPlan(pp=2, tp=2, n_micro=2)
+        full = pp.init_stacked_params(CFG, jax.random.PRNGKey(6))
+        inner = optax.sgd(0.05)
+        ids, tgt = _data(12, B=4)
+        ref_full, _, _ = pp.reference_pipeline_step(
+            CFG, plan, full, [(ids, tgt)], inner)
+        devs = jax.devices()
+        assert len(devs) >= 4, "conftest should force 8 CPU devices"
+        chans, engines = _engines(2, 27340, monkeypatch)
+        try:
+            pipes = [pp.HostPipeline(e, plan, CFG, full_params=full,
+                                     inner=inner,
+                                     devices=devs[2 * i: 2 * i + 2])
+                     for i, e in enumerate(engines)]
+            _run_world(pipes, [(ids, tgt)] * 2)
+            for pipe in pipes:
+                lo, hi = pipe.stage_layers()
+                want = pp.slice_stage_params(CFG, ref_full, lo, hi,
+                                             pipe.stage == 0,
+                                             pipe.stage == 1)
+                assert _tree_equal(pipe.params[0], want)
+        finally:
+            for c in chans:
+                c.close()
+
+    def test_interleaved_bitwise(self, monkeypatch):
+        plan = ParallelPlan(pp=2, n_micro=4, pp_schedule="interleaved",
+                            interleave=2)
+        full = pp.init_stacked_params(CFG, jax.random.PRNGKey(2))
+        inner = optax.sgd(0.05)
+        ids, tgt = _data(9, B=8)
+        ref_full, _, _ = pp.reference_pipeline_step(
+            CFG, plan, full, [(ids, tgt)], inner)
+        chans, engines = _engines(2, 27290, monkeypatch)
+        try:
+            pipes = [pp.HostPipeline(e, plan, CFG, full_params=full,
+                                     inner=inner) for e in engines]
+            _run_world(pipes, [(ids, tgt)] * 2)
+            part = pp.interleaved_partition(CFG.n_layers, 2, 2)
+            for pipe in pipes:
+                for c in range(2):
+                    lo, hi = part[pipe.stage][c]
+                    vs = c * 2 + pipe.stage
+                    want = pp.slice_stage_params(CFG, ref_full, lo, hi,
+                                                 vs == 0, vs == 3)
+                    assert _tree_equal(pipe.params[c], want)
+        finally:
+            for c in chans:
+                c.close()
+
+
+class TestZeroComposition:
+    @pytest.mark.parametrize("zero,inner_fn", [
+        (2, lambda: optax.sgd(0.05, momentum=0.9)),
+        (2, lambda: optax.sgd(0.05)),
+        (0, lambda: optax.sgd(0.05, momentum=0.9)),
+    ])
+    def test_pp_dp_bitwise(self, zero, inner_fn, monkeypatch):
+        """pp=2 x dp=2 (the 2-slice 3D shape minus tp): the per-stage
+        DP reduce-scatter buckets + chunked optimizer reproduce the
+        replicated reference bitwise — with AND without momentum."""
+        plan = ParallelPlan(pp=2, dp=2, n_micro=2, zero_stage=zero,
+                            pp_schedule="1f1b")
+        full = pp.init_stacked_params(CFG, jax.random.PRNGKey(3))
+        inner = inner_fn()
+        shards = [_data(10 + d, B=4) for d in range(2)]
+        ref_full, ref_losses, _ = pp.reference_pipeline_step(
+            CFG, plan, full, shards, inner_fn())
+        chans, engines = _engines(4, 27300 + 20 * zero, monkeypatch)
+        try:
+            pipes = [pp.HostPipeline(e, plan, CFG, full_params=full,
+                                     inner=inner_fn(), n_buckets=2)
+                     for e in engines]
+            outs = _run_world(
+                pipes, [shards[i % 2] for i in range(4)])
+            for i, loss in enumerate(outs):
+                if pipes[i].stage == 1:
+                    assert loss == pytest.approx(
+                        float(np.mean(ref_losses[i % 2])), abs=1e-6)
+            for pipe in pipes:
+                lo, hi = pipe.stage_layers()
+                want = pp.slice_stage_params(CFG, ref_full, lo, hi,
+                                             pipe.stage == 0,
+                                             pipe.stage == 1)
+                assert _tree_equal(pipe.params[0], want)
+        finally:
+            for c in chans:
+                c.close()
+
+
+# -- elastic stage re-carve ---------------------------------------------------
+def _commit_and_mirror(pipes, peers, boundary_cls=pp.StageBoundary):
+    """Commit each rank's boundary + run the cross-stage ring mirror."""
+    sbs = [boundary_cls() for _ in pipes]
+    for pipe, sb in zip(pipes, sbs):
+        pipe.commit_boundary(sb)
+
+    def mirror(i):
+        sbs[i].replicate_ring(peers[i].channel,
+                              peers[i].cluster.workers,
+                              tag=f"s{pipes[i].step_count}")
+
+    run_all([lambda i=i: mirror(i) for i in range(len(pipes))])
+    return sbs
+
+
+class TestStageRecarve:
+    def test_planned_merge_2_to_1(self, monkeypatch):
+        """Planned 2-stage -> 1-stage merge (no deaths: the leaving
+        stage serves its own spans): restored params + ZeRO momentum
+        chunks are bitwise the merged originals."""
+        monkeypatch.setenv("KF_TPU_HOST_TRANSPORT", "python")
+        from kungfu_tpu.peer import Peer
+        from kungfu_tpu.utils.envs import Config
+
+        plan = ParallelPlan(pp=2, dp=1, n_micro=2, zero_stage=2)
+        full = pp.init_stacked_params(CFG, jax.random.PRNGKey(4))
+        inner = optax.sgd(0.05, momentum=0.9)
+        workers = PeerList.of(PeerID("127.0.0.1", 27400),
+                              PeerID("127.0.0.1", 27401))
+        runners = PeerList.parse("127.0.0.1:27499")
+        cluster = Cluster(runners, workers)
+        peers = [Peer(Config(self_id=w, cluster=cluster,
+                             strategy=Strategy.STAR)) for w in workers]
+        for p in peers:
+            p.start()
+        try:
+            engines = [p.engine() for p in peers]
+            pipes = [pp.HostPipeline(e, plan, CFG, full_params=full,
+                                     inner=inner, peer=p)
+                     for e, p in zip(engines, peers)]
+            ids, tgt = _data(11, B=4)
+            _run_world(pipes, [(ids, tgt)] * 2)
+            sbs = _commit_and_mirror(pipes, peers)
+            new_workers = workers.select([0])
+
+            def carve(i):
+                sbs[i].recarve(1, peer=peers[i], old_workers=workers,
+                               new_workers=new_workers, tag="t")
+
+            run_all([lambda i=i: carve(i) for i in range(2)])
+            stage, n, params, opt = sbs[0].restore()
+            assert (stage, n) == (0, 1)
+            # params: the merged full tree, bitwise
+            merged = pp.merge_stage_trees(
+                CFG, 2, 1, [pipes[0].params[0], pipes[1].params[0]])
+            assert _tree_equal(params, merged)
+            # ZeRO momentum: unflatten each stage's trace chunk into
+            # its param-shaped tree (dp=1: chunk == stage flat), merge
+            # like params, re-flatten in the MERGED stage's layout —
+            # bitwise against the re-carved chunk
+            def unflatten_stage(lo, hi, first, last, flat):
+                shapes = pp.stage_param_shapes(CFG, lo, hi, first, last)
+                leaves, td = jax.tree_util.tree_flatten(shapes)
+                out, off = [], 0
+                for leaf in leaves:
+                    sz = int(np.prod(leaf.shape)) if leaf.shape else 1
+                    out.append(flat[off:off + sz].reshape(leaf.shape))
+                    off += sz
+                return jax.tree_util.tree_unflatten(td, out)
+
+            smap = plan.stage_map(CFG.n_layers)
+            tr_trees = []
+            for i, pipe in enumerate(pipes):
+                lo, hi = smap[i]
+                t = np.asarray(jax.tree_util.tree_leaves(
+                    pipe.opt_state[0])[0])[: pipe._flat_shapes[0]]
+                tr_trees.append(unflatten_stage(lo, hi, i == 0, i == 1, t))
+            merged_tr = pp.merge_stage_trees(CFG, 2, 1, tr_trees)
+            want = np.concatenate(
+                [np.asarray(l).ravel()
+                 for l in jax.tree_util.tree_leaves(merged_tr)])
+            got = np.asarray(jax.tree_util.tree_leaves(opt)[0])
+            assert np.array_equal(got[: want.shape[0]], want)
+            # a leaver dropped its shard
+            with pytest.raises(ValueError, match="restore before"):
+                sbs[1].restore()
+        finally:
+            for p in peers:
+                try:
+                    p.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def test_partial_stage_death_rejected(self):
+        sb = pp.StageBoundary()
+        sb.commit(1, CFG, 0, 2, 2, 0,
+                  pp.slice_stage_params(
+                      CFG, pp.init_stacked_params(
+                          CFG, jax.random.PRNGKey(0)), 0, 2, True, False),
+                  optax.sgd(0.1).init(jnp.zeros((4,))), 2)
+        with pytest.raises(ValueError, match="partially dead"):
+            sb.recarve(1, dead=[2])
+
+    def test_dead_buddy_unrecoverable(self):
+        sb = pp.StageBoundary()
+        sb.commit(1, CFG, 0, 4, 1, 0,
+                  pp.slice_stage_params(
+                      CFG, pp.init_stacked_params(
+                          CFG, jax.random.PRNGKey(0)), 0, 1, True, False),
+                  optax.sgd(0.1).init(jnp.zeros((4,))), 2)
+        # stages 2 AND 3 dead: 3's buddy predecessor (2) is dead too —
+        # mirror redundancy covers one failure domain, not two adjacent
+        with pytest.raises(ValueError, match="buddy predecessor"):
+            sb.recarve(2, dead=[2, 3])
+
+    def test_missing_mirror_rejected(self):
+        sb = pp.StageBoundary()
+        sb.commit(1, CFG, 0, 2, 1, 0,
+                  pp.slice_stage_params(
+                      CFG, pp.init_stacked_params(
+                          CFG, jax.random.PRNGKey(0)), 0, 2, True, False),
+                  optax.sgd(0.1).init(jnp.zeros((4,))), 2)
+        # stage 1 dead, this rank is its buddy predecessor but
+        # replicate_ring was never run on this boundary
+        with pytest.raises(ValueError, match="holds no mirror"):
+            sb.recarve(1, dead=[1])
+
+    def test_stale_mirror_step_rejected(self):
+        """A mirror replicated at a DIFFERENT step than this boundary's
+        commit must not serve a dead stage — it would blend optimizer
+        states from two steps (the expect_step gate's failure mode, one
+        hop removed)."""
+        sb = pp.StageBoundary()
+        sb.commit(5, CFG, 0, 2, 1, 0,
+                  pp.slice_stage_params(
+                      CFG, pp.init_stacked_params(
+                          CFG, jax.random.PRNGKey(0)), 0, 2, True, False),
+                  optax.sgd(0.1).init(jnp.zeros((4,))), 2)
+        sb._buddy = {"pflat": np.zeros(4, np.float32),
+                     "meta": np.array([4, 1, 2, 1, 0, 2], np.int64),
+                     "vec": {}}
+        sb._buddy_stage = 1
+        with pytest.raises(ValueError, match="replicated at step 4"):
+            sb.recarve(1, dead=[1])
+
+    def test_step_gate(self):
+        sb = pp.StageBoundary()
+        sb.commit(5, CFG, 0, 1, 1, 0,
+                  pp.slice_stage_params(
+                      CFG, pp.init_stacked_params(
+                          CFG, jax.random.PRNGKey(0)), 0, 4, True, True),
+                  optax.sgd(0.1).init(jnp.zeros((4,))), 2)
+        with pytest.raises(ValueError, match="replay from step"):
+            sb.recarve(1, expect_step=4)
+
+    def test_replicated_stateful_inner_rejected(self):
+        sb = pp.StageBoundary()
+        params = pp.slice_stage_params(
+            CFG, pp.init_stacked_params(CFG, jax.random.PRNGKey(0)),
+            0, 4, True, True)
+        mom = optax.sgd(0.1, momentum=0.9).init(params)
+        with pytest.raises(ValueError, match="ZeRO-2 flat-chunk"):
+            sb.commit(1, CFG, 0, 1, 1, 0, params, mom, 0)
+
+
+class TestChaosSliceLossRecarve:
+    """THE acceptance run: a 2-slice emulated 3D world — PP across the
+    DCN slices, TP=2 within each rank's local "ICI" device pair, ZeRO-2
+    momentum on DP — trains through a chaos ``die_slice`` with ONE
+    stage re-carve: the dead stage's params AND optimizer chunks
+    restored from the predecessor slice's ring-buddy mirrors, and the
+    post-loss world's final params bitwise a fixed-world replay from
+    the same committed boundary."""
+
+    def test_die_slice_recarve_bitwise(self, monkeypatch):
+        from tests.test_slices import make_slice_peers
+
+        monkeypatch.setenv("KF_CHAOS_SPEC",
+                           "die_slice:slice=1,step=2,mode=raise,rps=2")
+        # wide enough to cover the step-0/1 jit compiles on a loaded
+        # box, small enough to keep the post-kill detection bounded
+        monkeypatch.setenv("KF_CONFIG_PEER_DEADLINE", "12")
+        workers, peers = make_slice_peers(4, 2, 27410, monkeypatch)
+        plan = ParallelPlan(pp=2, dp=2, tp=2, n_micro=2, zero_stage=2)
+        full = pp.init_stacked_params(CFG, jax.random.PRNGKey(5))
+        mk_inner = lambda: optax.sgd(0.05, momentum=0.9)  # noqa: E731
+        shards = [_data(20 + d, B=4) for d in range(2)]
+        results = [None] * 4
+        recarves = []
+        devs = jax.devices()
+        assert len(devs) >= 8, "conftest should force 8 CPU devices"
+
+        def worker(i):
+            # rank i's local "ICI" = its own device pair: TP never
+            # crosses a slice
+            pipe = pp.HostPipeline(peers[i].engine(), plan, CFG,
+                                   full_params=full, inner=mk_inner(),
+                                   peer=peers[i],
+                                   devices=devs[2 * i: 2 * i + 2])
+            sb = pp.StageBoundary()
+            snap = StepSnapshot()
+            ids, tgt = shards[i % 2]
+            try:
+                # compile locally FIRST: a cold tp-shard_map jit inside
+                # the first recv window would read as a dead peer
+                pipe.warmup(ids.shape[0], ids.shape[1])
+                # steps 0 and 1 train clean; commit + mirror the
+                # step-2 boundary
+                for s in (0, 1):
+                    chaos.note_step(peers[i].chaos_rank(), s)
+                    pipe.train_step(ids, tgt)
+                pipe.commit_boundary(sb)
+                sb.replicate_ring(peers[i].channel,
+                                  peers[i].cluster.workers, tag="b2")
+                snap.commit(2, {"anchor": np.int64(2)})
+                # step 2: slice 1 dies at the boundary
+                chaos.note_step(peers[i].chaos_rank(), 2)
+                pipe.train_step(ids, tgt)
+                results[i] = ("no-death", None)
+            except chaos.InjectedDeath:
+                peers[i].close()
+                results[i] = ("died", None)
+            except PeerFailureError as err:
+                shrunk, replay = peers[i].recover_from_failure(
+                    err, snapshot=snap, stage_boundary=sb)
+                assert shrunk and replay is not None and replay[0] == 2
+                recarves.append(i)
+                new_plan = plan.with_stages(1)
+                pipe2 = pp.HostPipeline.from_boundary(
+                    peers[i].engine(), new_plan, CFG, sb,
+                    inner=mk_inner(), peer=peers[i],
+                    devices=devs[2 * i: 2 * i + 2])
+                assert pipe2.stage_layers() == (0, CFG.n_layers)
+                pipe2.warmup(ids.shape[0], ids.shape[1])
+                pipe2.train_step(ids, tgt)
+                results[i] = ("recovered", pipe2)
+
+        ts = [threading.Thread(target=worker, args=(i,), daemon=True)
+              for i in range(4)]
+        try:
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(300)
+            assert not any(t.is_alive() for t in ts), "recovery hung"
+            assert results[2][0] == "died" and results[3][0] == "died"
+            assert results[0][0] == "recovered"
+            assert results[1][0] == "recovered"
+            assert sorted(recarves) == [0, 1], \
+                "exactly one re-carve per survivor"
+
+            # fixed-world replay: steps 0-1 on the 2-stage world, then
+            # the survivor step on a 1-stage dp=2 world from the SAME
+            # boundary (merged params + merged momentum)
+            full1, states1 = dict(full), None
+            for _ in range(2):
+                full1, _, states1 = pp.reference_pipeline_step(
+                    CFG, plan, full1, shards, mk_inner(),
+                    opt_states=states1)
+            merged_trace = pp.merge_stage_trees(
+                CFG, 2, 1,
+                [states1[0][0].trace, states1[1][0].trace])
+            merged_state = (optax.TraceState(trace=merged_trace),
+                            states1[0][1])
+            plan1 = plan.with_stages(1)
+            full2, _, _ = pp.reference_pipeline_step(
+                CFG, plan1, full1, shards, mk_inner(),
+                opt_states=[merged_state])
+            for i in (0, 1):
+                pipe2 = results[i][1]
+                want = pp.slice_stage_params(CFG, full2, 0, CFG.n_layers,
+                                             True, True)
+                assert _tree_equal(pipe2.params[0], want), \
+                    "post-re-carve step diverged from fixed-world replay"
+        finally:
+            for p in peers:
+                try:
+                    p.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+
+# -- xray bubble phase --------------------------------------------------------
+class TestXrayBubblePhase:
+    def test_pp_bubble_is_a_distinct_phase(self):
+        from kungfu_tpu.monitor import xray
+
+        t0 = 1000.0
+        evs = [
+            {"ts": t0, "rank": 0, "step": 1, "kind": "pp",
+             "name": "bubble", "dur": 0.2, "attrs": {"stage": 1}},
+            {"ts": t0 + 0.2, "rank": 0, "step": 1, "kind": "pp",
+             "name": "fwd", "dur": 0.3, "attrs": {"stage": 1}},
+            {"ts": t0 + 0.5, "rank": 0, "step": 1, "kind": "collective",
+             "name": "engine.all_reduce", "dur": 0.1,
+             "attrs": {"tag": "g1", "op": "all_reduce"}},
+        ]
+        split = xray.rank_phase_split(evs)
+        assert split["pp_bubble"] == pytest.approx(0.2)
+        assert split["comm_exposed"] == pytest.approx(0.1)
+        # fwd/bwd pp spans are stage COMPUTE, not a separate phase
+        assert split["compute"] == pytest.approx(0.3)
+        assert "pp_bubble" in xray.PHASES
+        assert "pp" in xray.XRAY_KINDS
+
+    def test_report_kinds_still_superset(self):
+        from kungfu_tpu.monitor import xray
+        from kungfu_tpu.monitor.aggregator import REPORT_KINDS
+
+        assert xray.XRAY_KINDS <= REPORT_KINDS
+
+
+# -- serve autoscale execution ------------------------------------------------
+class _StubSlicePeer:
+    def __init__(self, workers):
+        from types import SimpleNamespace
+
+        self.config = SimpleNamespace(
+            cluster=SimpleNamespace(workers=workers),
+            self_id=workers[4], config_server="")
+
+    def slice_topology(self):
+        # no MEMBERSHIP alignment (single-slice peer); the ROUTER still
+        # excludes at slice grain via its explicit topology — the
+        # combination under test is the exclusion grain, not alignment
+        return None
+
+    def chaos_rank(self):
+        return 4
+
+    def rank(self):
+        return 4
+
+
+class _StubSliceRouter:
+    """Duck-typed slice-aware router: mark_worker_dead excludes the
+    whole slice, like the real fault ladder."""
+
+    def __init__(self, peer, live):
+        from kungfu_tpu.elastic.slices import SliceTopology
+
+        self.peer = peer
+        self.topology = SliceTopology(2, 2)
+        self._live = set(live)
+        self.busy: set = set()
+        self.replays = 0
+
+    @property
+    def live_workers(self):
+        return sorted(self._live)
+
+    def outstanding(self, r):
+        return 1 if r in self.busy else 0
+
+    def mark_worker_dead(self, r, readmit=True):
+        s = self.topology.slice_of(r)
+        ex = [x for x in self.topology.ranks_in(s) if x in self._live]
+        if any(x in self.busy for x in ex):
+            self.replays += 1  # a busy sibling got swept = replay storm
+        self._live -= set(ex)
+        return ex
+
+    def admit_worker(self, r):
+        self._live.add(r)
+        return True
+
+
+class TestServeFleetSliceScaleIn:
+    def test_retires_whole_drained_slices_only(self):
+        """Scale-in on a slice-aware router retires whole DRAINED
+        slices: a slice with a busy member is skipped entirely —
+        retiring its drained sibling would cascade-exclude the busy one
+        through the slice-grain fault ladder and replay its requests."""
+        from kungfu_tpu.serve.scale import ServeFleet
+
+        workers = PeerList.of(
+            *(PeerID("127.0.0.1", 27470 + i) for i in range(5)))
+        peer = _StubSlicePeer(workers)
+        router = _StubSliceRouter(peer, live=[0, 1, 2, 3])
+        router.busy = {2}
+
+        class _W:
+            dead = False
+
+            def stop(self):
+                self.dead = True
+
+        fleet = ServeFleet(router, None, lambda r: _W(),
+                           plan=ParallelPlan(dp=2))
+        fleet.workers = {r: _W() for r in (0, 1, 2, 3)}
+        fleet.scale_to(2)
+        # slice 1 (ranks 2,3) has a busy member: skipped whole; slice 0
+        # is drained and fleet-owned: retired whole
+        assert router.live_workers == [2, 3]
+        assert router.replays == 0, "a busy sibling was swept"
+        assert 0 not in fleet.workers and 1 not in fleet.workers
+        assert all(fleet.workers[r].dead is False for r in (2, 3))
+        # every group busy -> nothing retires
+        router2 = _StubSliceRouter(peer, live=[0, 1, 2, 3])
+        router2.busy = {1, 2}
+        fleet2 = ServeFleet(router2, None, lambda r: _W(),
+                            plan=ParallelPlan(dp=2))
+        fleet2.workers = {r: _W() for r in (0, 1, 2, 3)}
+        fleet2.scale_to(2)
+        assert router2.live_workers == [0, 1, 2, 3]
+        assert router2.replays == 0
+
+
+class TestServeFleetAutoscale:
+    def test_intent_spawns_real_worker(self, monkeypatch):
+        """Queue pressure + blown SLO raises a +1 intent; the fleet
+        executes it as a REAL spawn: a new engine + ServeWorker on a
+        provisioned rank, admitted to the router, and serving traffic."""
+        from kungfu_tpu.models.transformer import Transformer
+        from kungfu_tpu.peer import Peer
+        from kungfu_tpu.policy.serve import ServeAutoscalePolicy
+        from kungfu_tpu.serve.engine import InferenceEngine
+        from kungfu_tpu.serve.router import ServeRouter, ServeWorker
+        from kungfu_tpu.serve.scale import ServeFleet
+        from kungfu_tpu.serve.slo import SLOTargets
+        from kungfu_tpu.utils.envs import Config
+
+        monkeypatch.setenv("KF_TPU_HOST_TRANSPORT", "python")
+        monkeypatch.setenv("KF_NATIVE_ENGINE", "0")
+        cfg = TransformerConfig(vocab_size=64, d_model=16, n_layers=2,
+                                n_heads=2, d_ff=32, max_seq=32,
+                                dtype="float32")
+        model = Transformer(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        workers = PeerList.of(
+            *(PeerID("127.0.0.1", 27450 + i) for i in range(3)))
+        cluster = Cluster(PeerList.parse("127.0.0.1:27459"), workers)
+        peers = [Peer(Config(self_id=w, cluster=cluster,
+                             strategy=Strategy.STAR)) for w in workers]
+        for p in peers:
+            p.start()
+        spawned = {}
+
+        def spawn(rank):
+            eng = InferenceEngine(model, params, max_batch=2, rank=rank,
+                                  plan=ParallelPlan(dp=1))
+            eng.warmup(prompt_lens=(4,))
+            w = ServeWorker(peers[rank], eng, commit_every=2).start()
+            spawned[rank] = w
+            return w
+
+        try:
+            router = ServeRouter(peers[2], worker_ranks=[0],
+                                 queue_depth=8, deadline_s=10.0)
+            first = spawn(0)
+            fleet = ServeFleet(
+                router,
+                ServeAutoscalePolicy(
+                    targets=SLOTargets(ttft_s=0.5, e2e_s=1.0),
+                    scale_up_queue=2, cooldown_steps=0),
+                spawn, plan=ParallelPlan(dp=1))
+            assert fleet.live() == [0]
+            # pressure + blown SLO -> +1 intent -> a real spawn
+            got = fleet.tick(serve_queued=4, serve_e2e_ms=5000.0)
+            assert got == [1]
+            assert router.live_workers == [0, 1]
+            assert 1 in spawned and not spawned[1].dead
+            # the new worker actually serves
+            h = router.submit([1, 2, 3], 8)
+            toks = h.wait(timeout=30)
+            assert len(toks) > 0
+            # idle + wide margin -> scale back down to the plan floor
+            got = fleet.tick(serve_queued=0, serve_e2e_ms=10.0)
+            assert got == []
+            assert router.live_workers == [0]
+        finally:
+            for w in spawned.values():
+                if not w.dead:
+                    w.stop()
+            if first and not first.dead:
+                first.stop()
+            try:
+                router.close()
+            except Exception:  # noqa: BLE001
+                pass
+            for p in peers:
+                try:
+                    p.close()
+                except Exception:  # noqa: BLE001
+                    pass
