@@ -5,16 +5,17 @@
 PY ?= python3
 BASELINE := tests/lint_baseline.json
 
-.PHONY: lint verify shardcheck pallas-check check test native trace-demo \
+.PHONY: lint verify protocheck shardcheck pallas-check check test native \
+    trace-demo \
     zero-demo multislice-demo adapt-demo overlap-demo serve-demo pp-demo \
     xray-gate help
 
-## lint: all fourteen kf-lint rules — the Python suite (env-contract,
+## lint: all fifteen kf-lint rules — the Python suite (env-contract,
 ## jit-sync, blocking-io, retry-discipline, handle-discipline,
 ## collective-consistency, wire-contract, lock-order, trace-vocab,
-## agg-schema, shard-axis, shard-spec, recompile-hazard) AND the
-## transport.cpp lockcheck (lock-discipline) in one command, honoring
-## the baseline.
+## agg-schema, shard-axis, shard-spec, recompile-hazard, proto-verify)
+## AND the transport.cpp lockcheck (lock-discipline) in one command,
+## honoring the baseline.
 lint:
 	$(PY) scripts/kflint $(if $(wildcard $(BASELINE)),--baseline $(BASELINE))
 
@@ -24,6 +25,13 @@ verify:
 	$(PY) scripts/kflint --checker collective-consistency \
 	    --checker wire-contract --checker lock-order \
 	    $(if $(wildcard $(BASELINE)),--baseline $(BASELINE))
+
+## protocheck: just the proto-verify SPMD protocol verifier (fast
+## iteration on comm-protocol changes) — deliberately NO baseline: a
+## protocol divergence never lands as legacy debt (the check.sh
+## empty-baseline gate).
+protocheck:
+	$(PY) scripts/kflint --proto
 
 ## shardcheck: just the kf-shard axis-environment rules (fast iteration
 ## on sharding/mesh changes) — deliberately NO baseline: the tree must

@@ -1,7 +1,8 @@
 """kf-lint: project-invariant static analysis for the kungfu-tpu tree.
 
-Eight AST/structural checkers enforce invariants that code review kept
-missing (see docs/lint.md for the catalog and suppression syntax).
+Fifteen AST/structural checkers enforce invariants that code review
+kept missing (see docs/lint.md for the catalog and suppression
+syntax).
 
 The single-function rules:
 
@@ -32,13 +33,23 @@ graph (:mod:`kungfu_tpu.analysis.callgraph`):
   (:mod:`kungfu_tpu.analysis.wirecontract`).
 * ``lock-order`` — the cross-module Python lock-acquisition graph must
   be acyclic (:mod:`kungfu_tpu.analysis.pylockorder`).
+* ``proto-verify`` — the SPMD protocol verifier: per-entrypoint
+  symbolic collective/p2p protocols (extraction in
+  :mod:`kungfu_tpu.analysis.commgraph`) proven ordering-consistent,
+  tag-paired, and deadlock-free over every ``ParallelPlan`` geometry
+  up to 16 ranks (:mod:`kungfu_tpu.analysis.protoverify`).
 
 This package is intentionally stdlib-only (no jax/numpy import) so
 ``scripts/kflint`` runs in any environment, including bare CI images.
 """
 
 from kungfu_tpu.analysis.core import Violation, repo_root
-from kungfu_tpu.analysis.cli import CHECKERS, VERIFY_CHECKERS, run_checkers
+from kungfu_tpu.analysis.cli import (
+    CHECKERS,
+    PROTO_CHECKERS,
+    VERIFY_CHECKERS,
+    run_checkers,
+)
 
-__all__ = ["Violation", "repo_root", "CHECKERS", "VERIFY_CHECKERS",
-           "run_checkers"]
+__all__ = ["Violation", "repo_root", "CHECKERS", "PROTO_CHECKERS",
+           "VERIFY_CHECKERS", "run_checkers"]

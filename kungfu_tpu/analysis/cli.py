@@ -4,11 +4,21 @@ Usage (via ``scripts/kflint``)::
 
     kflint                  # all checkers over the repo
     kflint --checker jit-sync --checker env-contract
+    kflint --proto          # just the kf-verify protocol verifier
+    kflint --changed        # only report findings in files changed vs git
     kflint --root /path/to/tree
     kflint --list
     kflint --json                          # machine-readable findings
     kflint --baseline tests/lint_baseline.json
     kflint --write-baseline tests/lint_baseline.json
+
+``--changed`` keeps the *analysis* whole-tree (the interprocedural
+rules — proto-verify, collective-consistency, lock-order — are
+properties of paths through the tree, and the shared stat-keyed parse
+cache in ``core.parse_module`` means every pass reuses one AST per
+file) but *reports* only findings whose path changed relative to git
+(worktree vs HEAD, plus untracked files).  With no relevant changes it
+exits 0 without building the call graph at all.
 
 A **baseline** is a JSON list of ``{"checker", "path", "message"}``
 fingerprints (line numbers deliberately excluded — they drift with every
@@ -37,6 +47,7 @@ from kungfu_tpu.analysis import (
     handlecheck,
     jitpurity,
     lockcheck,
+    protoverify,
     pylockorder,
     recompilehazard,
     retrydiscipline,
@@ -62,6 +73,7 @@ CHECKERS: Dict[str, object] = {
     shardaxis.CHECKER: shardaxis.check,
     shardspec.CHECKER: shardspec.check,
     recompilehazard.CHECKER: recompilehazard.check,
+    protoverify.CHECKER: protoverify.check,
 }
 
 #: the kf-verify subset: the interprocedural rules built on the shared
@@ -74,6 +86,31 @@ VERIFY_CHECKERS = (collectives.CHECKER, wirecontract.CHECKER,
 #: the check.sh empty-baseline gate run exactly these)
 SHARD_CHECKERS = (shardaxis.CHECKER, shardspec.CHECKER,
                   recompilehazard.CHECKER)
+
+#: the protocol verifier (``kflint --proto``): gates with an EMPTY
+#: baseline in check.sh — a collective-ordering divergence, an orphan
+#: p2p tag, or a wait-for cycle can never land as "legacy debt"
+PROTO_CHECKERS = (protoverify.CHECKER,)
+
+
+def _git_changed_files(root: str) -> Optional[List[str]]:
+    """Repo-relative paths changed vs HEAD (staged + worktree) plus
+    untracked files; None when git is unavailable (fall back to a full
+    report rather than silently reporting nothing)."""
+    import subprocess
+
+    try:
+        diff = subprocess.run(
+            ["git", "-C", root, "diff", "--name-only", "HEAD"],
+            capture_output=True, text=True, timeout=30, check=True)
+        untracked = subprocess.run(
+            ["git", "-C", root, "ls-files", "--others",
+             "--exclude-standard"],
+            capture_output=True, text=True, timeout=30, check=True)
+    except Exception:  # noqa: BLE001 - any git failure: no filter
+        return None
+    return sorted({p for p in (diff.stdout + untracked.stdout).split("\n")
+                   if p.strip()})
 
 
 def run_checkers(root: Optional[str] = None,
@@ -117,6 +154,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    help="tree to lint (default: auto-detected repo root)")
     p.add_argument("--checker", action="append", choices=sorted(CHECKERS),
                    help="run only this checker (repeatable)")
+    p.add_argument("--proto", action="store_true",
+                   help="run only the kf-verify protocol verifier "
+                        "(proto-verify)")
+    p.add_argument("--changed", action="store_true",
+                   help="report only findings in files changed vs git "
+                        "(analysis stays whole-tree; exits 0 fast when "
+                        "nothing changed)")
     p.add_argument("--list", action="store_true",
                    help="list available checkers and exit")
     p.add_argument("--json", action="store_true",
@@ -131,8 +175,25 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         for name in sorted(CHECKERS):
             print(name)
         return 0
+    names = args.checker
+    if args.proto:
+        names = list(names or []) + [c for c in PROTO_CHECKERS
+                                     if c not in (names or [])]
     try:
-        violations = run_checkers(args.root, args.checker)
+        root = args.root or repo_root()
+        changed: Optional[List[str]] = None
+        if args.changed:
+            changed = _git_changed_files(root)
+            if changed is not None and not any(
+                    p.endswith((".py", ".cc", ".cpp", ".h"))
+                    for p in changed):
+                print("kflint: 0 violation(s) (no relevant changes)",
+                      file=sys.stderr)
+                return 0
+        violations = run_checkers(root, names)
+        if changed is not None:
+            changed_set = set(changed)
+            violations = [v for v in violations if v.path in changed_set]
         suppressed = 0
         if args.baseline:
             violations, suppressed = apply_baseline(
@@ -164,7 +225,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         for v in violations:
             print(v.render())
     n = len(violations)
-    checkers = args.checker or sorted(CHECKERS)
+    checkers = names or sorted(CHECKERS)
     note = f" ({suppressed} baselined)" if suppressed else ""
     print(f"kflint: {n} violation(s){note} [{', '.join(checkers)}]",
           file=sys.stderr)

@@ -1225,6 +1225,20 @@ class HostPipeline:
         #: the per-step hot path
         self._ops = build_schedule(self.plan.pp_schedule, self.n_micro,
                                    self._S, self.stage, self.v)
+        # the steady-state in-flight set — prefetched activation recvs,
+        # bounded sends, and the act+grad pair the current op touches —
+        # must fit the engine's async worker pool, or a full pool stalls
+        # submission mid-schedule while every peer waits on the frame we
+        # never sent: a distributed deadlock, not a slowdown.  Validate
+        # at construction (proto-verify pins the same bound statically).
+        from kungfu_tpu.comm.engine import ASYNC_POOL_WORKERS
+        window = self._prefetch + _MAX_INFLIGHT_SENDS + 2
+        if window > ASYNC_POOL_WORKERS:
+            raise ValueError(
+                f"pipeline in-flight window {window} (prefetch="
+                f"{self._prefetch} + max sends {_MAX_INFLIGHT_SENDS} + 2)"
+                f" exceeds the async pool ({ASYNC_POOL_WORKERS} workers);"
+                f" lower prefetch= or widen ASYNC_POOL_WORKERS")
         #: tag namespace keyed by the channel epoch token: a rebuilt
         #: post-shrink engine gets a fresh token, so a replayed step's
         #: tags can never collide with the dead epoch's stragglers

@@ -218,6 +218,20 @@ docs/fault_tolerance.md for the full matrix):
                                perturbation (delay jitter), default 0
 =============================  ================================================
 
+Protocol-verifier envs (the kf-verify static SPMD checker,
+:mod:`kungfu_tpu.analysis.protoverify`; see docs/lint.md):
+
+=============================  ================================================
+``KF_VERIFY_MAX_RANKS``        largest world size the geometry sweep
+                               enumerates ParallelPlans for, default 16
+``KF_VERIFY_GEOMETRY_CAP``     hard cap on geometries simulated per family
+                               (0 = unlimited), default 0
+``KF_VERIFY_TIMEOUT_S``        wall-clock budget for the whole geometry
+                               sweep in seconds, default 60.0; on expiry
+                               the sweep reports how many geometries it
+                               covered instead of silently truncating
+=============================  ================================================
+
 Kernel / model / data selection envs:
 
 =============================  ================================================
@@ -394,6 +408,15 @@ SERVE_SLO_E2E_MS = "KF_SERVE_SLO_E2E_MS"
 CHAOS_SPEC = "KF_CHAOS_SPEC"
 CHAOS_SEED = "KF_CHAOS_SEED"
 
+# protocol-verifier envs (read by kungfu_tpu/analysis/protoverify.py via
+# os.environ directly — the analysis package is stdlib-only and must not
+# import this jax-adjacent module; registered here so the env-contract
+# scan anchors the kf-verify knobs to the same registry, and
+# verify_knobs() below pins the defaults both sides must agree on)
+VERIFY_MAX_RANKS = "KF_VERIFY_MAX_RANKS"
+VERIFY_GEOMETRY_CAP = "KF_VERIFY_GEOMETRY_CAP"
+VERIFY_TIMEOUT_S = "KF_VERIFY_TIMEOUT_S"
+
 ALL_BOOTSTRAP_ENVS = [
     SELF_SPEC, INIT_PEERS, INIT_RUNNERS, PARENT_ID, INIT_CLUSTER_VERSION,
     ALLREDUCE_STRATEGY, CONFIG_SERVER, JOB_START_TIMESTAMP,
@@ -421,6 +444,20 @@ def parse_float_env(name: str, default: float) -> float:
         return float(os.environ.get(name, "") or default)
     except ValueError:
         return default
+
+
+def verify_knobs() -> dict:
+    """The kf-verify geometry-sweep knobs, parsed with their defaults.
+
+    protoverify._knobs() reads the same tokens from ``os.environ``
+    directly (it cannot import this module); tests pin that both sides
+    use these exact defaults so the documented contract cannot drift.
+    """
+    return {
+        "max_ranks": parse_int_env(VERIFY_MAX_RANKS, 16),
+        "geometry_cap": parse_int_env(VERIFY_GEOMETRY_CAP, 0),
+        "timeout_s": parse_float_env(VERIFY_TIMEOUT_S, 60.0),
+    }
 
 
 @dataclass

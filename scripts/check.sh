@@ -4,7 +4,7 @@
 #
 #   scripts/check.sh
 #
-# 1. kflint        — all fourteen project-invariant checkers, including
+# 1. kflint        — all fifteen project-invariant checkers, including
 #                    the kf-verify interprocedural rules and the
 #                    kf-shard axis-environment rules (docs/lint.md),
 #                    over kungfu_tpu/, scripts/, benchmarks/, examples/,
@@ -18,6 +18,12 @@
 #                    gate with an empty baseline (a mesh-axis typo, a
 #                    resize hazard, or a leaked in-flight collective
 #                    can never land as "legacy debt").
+# 1c. kf-verify    — proto-verify rerun WITHOUT the baseline: the SPMD
+#     protocol       protocol verifier (collective ordering, p2p tag
+#                    pairing, deadlock-freedom over every ParallelPlan
+#                    geometry <= 16 ranks, docs/lint.md) also gates
+#                    empty — a divergent collective or an orphan tag is
+#                    a distributed hang waiting to happen, never debt.
 # 2. kftrace       — flight-recorder dump schema self-check (recorder
 #                    and reader must agree byte-for-byte, docs/tracing.md)
 # 3. kftop         — live-plane /cluster schema self-check (push wire
@@ -49,6 +55,12 @@ echo "== empty-baseline gate (shard-axis, shard-spec, recompile-hazard, handle-d
 # collective handles never ratchet
 if ! python3 scripts/kflint --checker shard-axis --checker shard-spec \
         --checker recompile-hazard --checker handle-discipline; then
+    fail=1
+fi
+
+echo "== empty-baseline gate (proto-verify: ordering, tag pairing, deadlock-freedom)"
+# no --baseline on purpose: a protocol divergence never ratchets
+if ! python3 scripts/kflint --proto; then
     fail=1
 fi
 

@@ -1,0 +1,15 @@
+"""proto-verify fixture: handle-across-fence cycle — each arm posts a
+recv, fences on it (drain_async), and only THEN sends the frame the
+peer's fence is waiting for.  Both ranks block inside the fence."""
+import numpy as np
+
+
+def proto_entry_mirror(engine, me, left, right, payload):
+    if me % 2 == 0:
+        engine.recv_async(right, "kf.cyc.even")
+        engine.drain_async()
+        engine.send_async(left, payload, "kf.cyc.odd")
+    else:
+        engine.recv_async(left, "kf.cyc.odd")
+        engine.drain_async()
+        engine.send_async(right, payload, "kf.cyc.even")

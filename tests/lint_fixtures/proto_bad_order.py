@@ -1,0 +1,18 @@
+"""proto-verify fixture: collective ordering divergence — a collective
+under a one-sided rank guard, and a bucket loop running against
+canonical order (the uniform swap no cross-rank comparison can see)."""
+import numpy as np
+
+
+def proto_entry_diverge(engine, me, grads):
+    if me == 0:
+        engine.all_reduce(grads, name="kf.ord.g")
+    return grads
+
+
+def proto_entry_buckets(engine, spans, grads):
+    for i in range(len(spans)):
+        engine.reduce_scatter(grads[i], op="sum",
+                              name=f"kf.ord.b{len(spans) - 1 - i}")
+    for i in range(len(spans)):
+        engine.all_gather(grads[i], name=f"kf.ord.b{len(spans) - 1 - i}")

@@ -1,0 +1,12 @@
+"""proto-verify fixture: p2p tag pairing broken — every send tag must
+be matched by a recv of the same skeleton, and vice versa."""
+import numpy as np
+
+
+def proto_entry_scatter(engine, chan, me, peers, payload):
+    for i, p in enumerate(peers):
+        chan.send(p, f"kf.orph.a{i}", payload)
+    out = []
+    for i, p in enumerate(peers):
+        out.append(chan.recv(p, f"kf.orph.c{i}"))
+    return out

@@ -1,0 +1,34 @@
+"""proto-verify fixture: a clean symmetric protocol — canonical bucket
+order, paired tags, send-before-recv mirror, balanced collectives."""
+import numpy as np
+
+
+def proto_entry_buckets(engine, spans, grads):
+    for i in range(len(spans)):
+        engine.reduce_scatter(grads[i], op="sum", name=f"kf.good.b{i}")
+    for i in range(len(spans)):
+        engine.all_gather(grads[i], name=f"kf.good.b{i}")
+
+
+def proto_entry_ring(chan, me, world, blob):
+    pred = (me - 1) % world
+    succ = (me + 1) % world
+    chan.send(pred, f"kf.good.ring.{me}", blob)
+    return chan.recv(succ, f"kf.good.ring.{succ}")
+
+
+def proto_entry_guarded(engine, me, grads):
+    if me == 0:
+        engine.all_reduce(grads, name="kf.good.g")
+    else:
+        engine.all_reduce(grads, name="kf.good.g")
+    return grads
+
+
+def proto_entry_exchange(engine, me, peers, payload):
+    hs = []
+    for i, p in enumerate(peers):
+        hs.append(engine.send_async(p, payload, f"kf.good.x{i}"))
+        engine.recv_async(p, f"kf.good.x{i}")
+    for h in hs:
+        h.wait()

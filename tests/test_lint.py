@@ -17,6 +17,7 @@ from kungfu_tpu.analysis import (
     handlecheck,
     jitpurity,
     lockcheck,
+    protoverify,
     pylockorder,
     recompilehazard,
     retrydiscipline,
@@ -1183,3 +1184,48 @@ class TestReviewRegressions:
             core.parse_module(str(mod))
         entries = [k for k in core._MODULE_CACHE if k == str(mod)]
         assert len(entries) == 1, core._MODULE_CACHE.keys()
+
+
+class TestProtoVerify:
+    """The kf-verify SPMD protocol verifier (docs/lint.md).  Exact-line
+    pins on the bad fixtures; geometry/mutation coverage lives in
+    tests/test_protoverify.py."""
+
+    def _check(self, tmp_path, fixture):
+        from kungfu_tpu.analysis import callgraph, core
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": fixture})
+        core.clear_parse_cache()
+        callgraph.invalidate_cache()
+        return protoverify.check(root)
+
+    def test_good_fixture_clean(self, tmp_path):
+        got = self._check(tmp_path, "proto_good_mirror.py")
+        assert got == [], [v.render() for v in got]
+
+    def test_order_divergence_caught(self, tmp_path):
+        """One-sided rank guard + both halves of the uniform bucket
+        swap (reduce_scatter and all_gather tags run b{N-1-i})."""
+        got = self._check(tmp_path, "proto_bad_order.py")
+        assert {v.line for v in got} == {9, 15, 18}, \
+            [v.render() for v in got]
+        assert any("one side of a rank-dependent" in v.message
+                   or "rank" in v.message for v in got if v.line == 9)
+        assert all("canonical" in v.message
+                   for v in got if v.line in (15, 18))
+
+    def test_orphan_tags_caught(self, tmp_path):
+        got = self._check(tmp_path, "proto_bad_orphan.py")
+        assert {v.line for v in got} == {8, 11}, \
+            [v.render() for v in got]
+
+    def test_fence_cycle_caught(self, tmp_path):
+        """Mirror arms that each post a recv, fence, then send — both
+        ranks block inside the fence (2-rank simulation)."""
+        got = self._check(tmp_path, "proto_bad_cycle.py")
+        assert {v.line for v in got} == {8}, [v.render() for v in got]
+        assert any("deadlock" in v.message for v in got)
+
+    def test_proto_flag_registered(self):
+        from kungfu_tpu.analysis.cli import CHECKERS, PROTO_CHECKERS
+        assert PROTO_CHECKERS == (protoverify.CHECKER,)
+        assert protoverify.CHECKER in CHECKERS
