@@ -8,7 +8,7 @@ BASELINE := tests/lint_baseline.json
 .PHONY: lint verify protocheck shardcheck pallas-check check test native \
     trace-demo \
     zero-demo multislice-demo adapt-demo overlap-demo serve-demo pp-demo \
-    xray-gate help
+    persist-demo xray-gate help
 
 ## lint: all fifteen kf-lint rules — the Python suite (env-contract,
 ## jit-sync, blocking-io, retry-discipline, handle-discipline,
@@ -159,6 +159,18 @@ overlap-demo:
 ## BENCH_extra.json).
 pp-demo:
 	$(PY) examples/pp_demo.py
+
+## persist-demo: kf-persist drill: 4 kfrun workers stream async sharded
+## manifests, chaos `preempt:all,step=3` kills EVERY rank mid-run, the
+## `-restore-from` supervisor relaunches from the newest complete
+## manifest (a torn mid-preemption write is detected and skipped), then
+## a separate 2-worker launch cold-restarts from the SAME directory —
+## the 4-rank manifest re-carves onto the halved world and the final
+## params are asserted BITWISE against a fixed-world numpy replay
+## (docs/persistence.md; the overhead/goodput A/B is `python bench.py
+## --persist`, recorded in BENCH_extra.json).
+persist-demo:
+	$(PY) examples/preempt_restore.py
 
 help:
 	@grep -E '^## ' Makefile | sed 's/^## //'

@@ -139,6 +139,8 @@ ENTRYPOINTS: Tuple[Tuple[str, Optional[str], str, Optional[str]], ...] = (
      "local"),
     ("kungfu_tpu.serve.router", "ServeRouter", "_dispatch", None),
     ("kungfu_tpu.serve.router", "ServeRouter", "_replay", None),
+    ("kungfu_tpu.elastic.persist", "PersistPlane", "agree_manifest",
+     "local"),
 )
 
 #: functions named like this anywhere in scan scope are entrypoints too

@@ -65,6 +65,14 @@ _FENCE_CALLS = {
     # geometry (its tags name the old epoch's virtual stages) must
     # settle before the carve, exactly like a resize
     "recarve", "recarve_stages_after_shrink", "recarve_after_shrink",
+    # kf-persist (elastic/persist.py): a live async handle must not
+    # straddle the durable plane's boundaries either.  restore_from_
+    # manifest rebuilds state from disk — a handle issued against the
+    # pre-restore state would settle into a world that no longer exists;
+    # persist_fence drains the plane's own internally-tracked writes, so
+    # an explicitly-held handle crossing it is at best a double-wait
+    # and usually a straddle bug
+    "persist_fence", "restore_from_manifest",
 }
 
 _WAIT_ATTRS = {"wait"}

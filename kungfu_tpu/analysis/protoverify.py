@@ -103,6 +103,10 @@ EXPECTED_BINDINGS: Tuple[Tuple[str, str, str], ...] = (
      "kf.zrc.{}.scalars"),
     ("ZeroBoundary._recarve_channel", "_recv_or_fail",
      "kf.zrc.{}.scalars"),
+    ("PersistPlane.agree_manifest", "channel.send",
+     "kf.persist.agree.v{}"),
+    ("PersistPlane.agree_manifest", "_recv_or_fail",
+     "kf.persist.agree.v{}"),
 )
 
 
@@ -1016,6 +1020,21 @@ def _serve_replay_programs():
     return {"rt": router(), "w0": w0(), "w1": w1()}
 
 
+def _persist_agree_programs(n: int):
+    """The kf-persist restore-time agreement (elastic/persist.py
+    ``agree_manifest``): rank 0 fans its manifest choice to every other
+    rank in ascending order; each non-zero rank blocks on exactly that
+    one frame.  n=1 degenerates to no wire traffic at all."""
+    def prog(r: int):
+        if r == 0:
+            for k in range(1, n):
+                yield ("send", k, "persist.agree")
+        else:
+            yield ("recv", 0, "persist.agree")
+
+    return {r: prog(r) for r in range(n)}
+
+
 # -- geometry enumeration ----------------------------------------------------
 def _geometry_checks(root: str,
                      entries: List[EntryProtocol]) -> List[Violation]:
@@ -1183,4 +1202,17 @@ def _geometry_checks(root: str,
                                 deadline=deadline)
         report("serve-replay", findings,
                "kungfu_tpu/serve/router.py", 1)
+
+    # 7) persist restore-time manifest agreement (kf-persist): rank 0
+    # fans the chosen manifest out, everyone else blocks on rank 0 —
+    # including the 1-rank degenerate world (no frames at all)
+    for n in sorted({1, 2, 3, 4, min(8, max_ranks), max_ranks}):
+        if n < 1 or n > max_ranks:
+            continue
+        if not budget():
+            return out
+        findings, _ = _simulate(
+            _persist_agree_programs(n), deadline=deadline)
+        report(f"persist-agree n={n}", findings,
+               "kungfu_tpu/elastic/persist.py", 1)
     return out

@@ -103,13 +103,19 @@ class ChaosController:
         return False
 
     def on_step(self, step: int) -> None:
-        """Training loop announced step ``step`` (``die[_slice]:step=N``)."""
+        """Training loop announced step ``step`` (``die[_slice]:step=N``,
+        ``preempt:all[,step=N]``)."""
         for c in self._clauses:
             if c.kind == "die" and c.get("step") == step:
                 self._die(c, f"step={step}")
             elif (c.kind == "die_slice" and c.get("step") == step
                     and self._slice_matches(c)):
                 self._die(c, f"slice={c.get('slice')} step={step}")
+            elif c.kind == "preempt" and c.get("step") in (None, step):
+                # whole-job preemption: every rank's controller matches
+                # (no rank scope by grammar), so all processes die at the
+                # same announced boundary — no survivors by construction
+                self._die(c, f"preempt step={step}")
 
     def on_collective(self, tag: str) -> None:
         """Engine is starting a collective (``die[_slice]:coll=N``,

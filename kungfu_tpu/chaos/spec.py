@@ -53,6 +53,16 @@ without scoping a clause applies everywhere):
     must look slow to the MST re-carve, not just to the data path —
     and ``on=serve`` the serving request path (the worker straggles
     ``ms`` before admitting each matching request, kf-serve).
+``preempt``
+    Whole-job preemption: EVERY rank dies at the same boundary — the
+    spot/maintenance eviction that takes the entire capacity at once
+    (no survivors, so only the durable manifest plane of
+    ``elastic/persist.py`` can recover; docs/persistence.md).  The
+    mandatory bare ``all`` token makes the blast radius explicit:
+    ``preempt:all[,step=N][,mode=...]``.  ``step=N`` fires when the
+    training loop announces step N (without it, the first announced
+    step); ``mode`` as for ``die``.  Deliberately NOT rank-scopable —
+    a partial kill is ``die``/``die_slice``; preemption means all.
 ``drop_request``
     The serving plane loses an incoming request frame: this rank's
     serve handler silently discards every matching request
@@ -80,7 +90,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-KINDS = ("die", "die_slice", "reset", "delay", "drop_fanout",
+KINDS = ("die", "die_slice", "preempt", "reset", "delay", "drop_fanout",
          "drop_request", "config_down")
 
 _INT_PARAMS = {
@@ -92,6 +102,7 @@ _STR_PARAMS = {"mode", "host", "on"}
 _ALLOWED = {
     "die": {"rank", "step", "coll", "mode"},
     "die_slice": {"slice", "step", "coll", "mode", "rps"},
+    "preempt": {"all", "step", "mode"},
     "reset": {"rank", "send", "peer"},
     "delay": {"rank", "ms", "jitter", "peer", "every", "on"},
     "drop_fanout": {"host", "count"},
@@ -130,6 +141,10 @@ def _parse_clause(text: str) -> Clause:
         for item in rest.split(","):
             key, eq, val = item.partition("=")
             key, val = key.strip(), val.strip()
+            if kind == "preempt" and key == "all" and not eq:
+                # the explicit blast-radius token, not a key=value pair
+                params["all"] = True
+                continue
             if not eq or not key or not val:
                 raise ValueError(f"malformed chaos param {item!r} in {text!r}")
             if key not in _ALLOWED[kind]:
@@ -147,10 +162,15 @@ def _parse_clause(text: str) -> Clause:
             else:
                 params[key] = val
     mode = params.get("mode")
-    if kind in ("die", "die_slice") and mode not in (None, "exit", "raise"):
+    if kind in ("die", "die_slice", "preempt") \
+            and mode not in (None, "exit", "raise"):
         raise ValueError(f"{kind} mode must be exit|raise, got {mode!r}")
     if kind == "die_slice" and params.get("slice") is None:
         raise ValueError("die_slice needs slice=S (the slice to kill)")
+    if kind == "preempt" and params.get("all") is not True:
+        raise ValueError(
+            "preempt needs the explicit 'all' scope (preempt:all[,step=N])"
+            " — a partial kill is die/die_slice")
     if kind == "delay" and params.get("on") not in (None, "send", "recv",
                                                     "ping", "serve"):
         raise ValueError(

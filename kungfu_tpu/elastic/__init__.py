@@ -13,7 +13,12 @@ Parity with the reference's headline capability (``resize_cluster``):
 * :mod:`kungfu_tpu.elastic.shrink` — in-flight peer-failure recovery:
   exclusion consensus among the survivors, shrunk mesh epoch, replay
   from the last committed step (no reference analog — the reference's
-  only recovery is the whole-job relaunch this makes the last resort).
+  only recovery is the whole-job relaunch this makes the last resort);
+* :mod:`kungfu_tpu.elastic.persist` — the durable state plane: async
+  sharded checkpoints under digest-verified manifests and
+  checkpoint-shape-agnostic cold restore onto any world size (the
+  recovery rung below shrink — survives a whole-job preemption; see
+  docs/persistence.md).
 
 On TPU a resize is a **mesh-epoch swap**: membership changes on the host
 plane (consensus + runner notify), then the next ``communicator()`` /
@@ -29,6 +34,12 @@ from kungfu_tpu.elastic.shrink import (
     recover_from_peer_failure,
     shrink_to_survivors,
 )
+from kungfu_tpu.elastic.persist import (
+    PersistPlane,
+    RestoredState,
+    newest_complete_manifest,
+    restore_from_manifest,
+)
 
 __all__ = [
     "ConfigServer",
@@ -39,4 +50,8 @@ __all__ = [
     "find_dead_ranks",
     "recover_from_peer_failure",
     "shrink_to_survivors",
+    "PersistPlane",
+    "RestoredState",
+    "newest_complete_manifest",
+    "restore_from_manifest",
 ]

@@ -226,6 +226,18 @@ class ZeroBoundary:
         with self._lock:
             return self._step, dict(self._vec), dict(self._scal)
 
+    def export_carve(self):
+        """One-lock snapshot for the durable persist plane
+        (``elastic/persist.py``): ``(step, total, old_n, my_old, chunk,
+        full_mode, vec, scal)`` — this rank's OWN committed carve only.
+        The buddy mirror is deliberately excluded: its owner persists
+        those bytes under its own rank file, which is what de-duplicates
+        the manifest down to one copy of every chunk."""
+        with self._lock:
+            return (self._step, self._total, self._old_n, self._my_old,
+                    self._chunk, self._full_mode, dict(self._vec),
+                    dict(self._scal))
+
     def join(self, fresh_opt_shard, params, old_n: int) -> None:
         """Joiner bootstrap: a worker entering an existing world holds no
         committed chunk, but must still participate in the next

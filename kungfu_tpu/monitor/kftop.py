@@ -297,6 +297,24 @@ def render_view(view: dict, top: int = 10) -> str:
             + ", ".join(f"rank {r}: {n}" for r, n in sorted(lossy.items()))
             + " (raise KF_CONFIG_TIMELINE_CAP; skew/xray windows are "
               "incomplete)")
+    # kf-persist: a rank whose manifest age exceeds 3 persist periods
+    # has a wedged/starved durable plane — a preemption now would lose
+    # that much progress (docs/persistence.md)
+    ckpt_stale = []
+    for row in rows:
+        period = _gauge(row, "kf_ckpt_period_seconds")
+        age = _gauge(row, "kf_ckpt_age_seconds")
+        if period > 0 and age > 3 * period:
+            ckpt_stale.append(
+                f"rank {field(row, 'rank')}: {_fmt_s(age)} "
+                f"(period {_fmt_s(period)})")
+    if ckpt_stale:
+        lines.append("")
+        lines.append(
+            "!! CKPT STALE: manifest age > 3x persist period — "
+            + ", ".join(ckpt_stale)
+            + " (durable plane wedged? a preemption now replays all of "
+              "that; docs/persistence.md)")
     lines.extend(_serving_lines(view))
     return "\n".join(lines) + "\n"
 
@@ -327,6 +345,11 @@ def self_check() -> int:
             gauges['kf_step_phase_seconds{phase="comm_exposed"}'] = 0.05
         if rank == 2:  # one lossy ring proves the TRACE LOSS alarm
             counters["kf_timeline_dropped_total"] = 5
+        if rank == 2:  # and a wedged persist plane proves CKPT STALE
+            gauges["kf_ckpt_last_step"] = 1.0
+            gauges["kf_ckpt_age_seconds"] = 95.0
+            gauges["kf_ckpt_period_seconds"] = 30.0
+            gauges["kf_ckpt_bytes_total"] = 2048.0
         if rank == 1:  # one serving rank proves the serving rollup
             counters['kf_serve_requests_total{what="complete"}'] = 7
             counters['kf_serve_requests_total{what="replay"}'] = 2
@@ -397,7 +420,7 @@ def self_check() -> int:
           and "coll-lat" in text and "SLICE LOSS" in text
           and "== serving" in text and "replay" in text
           and "== XRAY" in text and "TRACE LOSS" in text
-          and "rank 2: 5" in text)
+          and "rank 2: 5" in text and "CKPT STALE" in text)
     if not ok:
         print("kftop: self-check FAILED (view schema/round-trip mismatch)",
               file=sys.stderr)

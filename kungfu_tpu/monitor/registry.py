@@ -234,6 +234,19 @@ METRIC_HELP: Dict[str, str] = {
         "KF_SERVE_QUEUE_DEPTH)",
     "kf_serve_active_requests":
         "decode slots occupied on this engine (continuous batching)",
+    "kf_ckpt_last_step":
+        "newest step this rank's persist plane made durable "
+        "(kf-persist; -1-ish float 0.0 before the first write)",
+    "kf_ckpt_age_seconds":
+        "seconds since this rank's last durable manifest write — grows "
+        "while the writer is wedged; kftop raises CKPT STALE past 3 "
+        "persist periods",
+    "kf_ckpt_bytes_total":
+        "cumulative bytes this rank streamed into durable manifests "
+        "(gauge-typed: the plane owns the accumulation)",
+    "kf_ckpt_period_seconds":
+        "configured persist period (KF_PERSIST_PERIOD; 0 = persist at "
+        "every commit) — the denominator of the CKPT STALE alarm",
     "kf_net_egress_bytes":
         "aggregate egress bytes (mirrored from NetMonitor)",
     "kf_net_ingress_bytes":

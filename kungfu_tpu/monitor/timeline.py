@@ -119,6 +119,12 @@ EVENT_KINDS = frozenset({
                    # "lost").  A counted kind: every mark ticks
                    # kf_serve_requests_total{what=<name>} even with
                    # tracing off, like the chaos/shrink counters
+    "ckpt",        # durable persist plane (kf-persist,
+                   # elastic/persist.py): "persist-issue" /
+                   # "persist-done" marks around each async manifest
+                   # write and the "restore" mark of a cold restart —
+                   # rare boundary events, so always recordable; the
+                   # always-on surfaces are the kf_ckpt_* gauges
     "step",        # training-step mark
     "mark",        # generic one-shot annotation
 })

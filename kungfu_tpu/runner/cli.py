@@ -10,7 +10,11 @@ Dispatch parity with ``app/kungfu-run.go:18-116``:
 * ``-w``: **WatchRun** — elastic runner daemon that diffs worker lists on
   membership change and spawns/kills accordingly (``runner/watch.go``);
 * ``-auto-recover``: **MonitoredRun** — heartbeat failure detector +
-  automatic relaunch (``runner/monitored.go``).
+  automatic relaunch (``runner/monitored.go``);
+* ``-restore-from``: **PersistRun** — no reference analog: cold-restart
+  supervision over the durable manifest plane (``runner/supervise.py``,
+  ``elastic/persist.py``) for whole-job preemptions that leave no
+  survivor to detect anything.
 
 Examples::
 
@@ -102,6 +106,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "emulation contract (docs/multislice.md); a real "
                         "pod's hosts already carry their MEGASCALE_* "
                         "identity and must not be re-stamped")
+    p.add_argument("-persist-dir", dest="persist_dir", default="",
+                   help="durable manifest root exported to workers as "
+                        "KF_PERSIST_DIR: training loops that carry a "
+                        "PersistPlane stream async per-rank shard "
+                        "checkpoints there (docs/persistence.md)")
+    p.add_argument("-restore-from", dest="restore_from", default="",
+                   help="manifest root to cold-restart from: implies "
+                        "-persist-dir DIR, sets KF_PERSIST_RESTORE=1 so "
+                        "workers resume from the newest complete manifest "
+                        "(onto THIS launch's world size — restore is "
+                        "shape-agnostic), and supervises the job: a "
+                        "whole-group preemption (every rank exits 43) "
+                        "relaunches from the newest complete manifest. "
+                        "An empty/fresh directory is a fresh start")
     p.add_argument("-monitor", dest="monitor", action="store_true",
                    help="live cluster observability plane: mount the "
                         "aggregator on the (builtin) config server, make "
@@ -337,7 +355,33 @@ def main(argv: Optional[List[str]] = None) -> int:
             "(-auto-recover relaunches on worker death, -w respawns via "
             "the config server)"
         )
+    if ns.persist_dir and ns.restore_from:
+        raise SystemExit(
+            "kfrun: -persist-dir and -restore-from are exclusive — "
+            "-restore-from already names the manifest root (and keeps "
+            "persisting into it)"
+        )
+    if ns.restore_from and (ns.auto_recover or ns.watch):
+        # both alternatives own worker-death policy; stacking them would
+        # race two supervisors over the same corpses
+        raise SystemExit(
+            "kfrun: -restore-from is its own supervisor (cold restart "
+            "from the durable manifest plane) and cannot combine with "
+            "-auto-recover or -w"
+        )
     chaos_envs = {}
+    persist_root = ns.restore_from or ns.persist_dir
+    if persist_root:
+        import os as _os
+
+        from kungfu_tpu.utils.envs import PERSIST_DIR, PERSIST_RESTORE
+
+        persist_root = _os.path.abspath(persist_root)
+        _os.makedirs(persist_root, exist_ok=True)
+        chaos_envs[PERSIST_DIR] = persist_root
+        if ns.restore_from:
+            chaos_envs[PERSIST_RESTORE] = "1"
+        _log.info("durable manifests -> %s", persist_root)
     if ns.monitor:
         from kungfu_tpu.monitor.aggregator import (
             PUSH_PERIOD_ENV,
@@ -398,6 +442,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         extra_envs=chaos_envs,
     )
     try:
+        if ns.restore_from:
+            from kungfu_tpu.runner.supervise import persist_run
+
+            return persist_run(ns, cluster, job)
         if ns.auto_recover:
             from kungfu_tpu.runner.monitored import monitored_run
 

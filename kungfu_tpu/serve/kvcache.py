@@ -30,6 +30,15 @@ Invariants (tests/test_kvcache.py):
   its starting footprint;
 * eviction only ever takes zero-reference committed pages;
 * the gauge equals ``(capacity - free) * page_bytes`` at all times.
+
+Durability (kf-persist): committed pages are *portable*.
+:meth:`KVCachePool.snapshot_committed` images them as a flat numpy dict
+(prefix tokens + K/V + content digest) that rides a
+:class:`~kungfu_tpu.elastic.persist.PersistPlane` manifest's
+``replicated`` payload; :meth:`KVCachePool.restore_committed` verifies
+and re-commits them into a fresh pool after a preemption, so a restarted
+serve worker's first request over a known prefix reuses prefill instead
+of recomputing it (docs/persistence.md).
 """
 
 from __future__ import annotations
@@ -105,14 +114,30 @@ def chain_hashes(tokens: Sequence[int], page_tokens: int) -> List[bytes]:
     return out
 
 
+def _content_digest(k: np.ndarray, v: np.ndarray) -> bytes:
+    """Digest over a page's K/V bytes — the torn-write detector for
+    snapshotted pages (the chain hash covers only the *tokens*; a page
+    whose data rotted in transit would otherwise restore cleanly under
+    a valid key and serve garbage attention)."""
+    h = hashlib.blake2b(b"kf-kv-page", digest_size=16)
+    h.update(np.ascontiguousarray(k).tobytes())
+    h.update(np.ascontiguousarray(v).tobytes())
+    return h.digest()
+
+
 class _Page:
-    __slots__ = ("k", "v", "key", "refs")
+    __slots__ = ("k", "v", "key", "refs", "prefix")
 
     def __init__(self):
         self.k: Optional[np.ndarray] = None   # [L, H, T, D]
         self.v: Optional[np.ndarray] = None
         self.key: Optional[bytes] = None      # chain hash when committed
         self.refs = 0
+        #: the covering token prefix (all tokens the chain hash digests)
+        #: — kept so a committed page is *portable*: a snapshot carries
+        #: (prefix, K, V) and a restoring pool re-derives the chain hash
+        #: from the tokens instead of trusting a stored key (kf-persist)
+        self.prefix: Optional[np.ndarray] = None
 
 
 class KVCachePool:
@@ -250,7 +275,7 @@ class KVCachePool:
         digests = chain_hashes(tokens, self.spec.page_tokens)
         committed = 0
         with self._lock:
-            for digest, pid in zip(digests, page_ids):
+            for i, (digest, pid) in enumerate(zip(digests, page_ids)):
                 page = self._pages.get(pid)
                 if page is None or page.refs <= 0:
                     raise ValueError(f"commit of non-live page {pid}")
@@ -259,6 +284,8 @@ class KVCachePool:
                 if digest in self._by_key:
                     continue
                 page.key = digest
+                page.prefix = np.asarray(
+                    tokens[:(i + 1) * self.spec.page_tokens], np.int64)
                 self._by_key[digest] = pid
                 committed += 1
         return committed
@@ -281,6 +308,98 @@ class KVCachePool:
                     self._lru.pop(pid, None)
                 out.append(pid)
             return out, len(out) * self.spec.page_tokens
+
+    # -- durable snapshot (kf-persist) -----------------------------------
+    def snapshot_committed(self) -> Dict[str, np.ndarray]:
+        """Portable image of every committed page that still holds data:
+        flat ``{name: array}`` suitable as a :class:`~kungfu_tpu.elastic.
+        persist.PersistPlane` ``replicated`` dict.  Per page *j*:
+        ``kv{j}_p`` covering token prefix (int64), ``kv{j}_k``/``kv{j}_v``
+        the K/V blocks, ``kv{j}_c`` a content digest over the K/V bytes.
+        The chain hash itself is deliberately NOT stored — the restoring
+        pool recomputes it from the prefix tokens, so a page can only
+        ever re-enter a cache under the key its own tokens derive."""
+        out: Dict[str, np.ndarray] = {}
+        with self._lock:
+            j = 0
+            for pid in self._by_key.values():
+                page = self._pages.get(pid)
+                if (page is None or page.k is None or page.v is None
+                        or page.prefix is None):
+                    continue
+                out[f"kv{j}_p"] = np.array(page.prefix, np.int64)
+                out[f"kv{j}_k"] = np.array(page.k)
+                out[f"kv{j}_v"] = np.array(page.v)
+                out[f"kv{j}_c"] = np.frombuffer(
+                    _content_digest(page.k, page.v), np.uint8).copy()
+                j += 1
+        return out
+
+    def restore_committed(self, snap: Dict[str, np.ndarray]
+                          ) -> Tuple[int, int]:
+        """Re-commit a :meth:`snapshot_committed` image into THIS pool:
+        ``(restored, rejected)``.  Every page is verified before
+        adoption — prefix length must tile whole pages, K/V shapes must
+        match this pool's spec, and the content digest must reproduce
+        (a torn/corrupted page is *rejected*, never served).  The chain
+        hash is recomputed from the prefix tokens via
+        :func:`chain_hashes`; a digest already committed here keeps the
+        incumbent (idempotent restore).  A pool too full to adopt a
+        verified page counts it rejected — restore never evicts live
+        requests' pages."""
+        restored = rejected = 0
+        pt = self.spec.page_tokens
+        shape = (self.spec.n_layers, self.spec.n_heads, pt,
+                 self.spec.head_dim)
+        idx = sorted(int(name[2:-2]) for name in snap
+                     if name.startswith("kv") and name.endswith("_p")
+                     and name[2:-2].isdigit())
+        for j in idx:
+            prefix = snap.get(f"kv{j}_p")
+            k = snap.get(f"kv{j}_k")
+            v = snap.get(f"kv{j}_v")
+            want = snap.get(f"kv{j}_c")
+            if (prefix is None or k is None or v is None or want is None
+                    or len(prefix) == 0 or len(prefix) % pt
+                    or tuple(np.shape(k)) != shape
+                    or tuple(np.shape(v)) != shape):
+                rejected += 1
+                continue
+            k = np.ascontiguousarray(k, np.dtype(self.spec.dtype))
+            v = np.ascontiguousarray(v, np.dtype(self.spec.dtype))
+            if _content_digest(k, v) != bytes(np.asarray(want, np.uint8)):
+                rejected += 1
+                continue
+            digest = chain_hashes(
+                np.asarray(prefix, np.int64).tolist(), pt)[-1]
+            if self._adopt_committed(digest, prefix, k, v):
+                restored += 1
+            else:
+                rejected += 1
+        return restored, rejected
+
+    def _adopt_committed(self, digest: bytes, prefix: np.ndarray,
+                         k: np.ndarray, v: np.ndarray) -> bool:
+        """Install a verified page as committed + parked (zero refs, in
+        the LRU).  ``True`` also when the digest is already committed —
+        the restore's goal state holds either way."""
+        with self._lock:
+            if digest in self._by_key:
+                return True
+            if not self._free and not self._lru:
+                return False  # only live pages left; never steal those
+            pid = self._take_one_locked()
+            page = self._pages[pid]
+            page.k = np.ascontiguousarray(k)
+            page.v = np.ascontiguousarray(v)
+            page.prefix = np.asarray(prefix, np.int64)
+            page.key = digest
+            self._by_key[digest] = pid
+            page.refs = 0
+            self._lru[pid] = None
+            self._lru.move_to_end(pid)
+            self._update_gauge()
+            return True
 
     # -- introspection ---------------------------------------------------
     def live_refs(self) -> Dict[int, int]:

@@ -203,6 +203,25 @@ see docs/serving.md):
                                (serve/slo.py)
 =============================  ================================================
 
+Persistence envs (the durable state plane,
+:mod:`kungfu_tpu.elastic.persist`; see docs/persistence.md):
+
+=============================  ================================================
+``KF_PERSIST_DIR``             manifest root for durable checkpoints; unset =
+                               the persist plane is off (``kfrun
+                               -persist-dir`` / ``-restore-from`` set it)
+``KF_PERSIST_PERIOD``          seconds between issued persists, default 30.0;
+                               0 = persist at every commit (demos/tests)
+``KF_PERSIST_ASYNC_DEPTH``     max in-flight async persist handles before
+                               issue blocks on the oldest, default 2
+``KF_PERSIST_KEEP``            keep-last-k complete manifests retained by
+                               rank-0 GC (min 1), default 3
+``KF_PERSIST_RESTORE``         truthy = restore-armed start: the worker
+                               agrees on and restores the newest complete
+                               manifest before training (set by ``kfrun
+                               -restore-from``)
+=============================  ================================================
+
 Fault-injection envs (the chaos layer, :mod:`kungfu_tpu.chaos`; see
 docs/fault_tolerance.md for the full matrix):
 
@@ -402,6 +421,16 @@ SERVE_REQUEST_DEADLINE = "KF_SERVE_REQUEST_DEADLINE"
 SERVE_SLO_TTFT_MS = "KF_SERVE_SLO_TTFT_MS"
 SERVE_SLO_E2E_MS = "KF_SERVE_SLO_E2E_MS"
 
+# persistence envs (read by kungfu_tpu/elastic/persist.py via
+# persist_knobs() at plane construction and by the runner's supervisor
+# path; registered here so the env-contract scan anchors the kf-persist
+# knobs to the same registry as every other KF_* token)
+PERSIST_DIR = "KF_PERSIST_DIR"
+PERSIST_PERIOD = "KF_PERSIST_PERIOD"
+PERSIST_ASYNC_DEPTH = "KF_PERSIST_ASYNC_DEPTH"
+PERSIST_KEEP = "KF_PERSIST_KEEP"
+PERSIST_RESTORE = "KF_PERSIST_RESTORE"
+
 # fault-injection envs (read by kungfu_tpu/chaos/inject.py at controller
 # creation; registered here so the env-contract scan anchors them to the
 # same registry as every other KF_* knob)
@@ -457,6 +486,20 @@ def verify_knobs() -> dict:
         "max_ranks": parse_int_env(VERIFY_MAX_RANKS, 16),
         "geometry_cap": parse_int_env(VERIFY_GEOMETRY_CAP, 0),
         "timeout_s": parse_float_env(VERIFY_TIMEOUT_S, 60.0),
+    }
+
+
+def persist_knobs() -> dict:
+    """The kf-persist plane knobs, parsed with their defaults
+    (elastic/persist.py constructs a :class:`~kungfu_tpu.elastic.
+    persist.PersistPlane` from these; kfrun's ``-persist-dir`` /
+    ``-restore-from`` flags export the dir + restore arm)."""
+    return {
+        "dir": os.environ.get(PERSIST_DIR, ""),
+        "period_s": parse_float_env(PERSIST_PERIOD, 30.0),
+        "depth": parse_int_env(PERSIST_ASYNC_DEPTH, 2),
+        "keep": parse_int_env(PERSIST_KEEP, 3),
+        "restore": parse_bool_env(PERSIST_RESTORE, False),
     }
 
 

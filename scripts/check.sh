@@ -32,6 +32,11 @@
 # 3b. adapt-demo   — kf-adapt interference A/B: chaos-degraded link,
 #                    bandit majority vote, consensus-fenced lockstep
 #                    strategy swap on every rank (docs/adaptation.md)
+# 3c. persist-demo — kf-persist drill: preempt:all kills every rank,
+#                    the -restore-from supervisor relaunches from the
+#                    newest complete manifest, a halved world restores
+#                    bitwise from the same directory
+#                    (docs/persistence.md)
 # 4. compileall    — every .py parses/compiles on this interpreter
 # 5. flag stamps   — no sanitizer flags leaked into the production
 #                    .buildflags stamp (variants must never mix)
@@ -150,6 +155,23 @@ if ! timeout -k 10 240 python3 examples/pp_demo.py \
         || ! grep -q "pp-demo OK" /tmp/_kf_pp_demo.log; then
     echo "ERROR: pp demo did not pass (schedule A/B or stage merge)"
     tail -40 /tmp/_kf_pp_demo.log || true
+    fail=1
+fi
+
+echo "== persist-demo (preempt:all -> supervised relaunch -> 4->2 cold restart)"
+# kf-persist end to end: every rank killed at the same step boundary
+# (preempt:all), the kfrun -restore-from supervisor relaunches from the
+# newest COMPLETE manifest (a write torn by the preemption must be
+# skipped, not restored), then a halved world cold-restarts from the
+# same directory via the shape-agnostic reshard_plan restore — final
+# params bitwise vs a fixed-world numpy replay (docs/persistence.md).
+# Bounded: a wedged supervisor round must fail the gate, not hang it.
+rm -f /tmp/_kf_persist_demo.log
+if ! timeout -k 10 300 python3 examples/preempt_restore.py \
+        > /tmp/_kf_persist_demo.log 2>&1 \
+        || ! grep -q "PERSIST DEMO OK" /tmp/_kf_persist_demo.log; then
+    echo "ERROR: persist demo did not restore bitwise through preemption"
+    tail -40 /tmp/_kf_persist_demo.log || true
     fail=1
 fi
 

@@ -41,6 +41,11 @@ run xray       1500 env JAX_PLATFORMS=tpu python bench.py --xray
 # first tunnel contact replaces it with stage compute on chip (the
 # host-plane hops and the 1F1B schedule are backend-independent)
 run pp         1500 python bench.py --pp
+# kf-persist: on a real pod the overhead row gains a true device-compute
+# denominator (host writer threads genuinely off the step path, no
+# 1-core GIL steal) and the goodput row exercises multi-host manifests
+# on the shared filesystem
+run persist    1500 python bench.py --persist
 run xent_cross 1800 python benchmarks/xent_sweep.py --crossover
 run bn_sweep   1800 python benchmarks/bn_sweep.py
 run longctx    1500 python bench.py --kernels --seq-len 8192

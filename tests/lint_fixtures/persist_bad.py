@@ -1,0 +1,40 @@
+"""handle-discipline fixture: persist-plane shapes the rule must catch
+(elastic/persist.py fences + the persist_async issue site)."""
+
+
+def dropped_persist(plane, boundary):
+    plane.persist_async(3, boundary)        # line 6: handle dropped
+    return boundary
+
+
+def persist_never_waited(plane, boundary):
+    h = plane.persist_async(3, boundary)    # line 11: never waited
+    return boundary
+
+
+def held_across_restore(plane, boundary):
+    h = plane.persist_async(3, boundary)    # line 16: not settled before
+    st = restore_from_manifest("/ckpt", 0, 2)   # the restore fence
+    h.wait()
+    return st
+
+
+def held_across_plane_fence(plane, engine, x):
+    h = engine.all_reduce_async(x)          # line 23: straddles the
+    plane.persist_fence()                   # plane's own fence
+    return h.wait()
+
+
+def persist_held_across_elastic(plane, peer, state, schedule, params, b):
+    h = plane.persist_async(5, b)           # line 29: persist handle
+    state, params, stop = elastic_step(     # straddles elastic_step
+        peer, state, schedule, params)
+    return h.wait(), state, params, stop
+
+
+def restore_from_manifest(mdir, my_new, new_n):
+    return None
+
+
+def elastic_step(peer, state, schedule, params):
+    return state, params, False

@@ -1,0 +1,38 @@
+"""handle-discipline fixture: compliant persist-plane shapes that must
+NOT flag."""
+
+
+def issue_then_wait(plane, boundary):
+    h = plane.persist_async(3, boundary)
+    return h.wait()
+
+
+def commit_returns_handle(plane, step, boundary):
+    # the plane's own period-gated commit: escape-by-return — the
+    # caller (or the internal tracking + persist_fence) settles it
+    return plane.persist_async(step, boundary)
+
+
+def fence_settles_tracked_writes(plane, boundary):
+    # no explicitly-held handle: commit() tracks internally and the
+    # boundary fence drains — the canonical train-loop shape
+    plane.commit(3, boundary)
+    plane.persist_fence()
+    return boundary
+
+
+def wait_then_restore(plane, boundary):
+    h = plane.persist_async(3, boundary)
+    h.wait()
+    st = restore_from_manifest("/ckpt", 0, 2)   # fence AFTER settle
+    return st
+
+
+def windowed_persists(plane, boundary, steps, handles):
+    for s in steps:
+        handles.append(plane.persist_async(s, boundary))
+    return handles
+
+
+def restore_from_manifest(mdir, my_new, new_n):
+    return None

@@ -192,6 +192,35 @@ class TestHandleDiscipline:
         assert handlecheck.check(root) == []
 
 
+class TestPersistHandleDiscipline:
+    """kf-persist rides the same lifetime rule: a durable-write handle
+    is an async handle — dropped/never-waited persists leak, and no
+    handle (persist or collective) may straddle ``persist_fence`` /
+    ``restore_from_manifest`` / ``elastic_step``."""
+
+    def _violations(self, tmp_path, fixture):
+        root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": fixture})
+        return handlecheck.check(root)
+
+    def test_bad_fixture_all_shapes_caught(self, tmp_path):
+        got = sorted((v.line, v.message)
+                     for v in self._violations(tmp_path, "persist_bad.py"))
+        assert [line for line, _ in got] == [6, 11, 17, 24, 30], got
+        assert "dropped" in got[0][1]
+        assert "never waited" in got[1][1]
+        # the restore is a membership-change boundary: a persist handle
+        # still in flight there may belong to the OLD geometry
+        assert "restore_from_manifest" in got[2][1]
+        # and the plane's own fence is a fence for EVERY handle kind —
+        # a collective handle must not straddle it either
+        assert "persist_fence" in got[3][1]
+        assert "elastic_step" in got[4][1]
+
+    def test_good_fixture_clean(self, tmp_path):
+        got = self._violations(tmp_path, "persist_good.py")
+        assert got == [], [v.render() for v in got]
+
+
 class TestCollectiveConsistency:
     """The kf-verify SPMD rule: rank-conditional collectives, constant
     rendezvous-name reuse, and peer-divergent name expressions — including

@@ -1,0 +1,496 @@
+"""kf-persist: the durable state plane (tier-1, docs/persistence.md).
+
+Manifest completeness is the safety property here — a write torn by
+the very preemption the plane exists to survive must never become
+training state — and the shape-agnostic restore is the exactness
+property: a manifest written by N ranks restored onto M ranks must be
+bitwise the carve a live re-carve would have produced.  Covers the
+manifest format (torn/corrupt segments, partial-beats-nothing,
+keep-last-k GC), the re-carve restore in both directions, the handle
+plane (period gating, fence accounting, gauges), the restore-time
+agreement hop over real host channels, the committed-KV-page
+snapshot round-trip incl. a restored serve worker reusing the warm
+prefix, the ``preempt:all`` chaos clause, and the ``-restore-from``
+supervisor policy.  The full subprocess drill (``make persist-demo``)
+rides in the slow tier.
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from kungfu_tpu import chaos
+from kungfu_tpu.chaos.inject import InjectedDeath
+from kungfu_tpu.elastic.persist import (FORMAT, ManifestError, PersistPlane,
+                                        agreed_manifest_path, choose_manifest,
+                                        gc_manifests, manifest_complete,
+                                        manifest_dirs, manifest_name,
+                                        newest_complete_manifest,
+                                        restore_from_manifest)
+from kungfu_tpu.elastic.reshard import ZeroBoundary
+from kungfu_tpu.monitor.registry import REGISTRY
+from kungfu_tpu.runner.supervise import strip_preempt
+from kungfu_tpu.utils import envs
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOTAL = 10
+
+
+def _chunks_of(full, total, n):
+    chunk = math.ceil(total / n)
+    buf = np.zeros((chunk * n,), full.dtype)
+    buf[:total] = full[:total]
+    return [buf[r * chunk:(r + 1) * chunk] for r in range(n)]
+
+
+def _vectors(seed=9):
+    rng = np.random.RandomState(seed)
+    return {
+        "mu": rng.randn(TOTAL).astype(np.float32),
+        "nu": rng.randn(TOTAL).astype(np.float32),
+    }
+
+
+def _write_world(root, n, vecs, step=7, cv=0, replicated=None):
+    """One complete manifest: n planes, each persisting its own chunk
+    of the committed boundary (the host-plane training shape)."""
+    mu = _chunks_of(vecs["mu"], TOTAL, n)
+    nu = _chunks_of(vecs["nu"], TOTAL, n)
+    mdir = None
+    for r in range(n):
+        b = ZeroBoundary()
+        b.commit_local(
+            step, {"mu": mu[r], "nu": nu[r], "count": np.int64(step)},
+            total=TOTAL, old_n=n, my_old=r)
+        plane = PersistPlane(root, r, cluster_version=cv, period_s=0.0,
+                             depth=2, keep=10)
+        h = plane.persist_async(step, b, replicated=replicated)
+        mdir = h.wait()
+        plane.close()
+    return mdir
+
+
+# -- manifest completeness ---------------------------------------------------
+class TestManifestCompleteness:
+    def test_complete_round_trip(self, tmp_path):
+        mdir = _write_world(str(tmp_path), 2, _vectors())
+        assert manifest_complete(mdir)
+        assert newest_complete_manifest(str(tmp_path)) == mdir
+
+    def test_torn_final_segment_rejected(self, tmp_path):
+        """THE preemption hazard: a segment truncated mid-write must
+        read as 'this rank never committed', in both verify modes."""
+        mdir = _write_world(str(tmp_path), 2, _vectors())
+        segp = os.path.join(mdir, "rank1.seg.npz")
+        with open(segp, "rb") as f:
+            data = f.read()
+        with open(segp, "wb") as f:
+            f.write(data[:-7])
+        assert not manifest_complete(mdir)
+        assert not manifest_complete(mdir, digest=False)  # size catches it
+        assert newest_complete_manifest(str(tmp_path)) is None
+        # the new rank whose carve reads the torn file must refuse
+        with pytest.raises(ManifestError):
+            restore_from_manifest(mdir, 1, 2)
+
+    def test_same_size_corruption_needs_the_digest(self, tmp_path):
+        """Bit rot keeps the byte count: only the digest mode sees it —
+        which is why GC's size-only shortcut may pick what to KEEP but
+        never what to RESTORE."""
+        mdir = _write_world(str(tmp_path), 2, _vectors())
+        segp = os.path.join(mdir, "rank0.seg.npz")
+        with open(segp, "rb") as f:
+            data = bytearray(f.read())
+        data[len(data) // 2] ^= 0xFF
+        with open(segp, "wb") as f:
+            f.write(bytes(data))
+        assert manifest_complete(mdir, digest=False)  # size still matches
+        assert not manifest_complete(mdir)
+        with pytest.raises(ManifestError):
+            restore_from_manifest(mdir, 0, 2)
+
+    def test_missing_commit_record_is_partial(self, tmp_path):
+        mdir = _write_world(str(tmp_path), 2, _vectors())
+        os.unlink(os.path.join(mdir, "rank1.ok.json"))
+        assert not manifest_complete(mdir)
+
+    def test_newest_complete_beats_newer_partial(self, tmp_path):
+        """A preemption mid-persist leaves a newer torn manifest; the
+        restore source must be the older one that committed."""
+        old = _write_world(str(tmp_path), 2, _vectors(), step=5)
+        new = _write_world(str(tmp_path), 2, _vectors(seed=10), step=9)
+        os.unlink(os.path.join(new, "rank0.ok.json"))
+        assert newest_complete_manifest(str(tmp_path)) == old
+        assert choose_manifest(str(tmp_path)) == (5, 0)
+
+    def test_format_mismatch_refuses(self, tmp_path):
+        mdir = _write_world(str(tmp_path), 2, _vectors())
+        metap = os.path.join(mdir, "meta.json")
+        with open(metap) as f:
+            meta = json.load(f)
+        meta["format"] = FORMAT + 1
+        with open(metap, "w") as f:
+            json.dump(meta, f)
+        with pytest.raises(ManifestError):
+            restore_from_manifest(mdir, 0, 2)
+
+
+# -- GC ----------------------------------------------------------------------
+class TestGC:
+    def test_keep_last_k(self, tmp_path):
+        for s in (1, 2, 3, 4):
+            _write_world(str(tmp_path), 2, _vectors(seed=s), step=s)
+        removed = gc_manifests(str(tmp_path), keep=2)
+        left = [s for s, _, _ in manifest_dirs(str(tmp_path))]
+        assert left == [3, 4]
+        assert sorted(os.path.basename(p) for p in removed) == \
+            [manifest_name(1, 0), manifest_name(2, 0)]
+
+    def test_only_complete_manifest_never_deleted(self, tmp_path):
+        """The last restore point survives any keep policy; a stale
+        partial OLDER than it goes, a newer partial (maybe still
+        landing) is left alone."""
+        older = _write_world(str(tmp_path), 2, _vectors(), step=2)
+        keeper = _write_world(str(tmp_path), 2, _vectors(), step=5)
+        newer = _write_world(str(tmp_path), 2, _vectors(), step=8)
+        os.unlink(os.path.join(older, "rank0.ok.json"))
+        os.unlink(os.path.join(newer, "rank1.ok.json"))
+        removed = gc_manifests(str(tmp_path), keep=1)
+        assert removed == [older]
+        assert os.path.isdir(keeper) and os.path.isdir(newer)
+        # and with nothing complete at all, GC removes nothing
+        os.unlink(os.path.join(keeper, "rank0.ok.json"))
+        assert gc_manifests(str(tmp_path), keep=1) == []
+
+
+# -- shape-agnostic restore --------------------------------------------------
+class TestRestoreReshard:
+    def _restore_all(self, mdir, new_n):
+        return [restore_from_manifest(mdir, r, new_n) for r in range(new_n)]
+
+    def _gathered(self, states, leaf):
+        chunk = states[0].chunk
+        buf = np.zeros((chunk * len(states),), states[0].vec[leaf].dtype)
+        for r, st in enumerate(states):
+            buf[r * chunk:(r + 1) * chunk] = st.vec[leaf]
+        return buf[:TOTAL]
+
+    def test_restore_onto_smaller_world_bitwise(self, tmp_path):
+        vecs = _vectors()
+        mdir = _write_world(str(tmp_path), 4, vecs, step=7,
+                            replicated={"params": np.arange(6, dtype=np.float32)})
+        sts = self._restore_all(mdir, 2)
+        # dict keys flatten sorted: leaf 0 = count (scalar), 1/2 = mu/nu
+        np.testing.assert_array_equal(self._gathered(sts, 1), vecs["mu"])
+        np.testing.assert_array_equal(self._gathered(sts, 2), vecs["nu"])
+        for st in sts:
+            assert st.step == 7 and st.new_n == 2
+            assert int(st.scal[0]) == 7
+            np.testing.assert_array_equal(
+                st.replicated["params"], np.arange(6, dtype=np.float32))
+
+    def test_restore_onto_larger_world_bitwise(self, tmp_path):
+        vecs = _vectors(seed=11)
+        mdir = _write_world(str(tmp_path), 2, vecs, step=3)
+        sts = self._restore_all(mdir, 4)
+        np.testing.assert_array_equal(self._gathered(sts, 1), vecs["mu"])
+        np.testing.assert_array_equal(self._gathered(sts, 2), vecs["nu"])
+
+    def test_single_rank_round_trip(self, tmp_path):
+        vecs = _vectors(seed=12)
+        mdir = _write_world(str(tmp_path), 1, vecs, step=2,
+                            replicated={"c": np.int64(41)})
+        (st,) = self._restore_all(mdir, 1)
+        np.testing.assert_array_equal(st.vec[1][:TOTAL], vecs["mu"])
+        np.testing.assert_array_equal(st.vec[2][:TOTAL], vecs["nu"])
+        assert st.replicated["c"].dtype == np.int64
+        assert int(st.replicated["c"]) == 41
+
+    def test_install_into_boundary_continues_live(self, tmp_path):
+        """The restored carve seeds the live elastic machinery: the
+        boundary's committed chunks are exactly the restored ones."""
+        vecs = _vectors(seed=13)
+        mdir = _write_world(str(tmp_path), 4, vecs, step=7)
+        st = restore_from_manifest(mdir, 1, 2)
+        b = ZeroBoundary()
+        st.install_into_boundary(b)
+        step, vec, scal = b.chunks()
+        assert step == 7
+        np.testing.assert_array_equal(vec[1], st.vec[1])
+        np.testing.assert_array_equal(vec[2], st.vec[2])
+
+    def test_bad_geometry_rejected(self, tmp_path):
+        mdir = _write_world(str(tmp_path), 2, _vectors())
+        with pytest.raises(ValueError):
+            restore_from_manifest(mdir, 2, 2)
+        with pytest.raises(ValueError):
+            restore_from_manifest(mdir, 0, 0)
+
+
+# -- the handle plane --------------------------------------------------------
+class TestPlaneHandles:
+    def _boundary(self, step=1):
+        b = ZeroBoundary()
+        b.commit_local(step, {"m": np.zeros(TOTAL, np.float32)},
+                       total=TOTAL, old_n=1, my_old=0)
+        return b
+
+    def test_commit_is_period_gated(self, tmp_path):
+        plane = PersistPlane(str(tmp_path), 0, period_s=1000.0)
+        try:
+            assert plane.commit(1, self._boundary(1)) is not None
+            assert plane.commit(2, self._boundary(2)) is None  # too soon
+        finally:
+            plane.close()
+
+    def test_period_zero_persists_every_commit_and_fence_counts(self, tmp_path):
+        plane = PersistPlane(str(tmp_path), 0, period_s=0.0, depth=2, keep=10)
+        try:
+            for s in (1, 2, 3):
+                assert plane.commit(s, self._boundary(s)) is not None
+            # depth-2 window: issuing step 3 already settled step 1
+            assert plane.persist_fence() <= 2
+            assert REGISTRY.gauge("kf_ckpt_last_step").value == 3.0
+            assert REGISTRY.gauge("kf_ckpt_age_seconds").value < 60.0
+            assert len(manifest_dirs(str(tmp_path))) == 3
+        finally:
+            plane.close()
+
+    def test_persist_before_any_commit_raises(self, tmp_path):
+        plane = PersistPlane(str(tmp_path), 0, period_s=0.0)
+        try:
+            with pytest.raises(ValueError):
+                plane.persist_async(1, ZeroBoundary())
+        finally:
+            plane.close()
+
+
+# -- restore-time agreement (the proto-verified hop) -------------------------
+class TestAgreement:
+    BASE_PORT = 28950
+
+    def _world(self, n):
+        from kungfu_tpu.comm.host import HostChannel
+        from kungfu_tpu.plan import PeerID, PeerList
+
+        TestAgreement.BASE_PORT += n + 1
+        base = TestAgreement.BASE_PORT
+        peers = PeerList.of(*(PeerID("127.0.0.1", base + i)
+                              for i in range(n)))
+        chans = [HostChannel(p, bind_host="127.0.0.1") for p in peers]
+        return peers, chans
+
+    def _agree(self, tmp_path, n, choice):
+        from tests._util import run_all
+
+        peers, chans = self._world(n)
+        planes = [PersistPlane(str(tmp_path), r) for r in range(n)]
+        try:
+            got = run_all([
+                lambda r=r: planes[r].agree_manifest(
+                    chans[r], peers, r,
+                    *(choice if r == 0 else (-1, -1)))
+                for r in range(n)
+            ], timeout=60)
+        finally:
+            for c in chans:
+                c.close()
+            for p in planes:
+                p.close()
+        return got
+
+    def test_every_rank_adopts_rank0_choice(self, tmp_path):
+        assert self._agree(tmp_path, 3, (7, 2)) == [(7, 2)] * 3
+        assert agreed_manifest_path(str(tmp_path), 7, 2) == \
+            os.path.join(str(tmp_path), manifest_name(7, 2))
+
+    def test_fresh_start_sentinel_agreed(self, tmp_path):
+        assert self._agree(tmp_path, 2, (-1, -1)) == [(-1, -1)] * 2
+        assert agreed_manifest_path(str(tmp_path), -1, -1) is None
+
+
+# -- committed KV-page snapshots ---------------------------------------------
+class TestKVSnapshot:
+    def _pool(self):
+        from kungfu_tpu.serve.kvcache import KVCachePool, PageSpec
+
+        spec = PageSpec(n_layers=2, n_heads=2, head_dim=4, page_tokens=4,
+                        dtype="float32")
+        return KVCachePool(spec, capacity_pages=8), spec
+
+    def _committed(self, pool, spec, tokens, seed=0):
+        rng = np.random.default_rng(seed)
+        shape = (spec.n_layers, spec.n_heads, spec.page_tokens,
+                 spec.head_dim)
+        n_pages = len(tokens) // spec.page_tokens
+        data = [(rng.standard_normal(shape).astype(np.float32),
+                 rng.standard_normal(shape).astype(np.float32))
+                for _ in range(n_pages)]
+        pages = pool.alloc(n_pages)
+        for pid, (k, v) in zip(pages, data):
+            pool.put_page_data(pid, k, v)
+        pool.commit_chain(tokens, pages)
+        pool.release(pages)
+        return data
+
+    def test_round_trip_bitwise(self):
+        pool, spec = self._pool()
+        tokens = list(range(1, 9))  # 2 full pages of 4
+        data = self._committed(pool, spec, tokens)
+        snap = pool.snapshot_committed()
+        fresh, _ = self._pool()
+        assert fresh.restore_committed(snap) == (2, 0)
+        pages, n_cached = fresh.lookup(tokens)
+        assert n_cached == 8
+        for pid, (k, v) in zip(pages, data):
+            gk, gv = fresh.page_data(pid)
+            np.testing.assert_array_equal(gk, k)
+            np.testing.assert_array_equal(gv, v)
+        fresh.release(pages)
+
+    def test_corrupt_page_rejected_never_served(self):
+        pool, spec = self._pool()
+        self._committed(pool, spec, list(range(1, 9)))
+        snap = pool.snapshot_committed()
+        name = sorted(k for k in snap if k.endswith("_k"))[0]
+        snap[name] = snap[name] + np.float32(1e-3)  # flip content
+        fresh, _ = self._pool()
+        assert fresh.restore_committed(snap) == (1, 1)
+
+    def test_idempotent_restore(self):
+        pool, spec = self._pool()
+        self._committed(pool, spec, list(range(1, 9)))
+        snap = pool.snapshot_committed()
+        fresh, _ = self._pool()
+        assert fresh.restore_committed(snap) == (2, 0)
+        free_before = fresh.free_pages
+        # the incumbent keeps the page: no duplicate adoption
+        assert fresh.restore_committed(snap) == (2, 0)
+        assert fresh.free_pages == free_before
+
+
+class TestRestoredServeWorker:
+    def test_warm_cache_through_cold_restart(self):
+        """ISSUE acceptance (serve): a restored worker answers the same
+        request token-identically WITH prefix reuse > 0 — the snapshot
+        made the cache warm, not just present."""
+        jax = pytest.importorskip("jax")
+        from kungfu_tpu.models.transformer import (Transformer,
+                                                   TransformerConfig)
+        from kungfu_tpu.serve.engine import InferenceEngine
+        from kungfu_tpu.serve.kvcache import KVCachePool, PageSpec
+
+        cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=2,
+                                n_heads=2, d_ff=64, max_seq=128,
+                                dtype="float32")
+        model = Transformer(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+
+        def engine():
+            pool = KVCachePool(PageSpec.for_model(cfg, page_tokens=8),
+                               capacity_pages=64)
+            return InferenceEngine(model, params, pool=pool, max_batch=4,
+                                   max_seq=cfg.max_seq)
+
+        prompt = list(range(1, 20))  # 19 tokens: 2 full pages of 8
+        old = engine()
+        old.submit("before", prompt, 6)
+        ref = [e for e in old.drain() if e["kind"] == "done"][0]
+        snap = old.pool.snapshot_committed()
+
+        new = engine()  # the cold-restarted worker
+        restored, rejected = new.pool.restore_committed(snap)
+        assert restored > 0 and rejected == 0
+        new.submit("after", prompt, 6)
+        evs = new.drain()
+        done = [e for e in evs if e["kind"] == "done"][0]
+        assert done["reused_tokens"] == 16  # both full pages reused
+        assert done["tokens"] == ref["tokens"]
+
+
+# -- the preempt:all chaos clause --------------------------------------------
+class TestChaosPreempt:
+    def test_parse_requires_explicit_all(self):
+        with pytest.raises(ValueError):
+            chaos.parse_spec("preempt:step=2")
+        with pytest.raises(ValueError):
+            chaos.parse_spec("preempt:rank=1")  # deliberately not scopable
+        (c,) = chaos.parse_spec("preempt:all,step=2,mode=raise")
+        assert c.kind == "preempt" and c.get("step") == 2
+
+    def test_fires_on_every_rank_at_the_step(self):
+        spec = chaos.parse_spec("preempt:all,step=2,mode=raise")
+        for rank in (0, 5):  # NOT rank-scoped: preemption means all
+            ctl = chaos.ChaosController(spec, rank=rank, seed=0)
+            ctl.on_step(1)
+            with pytest.raises(InjectedDeath):
+                ctl.on_step(2)
+
+    def test_without_step_fires_at_first_boundary(self):
+        ctl = chaos.ChaosController(
+            chaos.parse_spec("preempt:all,mode=raise"), rank=3, seed=0)
+        with pytest.raises(InjectedDeath):
+            ctl.on_step(0)
+
+
+# -- the -restore-from supervisor policy -------------------------------------
+class TestSupervisorPolicy:
+    def test_strip_preempt_spares_other_clauses(self):
+        assert strip_preempt("preempt:all,step=3;delay:ms=5") == "delay:ms=5"
+        assert strip_preempt("delay:ms=5;preempt:all") == "delay:ms=5"
+        assert strip_preempt("preempt:all") == ""
+        assert strip_preempt("") == ""
+        assert strip_preempt("die:step=3,rank=1") == "die:step=3,rank=1"
+
+    def test_restore_from_is_its_own_supervisor(self, tmp_path):
+        from kungfu_tpu.runner.cli import main
+
+        d = str(tmp_path / "m")
+        with pytest.raises(SystemExit):
+            main(["-np", "1", "-persist-dir", d, "-restore-from", d,
+                  "true"])
+        with pytest.raises(SystemExit):
+            main(["-np", "1", "-restore-from", d, "-w", "true"])
+        with pytest.raises(SystemExit):
+            main(["-np", "1", "-restore-from", d, "-auto-recover", "10s",
+                  "true"])
+
+
+class TestEnvKnobs:
+    def test_persist_knobs_defaults(self, monkeypatch):
+        for key in (envs.PERSIST_DIR, envs.PERSIST_PERIOD,
+                    envs.PERSIST_ASYNC_DEPTH, envs.PERSIST_KEEP,
+                    envs.PERSIST_RESTORE):
+            monkeypatch.delenv(key, raising=False)
+        knobs = envs.persist_knobs()
+        assert knobs == {"dir": "", "period_s": 30.0, "depth": 2,
+                         "keep": 3, "restore": False}
+
+    def test_persist_knobs_reads_env(self, monkeypatch):
+        monkeypatch.setenv(envs.PERSIST_DIR, "/ckpt")
+        monkeypatch.setenv(envs.PERSIST_PERIOD, "0")
+        monkeypatch.setenv(envs.PERSIST_RESTORE, "1")
+        knobs = envs.persist_knobs()
+        assert knobs["dir"] == "/ckpt"
+        assert knobs["period_s"] == 0.0
+        assert knobs["restore"] is True
+
+
+# -- the full drill ----------------------------------------------------------
+@pytest.mark.slow
+class TestPreemptRestoreE2E:
+    def test_demo_preempt_relaunch_and_halved_cold_restart(self):
+        """preempt:all kills every rank, the supervisor relaunches from
+        the newest complete manifest, and a 2-worker launch re-carves
+        the 4-rank manifest — final params bitwise vs replay."""
+        out = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "examples",
+                                          "preempt_restore.py")],
+            capture_output=True, text=True, timeout=280)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "PERSIST DEMO OK" in out.stdout
