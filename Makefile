@@ -5,17 +5,17 @@
 PY ?= python3
 BASELINE := tests/lint_baseline.json
 
-.PHONY: lint verify protocheck shardcheck pallas-check check test native \
+.PHONY: lint verify protocheck shardcheck detcheck pallas-check check test native \
     trace-demo \
     zero-demo multislice-demo adapt-demo overlap-demo serve-demo pp-demo \
     persist-demo xray-gate help
 
-## lint: all fifteen kf-lint rules — the Python suite (env-contract,
+## lint: all eighteen kf-lint rules — the Python suite (env-contract,
 ## jit-sync, blocking-io, retry-discipline, handle-discipline,
 ## collective-consistency, wire-contract, lock-order, trace-vocab,
-## agg-schema, shard-axis, shard-spec, recompile-hazard, proto-verify)
-## AND the transport.cpp lockcheck (lock-discipline) in one command,
-## honoring the baseline.
+## agg-schema, shard-axis, shard-spec, recompile-hazard, proto-verify,
+## replay-taint, rng-discipline, reduction-order) AND the transport.cpp
+## lockcheck (lock-discipline) in one command, honoring the baseline.
 lint:
 	$(PY) scripts/kflint $(if $(wildcard $(BASELINE)),--baseline $(BASELINE))
 
@@ -39,6 +39,14 @@ protocheck:
 shardcheck:
 	$(PY) scripts/kflint --checker shard-axis --checker shard-spec \
 	    --checker recompile-hazard
+
+## detcheck: just the kf-det replay-determinism rules (fast iteration
+## on consensus/persist/RNG changes) — deliberately NO baseline: a
+## replay-divergent flow never lands as legacy debt (the check.sh
+## empty-baseline gate, docs/determinism.md).
+detcheck:
+	$(PY) scripts/kflint --checker replay-taint \
+	    --checker rng-discipline --checker reduction-order
 
 ## pallas-check: the Pallas ICI collectives interpreter-path bitwise
 ## suite (docs/pallas_collectives.md): every ring kernel form — uni/

@@ -1,6 +1,6 @@
 """kf-lint: project-invariant static analysis for the kungfu-tpu tree.
 
-Fifteen AST/structural checkers enforce invariants that code review
+Eighteen AST/structural checkers enforce invariants that code review
 kept missing (see docs/lint.md for the catalog and suppression
 syntax).
 
@@ -39,6 +39,22 @@ graph (:mod:`kungfu_tpu.analysis.callgraph`):
   tag-paired, and deadlock-free over every ``ParallelPlan`` geometry
   up to 16 ranks (:mod:`kungfu_tpu.analysis.protoverify`).
 
+The replay-determinism (kf-det) rules, built on the interprocedural
+taint engine (:mod:`kungfu_tpu.analysis.taint`, rules in
+:mod:`kungfu_tpu.analysis.detrules`, contract in docs/determinism.md):
+
+* ``replay-taint`` — entropy sources (wall clock, unseeded RNG draws,
+  uuid, os entropy, set iteration order) must not reach replay-critical
+  sinks (consensus payloads, rendezvous tag names, checkpoint commits,
+  manifest records, chaos matchers); agreement-op results sanitize.
+* ``rng-discipline`` — PRNG keys are consumed by ``jax.random.split``
+  (no reuse, no double split), ``fold_in``/seed material derives from
+  agreed values, and no process-global ``np.random`` draw happens
+  inside traced code.
+* ``reduction-order`` — no order-sensitive accumulation over unordered
+  iteration (sets everywhere; dict views in the bitwise-pinned dirs);
+  ``sorted()`` is the canonical-order escape hatch.
+
 This package is intentionally stdlib-only (no jax/numpy import) so
 ``scripts/kflint`` runs in any environment, including bare CI images.
 """
@@ -46,10 +62,11 @@ This package is intentionally stdlib-only (no jax/numpy import) so
 from kungfu_tpu.analysis.core import Violation, repo_root
 from kungfu_tpu.analysis.cli import (
     CHECKERS,
+    DET_CHECKERS,
     PROTO_CHECKERS,
     VERIFY_CHECKERS,
     run_checkers,
 )
 
-__all__ = ["Violation", "repo_root", "CHECKERS", "PROTO_CHECKERS",
-           "VERIFY_CHECKERS", "run_checkers"]
+__all__ = ["Violation", "repo_root", "CHECKERS", "DET_CHECKERS",
+           "PROTO_CHECKERS", "VERIFY_CHECKERS", "run_checkers"]

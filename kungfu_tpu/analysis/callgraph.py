@@ -308,8 +308,10 @@ def project_graph(root: str) -> CallGraph:
 
 def invalidate_cache() -> None:
     """Tests that rewrite a tree between checks call this.  The axis
-    environment is derived from this graph and cascades with it."""
+    environment and the taint engine are derived from this graph and
+    cascade with it."""
     _GRAPH_CACHE.clear()
-    from kungfu_tpu.analysis import axisenv
+    from kungfu_tpu.analysis import axisenv, taint
 
     axisenv.invalidate_cache()
+    taint.invalidate_cache()

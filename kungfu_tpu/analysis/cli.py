@@ -43,6 +43,7 @@ from kungfu_tpu.analysis import (
     aggschema,
     blockingio,
     collectives,
+    detrules,
     envcheck,
     handlecheck,
     jitpurity,
@@ -74,6 +75,9 @@ CHECKERS: Dict[str, object] = {
     shardspec.CHECKER: shardspec.check,
     recompilehazard.CHECKER: recompilehazard.check,
     protoverify.CHECKER: protoverify.check,
+    detrules.CHECKER_TAINT: detrules.check_replay_taint,
+    detrules.CHECKER_RNG: detrules.check_rng_discipline,
+    detrules.CHECKER_RED: detrules.check_reduction_order,
 }
 
 #: the kf-verify subset: the interprocedural rules built on the shared
@@ -91,6 +95,31 @@ SHARD_CHECKERS = (shardaxis.CHECKER, shardspec.CHECKER,
 #: baseline in check.sh — a collective-ordering divergence, an orphan
 #: p2p tag, or a wait-for cycle can never land as "legacy debt"
 PROTO_CHECKERS = (protoverify.CHECKER,)
+
+#: the kf-det subset: the replay-determinism rules over the taint
+#: engine (make detcheck / the check.sh empty-baseline gate run exactly
+#: these — a determinism finding never ratchets)
+DET_CHECKERS = (detrules.CHECKER_TAINT, detrules.CHECKER_RNG,
+                detrules.CHECKER_RED)
+
+#: cross-language rule contracts: a change to EITHER side must surface
+#: the findings the rule reports on the other side — ``--changed``
+#: expands the filter set through these couples (a transport.cpp-only
+#: diff still shows the wire-contract finding anchored on host.py)
+COUPLED_PATHS: Tuple[Tuple[str, ...], ...] = (
+    (wirecontract.HOST_PATH.replace("\\", "/"),
+     wirecontract.CPP_PATH.replace("\\", "/")),
+)
+
+
+def expand_coupled(changed: Sequence[str]) -> set:
+    """The changed-path filter set, closed over the cross-language
+    couples."""
+    out = set(changed)
+    for couple in COUPLED_PATHS:
+        if out & set(couple):
+            out.update(couple)
+    return out
 
 
 def _git_changed_files(root: str) -> Optional[List[str]]:
@@ -192,7 +221,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 return 0
         violations = run_checkers(root, names)
         if changed is not None:
-            changed_set = set(changed)
+            changed_set = expand_coupled(changed)
             violations = [v for v in violations if v.path in changed_set]
         suppressed = 0
         if args.baseline:

@@ -4,7 +4,7 @@
 #
 #   scripts/check.sh
 #
-# 1. kflint        — all fifteen project-invariant checkers, including
+# 1. kflint        — all eighteen project-invariant checkers, including
 #                    the kf-verify interprocedural rules and the
 #                    kf-shard axis-environment rules (docs/lint.md),
 #                    over kungfu_tpu/, scripts/, benchmarks/, examples/,
@@ -24,6 +24,11 @@
 #                    geometry <= 16 ranks, docs/lint.md) also gates
 #                    empty — a divergent collective or an orphan tag is
 #                    a distributed hang waiting to happen, never debt.
+# 1d. kf-det       — replay-taint / rng-discipline / reduction-order
+#                    rerun WITHOUT the baseline: entropy reaching a
+#                    consensus/rendezvous/commit/manifest sink, a
+#                    reused PRNG key, or an unordered float fold breaks
+#                    bitwise replay (docs/determinism.md) — never debt.
 # 2. kftrace       — flight-recorder dump schema self-check (recorder
 #                    and reader must agree byte-for-byte, docs/tracing.md)
 # 3. kftop         — live-plane /cluster schema self-check (push wire
@@ -66,6 +71,14 @@ fi
 echo "== empty-baseline gate (proto-verify: ordering, tag pairing, deadlock-freedom)"
 # no --baseline on purpose: a protocol divergence never ratchets
 if ! python3 scripts/kflint --proto; then
+    fail=1
+fi
+
+echo "== empty-baseline gate (kf-det: replay-taint, rng-discipline, reduction-order)"
+# no --baseline on purpose: replay divergence never ratchets — a
+# finding here means a restart or replica would not reproduce bitwise
+if ! python3 scripts/kflint --checker replay-taint \
+        --checker rng-discipline --checker reduction-order; then
     fail=1
 fi
 

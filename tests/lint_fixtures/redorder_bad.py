@@ -1,0 +1,54 @@
+"""Seeded reduction-order violations.  tests/test_det.py copies this
+file under ``kungfu_tpu/ops/`` (a bitwise-pinned path) — keep edits
+append-only."""
+
+
+def set_bucket_fold(widths, slabs):
+    # BAD: appending under set iteration builds an ordered artifact
+    # from an unordered order
+    parts = []
+    off = 0
+    for w in set(widths):
+        parts.append(slabs[off:off + w])
+        off += w
+    return parts
+
+
+def set_literal_fold(grads):
+    # BAD: float accumulation over a set literal
+    total = 0.0
+    for k in {"wq", "wk", "wv"}:
+        total += grads[k]
+    return total
+
+
+def sum_over_set(vals):
+    # BAD: bare sum() folds in Python iteration order
+    return sum(v * v for v in set(vals))
+
+
+def dict_bucket_fold(buckets):
+    # BAD (pinned dirs): dict insertion order is geometry-shaped —
+    # a restart onto another world size builds the buckets in another
+    # order
+    acc = 0.0
+    for name, val in buckets.items():
+        acc += val
+    return acc
+
+
+def order_taint_via_name(ranks):
+    # BAD: the set order taint rides the variable
+    survivors = set(ranks)
+    csv = []
+    for r in survivors:
+        csv.append(str(r))
+    return ",".join(csv)
+
+
+def waived_fold(buckets):
+    # suppressed: documented order-insensitive integer count
+    n = 0
+    for k in buckets.keys():
+        n += 1  # kflint: allow(reduction-order)
+    return n

@@ -1,0 +1,36 @@
+"""Order-pinned folds reduction-order must NOT flag (also copied under
+``kungfu_tpu/ops/`` by tests/test_det.py)."""
+
+
+def list_bucket_fold(widths, slabs):
+    # lists iterate in construction order — pinned
+    parts = []
+    off = 0
+    for w in widths:
+        parts.append(slabs[off:off + w])
+        off += w
+    return parts
+
+
+def sorted_set_fold(widths):
+    # the canonical-order escape hatch: sorted() pins the fold order
+    total = 0.0
+    for w in sorted(set(widths)):
+        total += w
+    return total
+
+
+def sorted_dict_fold(buckets):
+    acc = 0.0
+    for name in sorted(buckets.keys()):
+        acc += buckets[name]
+    return acc
+
+
+def sum_over_sorted(vals):
+    return sum(v * v for v in sorted(set(vals)))
+
+
+def membership_is_fine(vals, allow):
+    # set membership tests are order-insensitive
+    return [v for v in vals if v in {"a", "b", "c"} and v not in allow]

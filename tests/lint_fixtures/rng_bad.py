@@ -1,0 +1,51 @@
+"""Seeded rng-discipline violations (tests/test_det.py pins the line
+numbers below — keep edits append-only)."""
+import os
+import time
+
+import jax
+import numpy as np
+
+
+def split_reuse(key, x):
+    # BAD: `key` is consumed by the split but used again — the normal
+    # draw duplicates the stream k1/k2 were derived from
+    k1, k2 = jax.random.split(key)
+    return jax.random.normal(key, x.shape) + k1.sum() + k2.sum()
+
+
+def split_again(key):
+    # BAD: the second split re-consumes the dead key: both splits
+    # return identical children
+    k1 = jax.random.split(key)[0]
+    k2 = jax.random.split(key)[0]
+    return k1, k2
+
+
+def fold_in_entropy(key):
+    # BAD: folding wall-clock into the key forks rank-divergent,
+    # replay-divergent streams
+    return jax.random.fold_in(key, int(time.time()))
+
+
+def entropy_seed():
+    # BAD: the root key must derive from agreed values, not the pid
+    return jax.random.PRNGKey(os.getpid())
+
+
+def entropy_np_seed():
+    # BAD: same discipline for numpy generators on replay paths
+    return np.random.default_rng(int(time.time_ns()))
+
+
+@jax.jit
+def np_random_in_jit(x):
+    # BAD: the draw happens once at trace time and is baked into the
+    # compiled artifact
+    noise = np.random.rand(4)
+    return x + noise
+
+
+def waived_jitter(key):
+    # suppressed: documented local-only jitter stream
+    return jax.random.fold_in(key, int(time.monotonic()))  # kflint: allow(rng-discipline)

@@ -1,0 +1,43 @@
+"""Sanctioned PRNG usage rng-discipline must NOT flag."""
+import jax
+import numpy as np
+
+
+def threaded_split(key, x):
+    # the canonical idiom: the split REBINDS key, so nothing is reused
+    key, sub = jax.random.split(key)
+    noise = jax.random.normal(sub, x.shape)
+    key, sub = jax.random.split(key)
+    return noise + jax.random.normal(sub, x.shape)
+
+
+def fanout_split(key, n):
+    # consuming fan-out: key is rebound by the same assignment
+    key, *subs = jax.random.split(key, n + 1)
+    return key, subs
+
+
+def agreed_fold_in(key, step, layer):
+    # folding agreed values produces identical streams on every rank
+    # and every replay
+    k = jax.random.fold_in(key, step)
+    return jax.random.fold_in(k, layer)
+
+
+def agreed_seed(cluster_version, step):
+    # seed material from agreed state
+    return jax.random.PRNGKey(cluster_version * 1_000_003 + step)
+
+
+def seeded_numpy(seed):
+    # a threaded seed is fine — determinism is the caller's contract
+    return np.random.default_rng(seed)
+
+
+def loop_threading(key, xs):
+    # rebinding inside the loop keeps the chain linear
+    out = []
+    for x in xs:
+        key, sub = jax.random.split(key)
+        out.append(jax.random.normal(sub, x.shape))
+    return out

@@ -1,0 +1,73 @@
+"""Seeded replay-taint violations (tests/test_det.py pins the line
+numbers below — keep edits append-only)."""
+import json
+import time
+import uuid
+
+
+def _stamp():
+    # entropy source, two calls below the sink
+    return time.time()
+
+
+def _token():
+    return f"run-{_stamp()}"
+
+
+def two_calls_deep(peer, workers):
+    # BAD: time.time() -> _stamp -> _token -> consensus payload; the
+    # digest differs on every replay
+    peer.channel.consensus_bytes(_token().encode(), workers, name="boot")
+
+
+def _tag_for(suffix):
+    # pure formatter: taint flows param -> return
+    return f"kf.win.{suffix}"
+
+
+def param_flow(peer, workers, blob):
+    # BAD: uuid4 through a helper into a rendezvous name — the tag
+    # never rendezvouses across ranks, and never replays
+    nonce = uuid.uuid4()
+    peer.channel.gather_bytes(blob, workers, name=_tag_for(nonce))
+
+
+def branch_sanitizer(peer, workers, fast):
+    # BAD: the else branch keeps the wall-clock tag; sanitizing ONE
+    # branch must not launder the other
+    if fast:
+        tag = "steady"
+    else:
+        tag = f"w{time.monotonic()}"
+    peer.channel.barrier(workers, name=tag)
+
+
+def container_round_trip(peer, workers):
+    # BAD: entropy stored into a dict, serialized, and committed as a
+    # manifest-style consensus payload
+    meta = {"step": 3}
+    meta["issued"] = time.time()
+    peer.channel.consensus_bytes(json.dumps(meta).encode(), workers,
+                                 name="meta")
+
+
+def list_append_round_trip(peer, workers, blob):
+    # BAD: entropy appended into a list that becomes the tag
+    parts = ["kf"]
+    parts.append(str(time.perf_counter()))
+    peer.channel.barrier(workers, name=".".join(parts))
+
+
+def agree_one_branch(peer, workers, blob):
+    # BAD: the agreement op sanitizes only the cached branch; the
+    # fallback still commits a rank-local wall-clock read
+    if blob:
+        digest = peer.channel.consensus_bytes(blob, workers, name="d")
+    else:
+        digest = str(time.time_ns()).encode()
+    peer.channel.consensus_bytes(digest, workers, name="install")
+
+
+def waived_probe(peer, workers, blob):
+    # suppressed: a deliberately local debug tag, documented here
+    peer.channel.gather_bytes(blob, workers, name=f"dbg.{time.time()}")  # kflint: allow(replay-taint)

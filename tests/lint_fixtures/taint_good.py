@@ -1,0 +1,38 @@
+"""Sanctioned flows replay-taint must NOT flag (tests/test_det.py runs
+the rule over this file and asserts zero findings)."""
+import hashlib
+import json
+import time
+
+
+def agreed_digest(peer, workers, payload):
+    # the digest derives from the payload every rank already agrees on
+    digest = hashlib.blake2b(payload, digest_size=8).hexdigest()
+    peer.channel.barrier(workers, name=f"kf.slice.{digest}")
+
+
+def round_tripped(peer, workers, blob):
+    # an agreement op's RESULT is the agreed value — taint dies there
+    agreed = peer.channel.consensus_bytes(blob, workers, name="agree")
+    peer.channel.consensus_bytes(agreed, workers, name="install")
+
+
+def agreed_metadata(peer, workers, step, cluster_version):
+    # (step, cluster_version) are agreed values, not entropy
+    meta = {"step": int(step), "v": int(cluster_version)}
+    peer.channel.consensus_bytes(json.dumps(meta).encode(), workers,
+                                 name=f"kf.persist.agree.v{cluster_version}")
+
+
+def sorted_set_tag(peer, workers, ranks):
+    # sorted() pins the order: the canonical-order escape hatch
+    survivors = ",".join(str(r) for r in sorted(set(ranks)))
+    peer.channel.barrier(workers, name=f"kf.shrink.{survivors}")
+
+
+def local_gauge_only(peer, workers, blob):
+    # wall-clock feeding a LOCAL gauge is sanctioned — it never reaches
+    # a replay-critical sink
+    t0 = time.monotonic()
+    peer.channel.broadcast_bytes(blob, workers, name="steady")
+    return time.monotonic() - t0

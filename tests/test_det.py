@@ -1,0 +1,401 @@
+"""kf-det in tier-1: the replay-determinism rules must catch what they
+claim to catch (fixtures under tests/lint_fixtures/ seed known
+violations), stay quiet on the sanctioned idioms, and flip red on the
+acceptance mutations applied to copies of the real tree."""
+
+import os
+import shutil
+import subprocess
+import sys
+
+from kungfu_tpu.analysis import core, detrules, taint
+from kungfu_tpu.analysis.cli import (
+    CHECKERS,
+    DET_CHECKERS,
+    expand_coupled,
+    main as cli_main,
+)
+from kungfu_tpu.analysis.core import repo_root
+
+ROOT = repo_root(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(ROOT, "tests", "lint_fixtures")
+
+
+def _tmp_tree(tmp_path, files):
+    """Build a minimal repo layout: {relpath: source or fixture name}."""
+    for rel, content in files.items():
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        if os.path.exists(os.path.join(FIXTURES, str(content))):
+            shutil.copy(os.path.join(FIXTURES, str(content)), dst)
+        else:
+            dst.write_text(content)
+    return str(tmp_path)
+
+
+def _det_check_all(root):
+    out = []
+    out.extend(detrules.check_replay_taint(root))
+    out.extend(detrules.check_rng_discipline(root))
+    out.extend(detrules.check_reduction_order(root))
+    return out
+
+
+class TestDetRegistration:
+    def test_det_checkers_registered(self):
+        assert set(DET_CHECKERS) == {
+            "replay-taint", "rng-discipline", "reduction-order"}
+        assert set(DET_CHECKERS) <= set(CHECKERS)
+
+    def test_cli_lists_det_rules(self, capsys):
+        assert cli_main(["--list"]) == 0
+        listed = capsys.readouterr().out.split()
+        for name in DET_CHECKERS:
+            assert name in listed
+
+
+class TestReplayTaint:
+    """The tentpole: entropy sources to replay-critical sinks, at
+    interprocedural depth, with sanitizer awareness."""
+
+    def test_fixture_violations_caught(self, tmp_path):
+        root = _tmp_tree(tmp_path,
+                         {"kungfu_tpu/elastic/taint_bad.py": "taint_bad.py"})
+        got = detrules.check_replay_taint(root)
+        assert {v.line for v in got} == {20, 32, 42, 50, 58, 68}, \
+            [v.render() for v in got]
+
+    def test_two_calls_deep_chain_rendered(self, tmp_path):
+        """The source->sink call path is part of the finding: time.time()
+        inside _stamp, through _token, into the consensus payload."""
+        root = _tmp_tree(tmp_path,
+                         {"kungfu_tpu/elastic/taint_bad.py": "taint_bad.py"})
+        msg = {v.line: v.message for v in
+               detrules.check_replay_taint(root)}[20]
+        assert "time.time()" in msg
+        assert "returned through _stamp()" in msg
+        assert "returned through _token()" in msg
+        assert "consensus" in msg
+
+    def test_param_flow_through_helper(self, tmp_path):
+        """uuid4 rides a pure formatter's param->return flow into a
+        rendezvous tag name."""
+        root = _tmp_tree(tmp_path,
+                         {"kungfu_tpu/elastic/taint_bad.py": "taint_bad.py"})
+        msg = {v.line: v.message for v in
+               detrules.check_replay_taint(root)}[32]
+        assert "uuid4()" in msg and "name=" in msg
+
+    def test_branch_sanitizer_does_not_launder(self, tmp_path):
+        """A clean value on ONE branch must not launder the tainted
+        other branch (env forks are merged by union)."""
+        root = _tmp_tree(tmp_path,
+                         {"kungfu_tpu/elastic/taint_bad.py": "taint_bad.py"})
+        lines = {v.line for v in detrules.check_replay_taint(root)}
+        assert 42 in lines   # branch_sanitizer's barrier
+        assert 68 in lines   # agree_one_branch's install consensus
+
+    def test_container_round_trips_tracked(self, tmp_path):
+        """Entropy stored into a dict/list survives serialization into
+        the sink payload (weak container updates)."""
+        root = _tmp_tree(tmp_path,
+                         {"kungfu_tpu/elastic/taint_bad.py": "taint_bad.py"})
+        lines = {v.line for v in detrules.check_replay_taint(root)}
+        assert {50, 58} <= lines
+
+    def test_suppression_honored(self, tmp_path):
+        root = _tmp_tree(tmp_path,
+                         {"kungfu_tpu/elastic/taint_bad.py": "taint_bad.py"})
+        assert all(v.line != 73 for v in detrules.check_replay_taint(root))
+
+    def test_sanctioned_flows_clean(self, tmp_path):
+        """Agreed digests, agreement-op round trips, sorted() tags, and
+        local-only gauges are the sanctioned idioms — zero findings."""
+        root = _tmp_tree(tmp_path,
+                         {"kungfu_tpu/elastic/taint_good.py": "taint_good.py"})
+        got = detrules.check_replay_taint(root)
+        assert got == [], [v.render() for v in got]
+
+
+class TestRngDiscipline:
+    def test_fixture_violations_caught(self, tmp_path):
+        root = _tmp_tree(tmp_path,
+                         {"kungfu_tpu/models/rng_bad.py": "rng_bad.py"})
+        got = detrules.check_rng_discipline(root)
+        assert {v.line for v in got} == {14, 21, 28, 33, 38, 45}, \
+            [v.render() for v in got]
+
+    def test_split_reuse_names_the_key(self, tmp_path):
+        root = _tmp_tree(tmp_path,
+                         {"kungfu_tpu/models/rng_bad.py": "rng_bad.py"})
+        msgs = {v.line: v.message for v in
+                detrules.check_rng_discipline(root)}
+        assert "`key` reused" in msgs[14]
+        assert "split again" in msgs[21]
+
+    def test_fold_in_entropy_carries_source(self, tmp_path):
+        root = _tmp_tree(tmp_path,
+                         {"kungfu_tpu/models/rng_bad.py": "rng_bad.py"})
+        msgs = {v.line: v.message for v in
+                detrules.check_rng_discipline(root)}
+        assert "fold_in" in msgs[28] and "time.time()" in msgs[28]
+        assert "getpid()" in msgs[33]
+        assert "time_ns()" in msgs[38]
+
+    def test_np_random_in_jit_names_root(self, tmp_path):
+        root = _tmp_tree(tmp_path,
+                         {"kungfu_tpu/models/rng_bad.py": "rng_bad.py"})
+        msgs = {v.line: v.message for v in
+                detrules.check_rng_discipline(root)}
+        assert "np_random_in_jit" in msgs[45]
+
+    def test_suppression_honored(self, tmp_path):
+        root = _tmp_tree(tmp_path,
+                         {"kungfu_tpu/models/rng_bad.py": "rng_bad.py"})
+        assert all(v.line != 51
+                   for v in detrules.check_rng_discipline(root))
+
+    def test_threaded_idioms_clean(self, tmp_path):
+        """Rebinding splits, fan-out, agreed fold_in/seeds, threaded
+        numpy seeds, and loop threading are the sanctioned idioms."""
+        root = _tmp_tree(tmp_path,
+                         {"kungfu_tpu/models/rng_good.py": "rng_good.py"})
+        got = detrules.check_rng_discipline(root)
+        assert got == [], [v.render() for v in got]
+
+
+class TestReductionOrder:
+    def test_fixture_violations_caught(self, tmp_path):
+        root = _tmp_tree(tmp_path,
+                         {"kungfu_tpu/ops/redorder_bad.py": "redorder_bad.py"})
+        got = detrules.check_reduction_order(root)
+        assert {v.line for v in got} == {12, 13, 21, 27, 36, 45}, \
+            [v.render() for v in got]
+
+    def test_dict_iteration_only_in_pinned_paths(self, tmp_path):
+        """Dict iteration order is insertion order — only geometry-shaped
+        in the bitwise-pinned dirs; set iteration is flagged anywhere."""
+        root = _tmp_tree(
+            tmp_path,
+            {"kungfu_tpu/utils/redorder_bad.py": "redorder_bad.py"})
+        lines = {v.line for v in detrules.check_reduction_order(root)}
+        assert 36 not in lines       # dict .items() fold: pinned dirs only
+        assert {12, 13, 21, 27, 45} <= lines
+
+    def test_suppression_honored(self, tmp_path):
+        root = _tmp_tree(tmp_path,
+                         {"kungfu_tpu/ops/redorder_bad.py": "redorder_bad.py"})
+        assert all(v.line != 53
+                   for v in detrules.check_reduction_order(root))
+
+    def test_sorted_escape_hatch_clean(self, tmp_path):
+        root = _tmp_tree(
+            tmp_path,
+            {"kungfu_tpu/ops/redorder_good.py": "redorder_good.py"})
+        got = detrules.check_reduction_order(root)
+        assert got == [], [v.render() for v in got]
+
+
+class TestTaintEngine:
+    """Direct pins on the interprocedural engine under the rules."""
+
+    def test_helper_summary_has_param_flow(self, tmp_path):
+        root = _tmp_tree(tmp_path,
+                         {"kungfu_tpu/elastic/taint_bad.py": "taint_bad.py"})
+        eng = taint.taint_engine(root)
+        tag_for = next(f for f in eng.graph.functions
+                       if f.name == "_tag_for")
+        summ = eng.summary(tag_for)
+        assert 0 in summ.param_flows  # suffix flows into the return
+
+    def test_source_summary_returns_taint(self, tmp_path):
+        root = _tmp_tree(tmp_path,
+                         {"kungfu_tpu/elastic/taint_bad.py": "taint_bad.py"})
+        eng = taint.taint_engine(root)
+        stamp = next(f for f in eng.graph.functions if f.name == "_stamp")
+        kinds = {t.kind for t in eng.summary(stamp).ret}
+        assert kinds == {"time"}
+
+    def test_recursion_terminates(self, tmp_path):
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/mod.py":
+                "import time\n\n\n"
+                "def a(n):\n"
+                "    if n <= 0:\n"
+                "        return time.time()\n"
+                "    return b(n - 1)\n\n\n"
+                "def b(n):\n"
+                "    return a(n)\n\n\n"
+                "def use(peer, workers, n):\n"
+                "    payload = str(a(n)).encode()\n"
+                "    peer.channel.consensus_bytes(payload, workers, name='r')\n",
+        })
+        got = detrules.check_replay_taint(root)
+        # the cycle back edge returns the empty summary, but the direct
+        # time.time() return in `a` still reaches the sink
+        assert [v.line for v in got] == [16], [v.render() for v in got]
+
+
+class TestDetMutationProof:
+    """The acceptance criterion: each seeded mutation on a copy of the
+    real tree flips exactly its rule red; the unmutated copies pass all
+    three rules with no baseline."""
+
+    _FILES = {
+        "kungfu_tpu/elastic/persist.py": ("elastic", "persist.py"),
+        "kungfu_tpu/parallel/train.py": ("parallel", "train.py"),
+        "kungfu_tpu/ops/schedules.py": ("ops", "schedules.py"),
+    }
+
+    def _tree(self, tmp_path, mutate=None):
+        files = {}
+        for rel, (sub, fn) in self._FILES.items():
+            src = open(os.path.join(ROOT, "kungfu_tpu", sub, fn)).read()
+            if mutate and fn in mutate:
+                mutated = mutate[fn](src)
+                assert mutated != src, f"mutation must change {fn}"
+                src = mutated
+            files[rel] = src
+        return _tmp_tree(tmp_path, files)
+
+    def test_unmutated_copies_clean(self, tmp_path):
+        root = self._tree(tmp_path)
+        got = _det_check_all(root)
+        assert got == [], [v.render() for v in got]
+
+    def test_persist_digest_entropy_caught(self, tmp_path):
+        """Manifest digest derived from time.time() instead of the
+        payload: the ok record can never verify on replay."""
+        root = self._tree(tmp_path, mutate={
+            "persist.py": lambda s: s.replace(
+                "digest = hashlib.blake2b(payload, "
+                "digest_size=16).hexdigest()",
+                "digest = hashlib.blake2b(str(time.time()).encode(), "
+                "digest_size=16).hexdigest()"),
+        })
+        got = [v for v in detrules.check_replay_taint(root)
+               if v.path.endswith("persist.py")]
+        assert got, "replay-taint must flag the entropy digest"
+        assert any("time.time()" in v.message for v in got), \
+            [v.render() for v in got]
+
+    def test_train_key_reuse_caught(self, tmp_path):
+        """Dropping the rebinding on the first split leaves `key` dead
+        but reconsumed by the next split."""
+        root = self._tree(tmp_path, mutate={
+            "train.py": lambda s: s.replace(
+                "key, k = jax.random.split(key)",
+                "k = jax.random.split(key)[1]", 1),
+        })
+        got = [v for v in detrules.check_rng_discipline(root)
+               if v.path.endswith("train.py")]
+        assert got, "rng-discipline must flag the key reuse"
+        assert any("`key`" in v.message for v in got), \
+            [v.render() for v in got]
+
+    def test_schedules_set_iteration_caught(self, tmp_path):
+        """Folding scatter slabs over set(widths) unpins the bucket
+        order the bitwise-replay contract depends on."""
+        root = self._tree(tmp_path, mutate={
+            "schedules.py": lambda s: s.replace(
+                "for w in widths:", "for w in set(widths):", 1),
+        })
+        got = [v for v in detrules.check_reduction_order(root)
+               if v.path.endswith("schedules.py")]
+        assert got, "reduction-order must flag the set fold"
+        assert any("set(...)" in v.message for v in got), \
+            [v.render() for v in got]
+
+    def test_mutations_fail_the_cli(self, tmp_path, capsys):
+        """The same flip through the kflint CLI (what check.sh runs)."""
+        root = self._tree(tmp_path, mutate={
+            "schedules.py": lambda s: s.replace(
+                "for w in widths:", "for w in set(widths):", 1),
+        })
+        args = ["--root", root]
+        for c in DET_CHECKERS:
+            args += ["--checker", c]
+        assert cli_main(args) == 1
+        capsys.readouterr()
+
+
+class TestChangedCoupled:
+    """The --changed cross-language fix: a transport.cpp-only change
+    must still surface wire-contract findings (attributed to host.py)."""
+
+    def test_expand_coupled_closes_over_the_pair(self):
+        got = expand_coupled(["kungfu_tpu/native/transport.cpp"])
+        assert "kungfu_tpu/comm/host.py" in got
+        assert "kungfu_tpu/native/transport.cpp" in got
+        # unrelated changes stay as-is
+        assert expand_coupled(["kungfu_tpu/ops/schedules.py"]) == {
+            "kungfu_tpu/ops/schedules.py"}
+
+    def test_cpp_only_change_surfaces_wire_contract(self, tmp_path,
+                                                    monkeypatch, capsys):
+        host = open(os.path.join(ROOT, "kungfu_tpu", "comm",
+                                 "host.py")).read()
+        cpp = open(os.path.join(ROOT, "kungfu_tpu", "native",
+                                "transport.cpp")).read()
+        # a kMagic drift is found by diffing BOTH sides, but the finding
+        # is attributed to host.py — exactly the path the old --changed
+        # filter dropped when only the .cpp changed
+        mutated = cpp.replace("0x4B465450", "0x4B465451")
+        assert mutated != cpp
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/comm/host.py": host,
+            "kungfu_tpu/native/transport.cpp": mutated,
+        })
+        from kungfu_tpu.analysis import cli as cli_mod
+        monkeypatch.setattr(
+            cli_mod, "_git_changed_files",
+            lambda root: ["kungfu_tpu/native/transport.cpp"])
+        rc = cli_main(["--root", root, "--changed",
+                       "--checker", "wire-contract"])
+        out = capsys.readouterr()
+        assert rc == 1, out.out + out.err
+        assert "host.py" in out.out + out.err
+
+
+class TestCheckWiring:
+    """check.sh / Makefile carry the kf-det empty-baseline gate."""
+
+    def test_check_sh_has_det_gate(self):
+        text = open(os.path.join(ROOT, "scripts", "check.sh")).read()
+        for name in DET_CHECKERS:
+            assert f"--checker {name}" in text, name
+
+    def test_makefile_has_detcheck(self):
+        text = open(os.path.join(ROOT, "Makefile")).read()
+        assert "detcheck" in text
+        for name in DET_CHECKERS:
+            assert name in text
+
+    def test_full_cli_clean_on_tree(self):
+        """The empty-baseline acceptance gate on the real tree."""
+        rc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "scripts", "kflint"),
+             "--checker", "replay-taint", "--checker", "rng-discipline",
+             "--checker", "reduction-order"],
+            capture_output=True, timeout=120,
+        )
+        assert rc.returncode == 0, \
+            rc.stdout.decode() + rc.stderr.decode()
+
+
+class TestDetSingleParse:
+    """The det rules ride the shared parse cache: one parse per file
+    even with the engine, the call graph, and the axis env all active."""
+
+    def test_det_rules_share_the_parse_cache(self, tmp_path):
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/elastic/taint_bad.py": "taint_bad.py",
+            "kungfu_tpu/models/rng_bad.py": "rng_bad.py",
+            "kungfu_tpu/ops/redorder_bad.py": "redorder_bad.py",
+        })
+        core.clear_parse_cache()
+        _det_check_all(root)
+        counts = {p: c for p, c in core.PARSE_COUNTS.items()
+                  if p.startswith(str(tmp_path))}
+        assert len(counts) == 3, counts
+        assert all(c == 1 for c in counts.values()), counts

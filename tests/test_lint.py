@@ -890,7 +890,7 @@ class TestJitSyncInterprocedural:
 class TestSingleParse:
     """The kflint perf satellite: one full run parses each file exactly
     once — the module cache in analysis/core.py is shared by all
-    thirteen rules AND the call graph."""
+    eighteen rules AND the call graph AND the kf-det taint engine."""
 
     def test_each_file_parsed_once_per_run(self, tmp_path):
         from kungfu_tpu.analysis import core
@@ -907,6 +907,19 @@ class TestSingleParse:
                   if p.startswith(str(tmp_path))}
         assert len(counts) == 4, counts
         assert all(c == 1 for c in counts.values()), counts
+
+    def test_full_tree_single_parse(self):
+        """On the REAL tree — every checker plus the taint engine plus
+        the call graph plus the axis env still cost one parse per file
+        (the <10s full-run budget depends on this)."""
+        from kungfu_tpu.analysis import core
+
+        core.clear_parse_cache()
+        run_checkers(ROOT)
+        counts = {p: c for p, c in core.PARSE_COUNTS.items()
+                  if p.startswith(os.path.join(ROOT, "kungfu_tpu"))}
+        over = {p: c for p, c in counts.items() if c != 1}
+        assert counts and not over, over
 
     def test_cache_invalidates_on_rewrite(self, tmp_path):
         """Rewriting a file between runs re-parses it (stat-keyed cache,
