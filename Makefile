@@ -8,7 +8,7 @@ BASELINE := tests/lint_baseline.json
 .PHONY: lint verify protocheck shardcheck detcheck pallas-check check test native \
     trace-demo \
     zero-demo multislice-demo adapt-demo overlap-demo serve-demo pp-demo \
-    persist-demo xray-gate help
+    persist-demo xray-gate sentinel-gate benchdiff help
 
 ## lint: all eighteen kf-lint rules — the Python suite (env-contract,
 ## jit-sync, blocking-io, retry-discipline, handle-discipline,
@@ -83,6 +83,27 @@ xray-gate:
 	grep -q '"vs_baseline": 1.0' /tmp/_kf_xray_gate.json
 	grep -q '"budget_ok": true' /tmp/_kf_xray_gate.json
 	@echo "xray-gate: all checks green"
+
+## sentinel-gate: the kf-sentinel detection gate (the same stanza
+## scripts/check.sh runs): 3-rank paced mesh, chaos delay clauses armed
+## MID-RUN via after_step — the clean baseline must stay silent, the
+## regress:step_time_s changepoint alert must fire online within K=2
+## windows, the incident flight record's xray verdict must name the
+## planted rank/edge, and `kfhist --verdict` over the durable history
+## must reproduce the identical verdicts offline (docs/sentinel.md;
+## the recorded row is BENCH_extra.json sentinel_cpu_mesh).
+sentinel-gate:
+	$(PY) bench.py --sentinel --quick > /tmp/_kf_sentinel_gate.json
+	grep -q '"vs_baseline": 1.0' /tmp/_kf_sentinel_gate.json
+	@echo "sentinel-gate: all checks green"
+
+## benchdiff: compare the live BENCH_extra.json against the checked-in
+## per-gate scalar baseline (tests/bench_baseline.json) with tolerance
+## bands — nonzero exit on any regressed or vanished gate.  Regenerate
+## the baseline after recording new rows:
+##   scripts/kfbench-diff --snapshot BENCH_extra.json > tests/bench_baseline.json
+benchdiff:
+	$(PY) scripts/kfbench-diff tests/bench_baseline.json BENCH_extra.json
 
 ## trace-demo: 4-peer local run with an injected 400 ms straggler on
 ## rank 2 (every 9th matching send, so most collectives stay clean and

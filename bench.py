@@ -2711,6 +2711,277 @@ def payload_persist(args) -> dict:
     }
 
 
+def payload_sentinel(args) -> dict:
+    """kf-sentinel gate (ISSUE 19): online regression detection with a
+    reproducible offline verdict, tunnel-proof on the CPU mesh.
+
+    A 3-rank in-process host-plane cluster trains the small transformer
+    and allreduces a gradient-sized buffer per step, feeding per-rank
+    snapshots to a live :class:`ClusterAggregator` with an attached
+    :class:`Sentinel` (fake aggregator clock -> exactly one sentinel
+    sample per step, deterministic cadence).  After a clean baseline
+    phase, chaos ``delay`` clauses are armed MID-RUN on the 0<->1 link
+    (30 ms each send direction + 60 ms on rank 1's receive leg), so
+    step walls inflate and the planted straggler is rank 1.  The gate
+    asserts the sentinel plane end to end: no alert fires during the
+    clean phase, a ``regress:step_time_s`` changepoint alert fires
+    online within K=2 detection windows of the onset, the incident
+    flight record's kf-xray verdict names the planted rank/edge, and
+    ``kfhist --verdict --upto <history_n>`` replayed over the durable
+    history reproduces the incident's verdicts IDENTICALLY (one
+    implementation, monitor/detect.py)."""
+    import gc
+    import os
+    import shutil
+    import tempfile
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    os.environ["KF_NATIVE_ENGINE"] = "0"  # chaos hooks ride the py path
+    os.environ["KF_CONFIG_ENABLE_TRACE"] = "1"
+    os.environ.setdefault("KF_CONFIG_LOG_LEVEL", "WARNING")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+    import jax.numpy as jnp
+
+    from kungfu_tpu import chaos
+    from kungfu_tpu.models.transformer import Transformer, TransformerConfig
+    from kungfu_tpu.monitor import kfhist, timeline
+    from kungfu_tpu.monitor.aggregator import (REPORT_KINDS,
+                                               ClusterAggregator,
+                                               make_snapshot)
+    from kungfu_tpu.monitor.registry import REGISTRY
+    from kungfu_tpu.monitor.sentinel import Sentinel
+    from kungfu_tpu.peer import Peer
+    from kungfu_tpu.plan import Cluster, PeerList, parse_strategy
+    from kungfu_tpu.utils.envs import Config
+
+    window = 4
+    k_windows = 2          # the detection-latency budget, in windows
+    clean_steps = 12 if args.quick else 16
+    chaos_steps = 8 if args.quick else 10
+    wire_ms = 30
+    # the planted fault, armed MID-RUN: the delay clauses stay inert
+    # until note_step announces `clean_steps` (after_step gating), so
+    # the baseline phase is clean and the 0<->1 link degrades from one
+    # deterministic step boundary — rank 1's receive leg pays 2x wire
+    # (the asymmetric straggler the incident's xray verdict must name)
+    os.environ["KF_CHAOS_SPEC"] = (
+        f"delay:ms={wire_ms},rank=0,peer=1,on=send,after_step={clean_steps};"
+        f"delay:ms={wire_ms},rank=1,peer=0,on=send,after_step={clean_steps};"
+        f"delay:ms={2 * wire_ms},rank=1,peer=0,on=recv,"
+        f"after_step={clean_steps}")
+    root = tempfile.mkdtemp(prefix="kf-sentinel-bench-")
+    # the env knob family steers BOTH planes: Sentinel.from_env() (the
+    # production attach path) and kfhist's offline replay defaults
+    os.environ["KF_SENTINEL_DIR"] = root
+    os.environ["KF_SENTINEL_PERIOD"] = "1"
+    os.environ["KF_SENTINEL_WINDOW"] = str(window)
+
+    B, S = 2, 32
+    cfg = TransformerConfig(vocab_size=512, d_model=128, n_layers=2,
+                            n_heads=4, d_ff=512, max_seq=64)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    grad_fn = jax.jit(jax.grad(lambda p, ids, tg: model.loss(p, (ids, tg))))
+    warm = jnp.zeros((B, S), jnp.int32)
+    jax.block_until_ready(grad_fn(params, warm, warm))
+
+    workers = PeerList.parse(",".join(f"127.0.0.1:{24700 + i}"
+                                      for i in range(3)))
+    runners = PeerList.parse("127.0.0.1:24799")
+    cluster = Cluster(runners, workers)
+    peers = [Peer(Config(self_id=w, cluster=cluster)) for w in workers]
+    for p in peers:
+        p.config.strategy = parse_strategy("STAR")
+        p.start()
+
+    grad_buf = np.ones(50_000, np.float32)  # ~200 KiB, the wire payload
+    rngs = [np.random.default_rng(r) for r in range(3)]
+
+    def run_world(fns, timeout=120.0):
+        outs, errs = [None] * len(fns), []
+
+        def wrap(i, f):
+            try:
+                outs[i] = f()
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=wrap, args=(i, f), daemon=True)
+              for i, f in enumerate(fns)]
+        for t in ts:
+            t.start()
+        deadline = _time.monotonic() + timeout
+        for t in ts:
+            t.join(max(0.0, deadline - _time.monotonic()))
+        if errs:
+            raise errs[0]
+        if any(t.is_alive() for t in ts):
+            raise TimeoutError("sentinel world hung")
+        return outs
+
+    # paced steps: every step runs at least `pace_s` (an input-bound
+    # training loop's fixed cadence).  The clean baseline is then flat
+    # to scheduler jitter — the detector must judge the PLANTED fault,
+    # not the host CPU's frequency-boost decay, which drifts raw 27 ms
+    # compute walls by ~9% over the run and is a genuine (but
+    # machine-local) median shift
+    pace_s = 0.05
+
+    def rank_step(p, rank):
+        t0 = _time.perf_counter()
+        with timeline.span("input", "batch.next", rank=rank):
+            ids = rngs[rank].integers(0, cfg.vocab_size,
+                                      (B, S)).astype(np.int32)
+        g = grad_fn(params, jnp.asarray(ids), jnp.asarray(ids))
+        jax.block_until_ready(g)
+        out = p.engine().all_reduce(grad_buf, op="sum")
+        assert float(out[0]) == 3.0
+        pad = pace_s - (_time.perf_counter() - t0)
+        if pad > 0:
+            _time.sleep(pad)
+        return _time.perf_counter() - t0
+
+    clock = [1000.0]  # the aggregator's fake clock: 1 tick = 1 step
+    agg = ClusterAggregator(stale_after=3600.0, time_fn=lambda: clock[0])
+    sentinel = Sentinel.from_env()
+    agg.attach_sentinel(sentinel)
+
+    def ingest(rank, step, wall_s, events):
+        # bounded event window per snapshot (last two steps), like the
+        # production RankReporter — cumulative lists would grow the
+        # per-sample xray cost quadratically over the run
+        agg.ingest(make_snapshot(
+            rank=rank, pid=os.getpid(), wall=clock[0], step=step,
+            step_time_s=wall_s, counters={}, gauges={}, latency={},
+            events=[e for e in events
+                    if e["rank"] == rank and e["kind"] in REPORT_KINDS
+                    and e.get("step", -1) >= step - 1],
+            net={}, strategy="STAR"))
+
+    # unsampled warm steps: the measured baseline must not include the
+    # first-steps drift (cache/thermal settling would read as a shift)
+    for _ in range(4):
+        run_world([lambda p=p, r=r: rank_step(p, r)
+                   for r, p in enumerate(peers)])
+    timeline.reset()
+    onset_records = None
+    false_positive = False
+    # GC pauses land inside the timed rank threads and read as step-time
+    # jitter on the clean baseline; the detector must judge the planted
+    # fault, not the host interpreter's collector
+    gc.disable()
+    try:
+        for i in range(clean_steps + chaos_steps):
+            if i == clean_steps:
+                # the sentinel must be clean BEFORE the fault arms
+                false_positive = bool(sentinel.alerts_view()["alerts"])
+                onset_records = sentinel.alerts_view()["records"]
+            for r in range(3):
+                # the production step announcement: stamps the timeline
+                # step AND drives each rank's after_step arming clock
+                chaos.note_step(r, i)
+            walls = run_world([lambda p=p, r=r: rank_step(p, r)
+                               for r, p in enumerate(peers)])
+            events = timeline.snapshot()
+            for r in range(3):
+                ingest(r, i, walls[r], events)
+            # advance the fake clock past the sample period and flush:
+            # the re-ingest of rank 0's (identical) snapshot triggers the
+            # sentinel with all three rank rows fresh for step i
+            clock[0] += 1.0
+            ingest(0, i, walls[0], events)
+    finally:
+        gc.enable()
+        for p in peers:
+            p.close()
+        os.environ.pop("KF_CHAOS_SPEC", None)
+
+    av = sentinel.alerts_view()
+    fired = [a for a in av["alerts"] if a["rule"] == "regress:step_time_s"]
+    incident = {}
+    if fired and fired[0].get("incident"):
+        with open(fired[0]["incident"]) as f:
+            incident = json.load(f)
+    detection_latency = (incident.get("history_n", 10 ** 9)
+                         - (onset_records or 0))
+    # the offline replay: kfhist --verdict --upto <history_n> over the
+    # durable history, window/threshold from the SAME env knobs
+    offline = kfhist.verdict_from_dir(root, upto=incident.get("history_n"))
+    counters = REGISTRY.snapshot()
+    culprit = ((incident.get("xray") or {}).get("verdict") or {}
+               ).get("culprit") or {}
+    checks = {
+        "no_false_positive_in_clean_phase": not false_positive,
+        "changepoint_alert_fired_online": bool(fired),
+        "alert_within_k_windows_of_onset":
+            detection_latency <= k_windows * window,
+        "incident_flight_record_written": bool(incident),
+        "incident_names_planted_rank1_edge":
+            culprit.get("slowest_rank") == 1,
+        "offline_verdict_identical_to_incident":
+            bool(incident) and json.loads(json.dumps(
+                offline["verdicts"])) == incident.get("verdicts"),
+        "offline_step_time_shifted_up":
+            (offline["verdicts"].get("step_time_s") or {}).get("shifted")
+            is True
+            and offline["verdicts"]["step_time_s"]["direction"] == "up",
+        "alert_counter_ticked": any(
+            k.startswith("kf_alerts_total") and "regress:step_time_s" in k
+            and v >= 1 for k, v in counters.items()),
+        "evidence_bounded": len(incident.get("timeline_tail", [])) <= 256,
+    }
+    shutil.rmtree(root, ignore_errors=True)
+    os.environ.pop("KF_SENTINEL_DIR", None)
+    v = (incident.get("verdicts") or {}).get("step_time_s") or {}
+    return {
+        "metric": "sentinel_online_offline_verdict_gate",
+        "value": round(float(v.get("score", 0.0)), 2),
+        "unit": "mad-score",
+        "vs_baseline": 1.0 if all(checks.values()) else 0.0,
+        "vs_baseline_meaning": ("1.0 = every sentinel check passed "
+                                "(clean baseline silent, online alert "
+                                "within K windows, incident names the "
+                                "planted edge, kfhist replay verdict "
+                                "identical)"),
+        "platform": "cpu-hostplane",
+        "n_devices": 3,
+        "model": (f"3 ranks, GPT d{cfg.d_model}xL{cfg.n_layers} fwd+bwd "
+                  f"per step + 200 KiB allreduce; {wire_ms} ms chaos "
+                  f"delay armed mid-run on the 0<->1 link after "
+                  f"{clean_steps} clean steps"),
+        "checks": checks,
+        "rows": {
+            "detection": {
+                "clean_steps": clean_steps,
+                "chaos_steps": chaos_steps,
+                "window": window,
+                "k_windows_budget": k_windows,
+                "onset_records": onset_records,
+                "alert_history_n": incident.get("history_n"),
+                "detection_latency_samples": (
+                    detection_latency if incident else None),
+                "rule": fired[0]["rule"] if fired else None,
+                "shift_score": round(float(v.get("score", 0.0)), 2),
+                "base_median_s": v.get("base_median"),
+                "recent_median_s": v.get("recent_median"),
+            },
+            "incident": {
+                "culprit": culprit or None,
+                "timeline_tail_events": len(
+                    incident.get("timeline_tail", [])),
+                "history_records": len(incident.get("history", [])),
+                "active_alerts": (incident.get("config") or {}
+                                  ).get("active_alerts"),
+            },
+        },
+    }
+
+
 PAYLOADS = {
     "resnet": payload_resnet,
     "kernels": payload_kernels,
@@ -2725,6 +2996,7 @@ PAYLOADS = {
     "xray": payload_xray,
     "pp": payload_pp,
     "persist": payload_persist,
+    "sentinel": payload_sentinel,
 }
 
 
@@ -2784,6 +3056,12 @@ def main() -> None:
                         "world cold restarts from the durable manifest "
                         "plane, final params bitwise vs fixed-world "
                         "replay (host-plane CPU; tunnel-proof)")
+    p.add_argument("--sentinel", action="store_true",
+                   help="kf-sentinel: online step-time changepoint alert "
+                        "under a mid-run chaos delay, incident flight "
+                        "record naming the planted edge, and the kfhist "
+                        "offline replay reproducing the identical "
+                        "verdict (host-plane CPU; tunnel-proof)")
     p.add_argument("--pallas", action="store_true",
                    help="Pallas ICI ring collectives: interpret-kernel "
                         "bitwise A/B vs the lax references + traced-"
@@ -2808,6 +3086,7 @@ def main() -> None:
              else "xray" if args.xray
              else "pp" if args.pp
              else "persist" if args.persist
+             else "sentinel" if args.sentinel
              else "pallas" if args.pallas else "resnet")
     pallas_tpu = False
     if which == "pallas" and not args.cpu and not args.cpu_mesh:
@@ -2845,7 +3124,7 @@ def main() -> None:
     pre_err = backend_preflight(
         cpu=args.cpu or bool(args.cpu_mesh)
         or which in ("multislice", "adapt", "overlap", "serve", "xray",
-                     "pp", "persist")
+                     "pp", "persist", "sentinel")
         or pallas_tpu)
     if pre_err is None:
         out = run_guarded(fwd, timeout=args.timeout)
@@ -2913,6 +3192,8 @@ def main() -> None:
                    "pp_cpu_mesh"),
             "persist": ("persist_preemption_goodput_fraction", "fraction",
                         "persist_cpu_mesh"),
+            "sentinel": ("sentinel_online_offline_verdict_gate",
+                         "mad-score", "sentinel_cpu_mesh"),
         }
         metric, unit, section = payload_info[which]
         out = {

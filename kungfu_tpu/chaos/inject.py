@@ -65,6 +65,11 @@ class ChaosController:
         self._sends = 0
         self._recvs = 0
         self._fetches = 0
+        #: the last step the training loop announced (note_step) — the
+        #: arming clock for ``delay:after_step=N`` mid-run onsets; None
+        #: until the first announcement, so un-announced processes never
+        #: arm a gated clause by accident
+        self._step: Optional[int] = None
         self._fanout_dropped: dict = {}
         #: clause-index -> count of events MATCHING that clause's filters
         #: (``delay:every=K`` strides over matching events; striding the
@@ -104,7 +109,9 @@ class ChaosController:
 
     def on_step(self, step: int) -> None:
         """Training loop announced step ``step`` (``die[_slice]:step=N``,
-        ``preempt:all[,step=N]``)."""
+        ``preempt:all[,step=N]``, and the ``delay:after_step=N`` arming
+        clock)."""
+        self._step = step
         for c in self._clauses:
             if c.kind == "die" and c.get("step") == step:
                 self._die(c, f"step={step}")
@@ -195,6 +202,11 @@ class ChaosController:
 
     def _maybe_delay(self, ci: int, c: Clause, other_rank: int) -> None:
         if c.get("peer") is not None and c.get("peer") != other_rank:
+            return
+        after = c.get("after_step")
+        if after is not None and (self._step is None or self._step < after):
+            # gated BEFORE the match count: an every=K stride over an
+            # after_step clause strides armed-phase events only
             return
         with self._lock:
             n = self._matched[ci] = self._matched.get(ci, 0) + 1

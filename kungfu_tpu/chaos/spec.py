@@ -53,6 +53,12 @@ without scoping a clause applies everywhere):
     must look slow to the MST re-carve, not just to the data path —
     and ``on=serve`` the serving request path (the worker straggles
     ``ms`` before admitting each matching request, kf-serve).
+    ``after_step=N`` keeps the clause INERT until the training loop
+    announces step N via :func:`kungfu_tpu.chaos.note_step` — a
+    mid-run onset, so a regression experiment gets a clean baseline
+    phase and a planted degradation from one deterministic step
+    boundary (the kf-sentinel changepoint gate).  Matching-event
+    counts (``every``) start at the onset, not at process start.
 ``preempt``
     Whole-job preemption: EVERY rank dies at the same boundary — the
     spot/maintenance eviction that takes the entire capacity at once
@@ -95,7 +101,7 @@ KINDS = ("die", "die_slice", "preempt", "reset", "delay", "drop_fanout",
 
 _INT_PARAMS = {
     "rank", "step", "coll", "send", "peer", "every", "count", "after",
-    "ms", "jitter", "slice", "rps",
+    "ms", "jitter", "slice", "rps", "after_step",
 }
 _STR_PARAMS = {"mode", "host", "on"}
 
@@ -104,7 +110,7 @@ _ALLOWED = {
     "die_slice": {"slice", "step", "coll", "mode", "rps"},
     "preempt": {"all", "step", "mode"},
     "reset": {"rank", "send", "peer"},
-    "delay": {"rank", "ms", "jitter", "peer", "every", "on"},
+    "delay": {"rank", "ms", "jitter", "peer", "every", "on", "after_step"},
     "drop_fanout": {"host", "count"},
     "drop_request": {"rank", "count", "every"},
     "config_down": {"rank", "after", "count"},

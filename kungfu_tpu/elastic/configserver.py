@@ -17,6 +17,9 @@ shrink:
 * ``POST /push``    → rank snapshot / control-event intake
 * ``GET  /cluster`` → the rolling cluster view (JSON; ``kftop`` renders it)
 * ``GET  /metrics`` → cluster-plane Prometheus text
+* ``GET  /alerts``  → the kf-sentinel alert state (active rules, fired
+  alerts, detector verdicts) — 404 unless a Sentinel is attached to the
+  mounted aggregator (``kfrun -sentinel`` / ``KF_SENTINEL_DIR``)
 """
 
 from __future__ import annotations
@@ -73,6 +76,15 @@ class ConfigServer:
                         return
                     view = agg.cluster_view(srv._cluster_info())
                     self._reply(200, json.dumps(view).encode())
+                    return
+                if self.path.startswith("/alerts"):
+                    agg = srv.aggregator
+                    sentinel = getattr(agg, "_sentinel", None)
+                    if agg is None or sentinel is None:
+                        self._reply(404, b'{"error": "no sentinel"}')
+                        return
+                    self._reply(200,
+                                json.dumps(sentinel.alerts_view()).encode())
                     return
                 if self.path.startswith("/metrics"):
                     agg = srv.aggregator
@@ -196,6 +208,14 @@ def main(argv=None) -> int:
         from kungfu_tpu.monitor.aggregator import ClusterAggregator
 
         aggregator = ClusterAggregator()
+        # KF_SENTINEL_DIR in the environment attaches the judging
+        # plane (history + detectors + /alerts); unset = no sentinel,
+        # byte-identical aggregator (monitor/sentinel.py cost contract)
+        from kungfu_tpu.monitor.sentinel import Sentinel
+
+        sentinel = Sentinel.from_env()
+        if sentinel is not None:
+            aggregator.attach_sentinel(sentinel)
     srv = ConfigServer(port=ns.port, host=ns.host,
                        aggregator=aggregator).start()
     _log.info("config server listening on %s:%d", ns.host, ns.port)

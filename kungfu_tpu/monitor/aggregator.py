@@ -119,6 +119,10 @@ VIEW_FIELDS = frozenset({
     # skew-row subfields (monitor/skew.py row dicts)
     "op", "tag", "slowest_rank", "slowest_s", "fastest_rank",
     "fastest_s", "skew_s", "total_s",
+    # kf-sentinel section (present ONLY when a Sentinel is attached —
+    # the disabled plane is byte-identical to the pre-sentinel view):
+    # active rules + fired-alert log + live detector verdicts
+    "alerts", "active", "rule", "evidence", "incident", "verdicts",
     # serving summary (kf-serve; None on deployments with no serve
     # metrics): cluster-wide sums of the per-rank serve gauges/counters
     # plus window-mean latencies from the pushed histogram deltas
@@ -273,6 +277,29 @@ class ClusterAggregator:
         self._events: Dict[int, deque] = {}      # rank -> recent events
         self._max_events = max_events_per_rank
         self._controls: deque = deque(maxlen=max_controls)
+        # kf-sentinel judging plane (attach_sentinel); None = off, and
+        # every sentinel touch point below is a None check so the
+        # disabled aggregator is byte-identical to the pre-sentinel one
+        self._sentinel = None
+
+    def attach_sentinel(self, sentinel) -> None:
+        """Attach the kf-sentinel judging plane (duck-typed — this
+        module must not import :mod:`~kungfu_tpu.monitor.sentinel`,
+        which imports it back).  The sentinel samples after ingests and
+        contributes the ``alerts`` section of ``/cluster``."""
+        self._sentinel = sentinel
+
+    def _notify_sentinel(self) -> None:
+        """Post-ingest sentinel hook, OUTSIDE the aggregator lock (the
+        sentinel calls back into ``cluster_view``) and guarded — the
+        judging plane must never take the ingest path down."""
+        s = self._sentinel
+        if s is None:
+            return
+        try:
+            s.on_ingest(self)
+        except Exception as e:  # noqa: BLE001 - monitoring must not raise
+            _log.debug("sentinel sample failed: %s", e)
 
     # -- ingest ----------------------------------------------------------
     def ingest(self, obj: dict) -> None:
@@ -296,6 +323,7 @@ class ClusterAggregator:
                     self._seen.pop(r, None)
             REGISTRY.counter("kf_cluster_control_events_total",
                              what=str(obj.get("kind"))).inc()
+            self._notify_sentinel()
             return
         if not obj.get("kfmon"):
             raise ValueError("push payload is neither snapshot nor control")
@@ -319,6 +347,7 @@ class ClusterAggregator:
                 if ev.get("rank") is None:
                     ev = dict(ev, rank=rank)
                 win.append(ev)
+        self._notify_sentinel()
 
     # -- views -----------------------------------------------------------
     @staticmethod
@@ -485,7 +514,7 @@ class ClusterAggregator:
             health["quorum_margin"] = size - (size // 2 + 1)
         if controls:
             health["last_control"] = controls[-1]
-        return {
+        view = {
             "kfmon": WIRE_VERSION,
             "wall": now,
             "stale_after_s": self.stale_after,
@@ -501,6 +530,13 @@ class ClusterAggregator:
             "straggler": skewlib.straggler_verdict(events),
             "controls": controls[-top:],
         }
+        # the alerts section exists ONLY when a sentinel is attached:
+        # with the plane off, /cluster is byte-identical to the
+        # pre-sentinel view (asserted in tests — the cost contract)
+        s = self._sentinel
+        if s is not None:
+            view["alerts"] = s.alerts_view()
+        return view
 
     def render_prometheus(self, cluster_info: Optional[dict] = None,
                           top: int = 20) -> str:
@@ -569,6 +605,14 @@ class ClusterAggregator:
                         f'kf_cluster_step_phase_seconds'
                         f'{{phase="{_esc_label(ph)}"}} '
                         f'{xr["phase_seconds"][ph]:.6g}')
+        if view.get("alerts"):
+            lines += [
+                "# HELP kf_cluster_alerts_active kf-sentinel rules "
+                "currently firing",
+                "# TYPE kf_cluster_alerts_active gauge",
+                f"kf_cluster_alerts_active "
+                f"{len(view['alerts']['active'])}",
+            ]
         version = (view["cluster"] or {}).get("version")
         if version is not None:
             lines += [
@@ -654,7 +698,8 @@ class RankReporter:
                  strategy_fn: Optional[Callable[[], str]] = None,
                  net_totals_fn: Optional[Callable[[], Dict[str, int]]] = None,
                  events_fn: Optional[Callable[[], List[dict]]] = None,
-                 slice_id=_SLICE_FROM_ENV):
+                 slice_id=_SLICE_FROM_ENV,
+                 pre_snapshot_fn: Optional[Callable[[], None]] = None):
         self.rank = rank
         # slice identity, like the rank, is the STABLE bootstrap value
         # (a slice-shrink renumbers live topologies but must not alias
@@ -684,6 +729,10 @@ class RankReporter:
         self._strategy_fn = strategy_fn
         self._net_totals_fn = net_totals_fn
         self._events_fn = events_fn
+        # refresh hook run before each snapshot build: gauges whose
+        # source is a query, not an instrumented code path (device
+        # memory stats, ...) get one cheap poll per push
+        self._pre_snapshot_fn = pre_snapshot_fn
         self._cursor = 0           # timeline.events_tail cursor
         self._hist_prev: Dict[str, tuple] = {}
         # a failed push must not eat its window: the cursor and delta
@@ -762,6 +811,13 @@ class RankReporter:
         """Build (but do not send) one snapshot — also the test surface."""
         from kungfu_tpu.monitor import timeline
 
+        if self._pre_snapshot_fn is not None:
+            # guarded like the other user callbacks: a raising gauge
+            # poll must not cost this window its events/deltas
+            try:
+                self._pre_snapshot_fn()
+            except Exception as e:  # noqa: BLE001 - monitoring must not raise
+                _log.debug("pre-snapshot hook failed: %s", e)
         now = time.time()
         step = timeline.current_step()
         counters, gauges, latency = self._split_registry()

@@ -136,6 +136,37 @@ def _serving_lines(view: dict) -> List[str]:
     return lines
 
 
+def _device_mem(row: dict):
+    """A rank's ``(in_use, limit)`` device-memory bytes from the labeled
+    gauge pair (direct key reads — ``sum_metric`` would add the two
+    variants together, which is exactly wrong here)."""
+    gauges = field(row, "gauges") or {}
+    return (gauges.get('kf_device_memory_bytes{kind="in_use"}', 0.0),
+            gauges.get('kf_device_memory_bytes{kind="limit"}', 0.0))
+
+
+def _alert_lines(view: dict) -> List[str]:
+    """The ALERTS section (kf-sentinel; present only when a Sentinel is
+    attached to the aggregator — docs/sentinel.md)."""
+    al = field(view, "alerts")
+    if not al:
+        return []
+    active = field(al, "active") or []
+    fired = field(al, "alerts") or []
+    lines = ["", "== ALERTS (kf-sentinel online detectors; "
+                 "docs/sentinel.md)"]
+    if active:
+        lines.append("  !! ACTIVE: " + " | ".join(active))
+    else:
+        lines.append("  (no rule firing)")
+    for a in fired[-5:]:
+        inc = field(a, "incident")
+        lines.append(
+            f"  fired: {field(a, 'rule')}"
+            + (f" -> {inc}" if inc else ""))
+    return lines
+
+
 def _fmt_flops(v) -> str:
     if not v:
         return "-"
@@ -240,11 +271,13 @@ def render_view(view: dict, top: int = 10) -> str:
             f"{field(last, 'attrs') or ''}")
     lines.append("")
     show_slice = any(field(r, "slice") is not None for r in rows)
+    show_mem = any(_device_mem(r)[0] for r in rows)
     hdr = (f"{'rank':>4} " + (f"{'slice':>5} " if show_slice else "")
            + f"{'state':<6} {'age':>7} {'step':>7} "
            f"{'step-time':>10} {'coll-lat':>9} {'retries':>8} "
            f"{'faults':>7} {'chaos':>6} "
-           f"{'egress':>9} {'ingress':>9}  strategy")
+           + (f"{'dev-mem':>15} " if show_mem else "")
+           + f"{'egress':>9} {'ingress':>9}  strategy")
     lines.append(hdr)
     for row in rows:
         state = "STALE" if field(row, "stale") else "ok"
@@ -253,6 +286,12 @@ def render_view(view: dict, top: int = 10) -> str:
                   + _counter(row, "kf_detector_down_total"))
         lat = _window_latency_s(row)
         sl = field(row, "slice")
+        mem_txt = ""
+        if show_mem:
+            in_use, limit = _device_mem(row)
+            cell = (f"{_fmt_bytes(int(in_use))}/{_fmt_bytes(int(limit))}"
+                    if in_use else "-")
+            mem_txt = f"{cell:>15} "
         lines.append(
             f"{field(row, 'rank'):>4} "
             + (f"{sl if sl is not None else '-':>5} " if show_slice else "")
@@ -264,7 +303,8 @@ def render_view(view: dict, top: int = 10) -> str:
             f"{_counter(row, 'kf_engine_retries_total'):>8} "
             f"{faults:>7} "
             f"{_counter(row, 'kf_chaos_injections_total'):>6} "
-            f"{_fmt_bytes(net.get('egress_bytes')):>9} "
+            + mem_txt
+            + f"{_fmt_bytes(net.get('egress_bytes')):>9} "
             f"{_fmt_bytes(net.get('ingress_bytes')):>9}  "
             f"{field(row, 'strategy') or '-'}")
     if not rows:
@@ -316,6 +356,7 @@ def render_view(view: dict, top: int = 10) -> str:
             + " (durable plane wedged? a preemption now replays all of "
               "that; docs/persistence.md)")
     lines.extend(_serving_lines(view))
+    lines.extend(_alert_lines(view))
     return "\n".join(lines) + "\n"
 
 
@@ -325,8 +366,18 @@ def self_check() -> int:
     :func:`make_snapshot`, ingest them into a live aggregator, serialize
     the view through JSON, and re-render — proving the push wire format,
     the view schema, and the renderer agree (wired into check.sh)."""
+    import tempfile
+
+    from kungfu_tpu.monitor.sentinel import Sentinel
+
     clock = [1000.0]
     agg = ClusterAggregator(stale_after=1.0, time_fn=lambda: clock[0])
+    # a sentinel with a step-time ceiling the canned 0.25 s step busts:
+    # proves ingest -> sample -> alert -> /cluster alerts section ->
+    # ALERTS rendering, end to end on the same canned payload
+    tmp = tempfile.TemporaryDirectory(prefix="kftop-selfcheck-")
+    agg.attach_sentinel(Sentinel(tmp.name, period_s=0.0,
+                                 step_ceiling_s=0.1))
 
     def span(rank, dur, tag):
         return {"ts": 999.0, "rank": rank, "step": 3, "kind": "collective",
@@ -350,6 +401,9 @@ def self_check() -> int:
             gauges["kf_ckpt_age_seconds"] = 95.0
             gauges["kf_ckpt_period_seconds"] = 30.0
             gauges["kf_ckpt_bytes_total"] = 2048.0
+        if rank == 1:  # device-memory gauges prove the dev-mem column
+            gauges['kf_device_memory_bytes{kind="in_use"}'] = float(2 << 30)
+            gauges['kf_device_memory_bytes{kind="limit"}'] = float(8 << 30)
         if rank == 1:  # one serving rank proves the serving rollup
             counters['kf_serve_requests_total{what="complete"}'] = 7
             counters['kf_serve_requests_total{what="replay"}'] = 2
@@ -415,12 +469,22 @@ def self_check() -> int:
           and field(xr, "phase_seconds") == {"compute": 0.2,
                                              "comm_exposed": 0.05}
           and field(xr, "dropped_events") == {"2": 5})
+    # kf-sentinel: the busted step-time ceiling must be an active alert
+    # in the view, and the fired alert must carry its incident path
+    al = field(view, "alerts")
+    ok = (ok and al is not None
+          and "watermark:step_time" in (field(al, "active") or [])
+          and (field(al, "alerts") or [])
+          and field(field(al, "alerts")[0], "incident"))
     text = render_view(view)
     ok = (ok and "STALE" in text and "all_reduce/grad3" in text
           and "coll-lat" in text and "SLICE LOSS" in text
           and "== serving" in text and "replay" in text
           and "== XRAY" in text and "TRACE LOSS" in text
-          and "rank 2: 5" in text and "CKPT STALE" in text)
+          and "rank 2: 5" in text and "CKPT STALE" in text
+          and "== ALERTS" in text and "watermark:step_time" in text
+          and "dev-mem" in text and "2.0GiB/8.0GiB" in text)
+    tmp.cleanup()
     if not ok:
         print("kftop: self-check FAILED (view schema/round-trip mismatch)",
               file=sys.stderr)

@@ -205,3 +205,44 @@ def monitoring_period_from_env() -> float:
         return float(os.environ.get(MONITORING_PERIOD, DEFAULT_PERIOD_S))
     except ValueError:
         return DEFAULT_PERIOD_S
+
+
+def publish_device_memory() -> bool:
+    """Poll the local accelerators' allocator stats into the unified
+    registry: ``kf_device_memory_bytes{kind="in_use"|"limit"}`` summed
+    over local devices.  The cluster snapshot then carries both gauges
+    to kftop's dev-mem column and the sentinel's history — HBM pressure
+    becomes a recorded series, not a post-OOM guess.
+
+    None-safe by contract: backends without ``memory_stats`` (CPU) or a
+    jax that cannot import make this a no-op returning ``False`` — it
+    is wired as the RankReporter's ``pre_snapshot_fn``, where a raise
+    would cost the snapshot its event window."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 - monitoring must not raise
+        return False
+    in_use = limit = 0
+    found = False
+    for d in devices:
+        stats_fn = getattr(d, "memory_stats", None)
+        if stats_fn is None:
+            continue
+        try:
+            stats = stats_fn() or {}
+        except Exception:  # noqa: BLE001 - backend quirk, not fatal
+            continue
+        if "bytes_in_use" not in stats:
+            continue
+        found = True
+        in_use += int(stats.get("bytes_in_use", 0))
+        limit += int(stats.get("bytes_limit",
+                               stats.get("bytes_reservable_limit", 0)))
+    if not found:
+        return False
+    REGISTRY.gauge("kf_device_memory_bytes", kind="in_use").set(in_use)
+    if limit:
+        REGISTRY.gauge("kf_device_memory_bytes", kind="limit").set(limit)
+    return True

@@ -253,6 +253,20 @@ METRIC_HELP: Dict[str, str] = {
         "aggregate ingress bytes (mirrored from NetMonitor)",
     "kf_cluster_control_events_total":
         "control events (shrink/resize/...) received by the aggregator",
+    "kf_alerts_total":
+        "kf-sentinel rule firings by rule name (changepoint regressions, "
+        "SLO burn rates, watermarks); each firing cuts an incident "
+        "flight record under KF_SENTINEL_DIR",
+    "kf_jit_compiles_total":
+        "XLA compilations observed through the jax monitoring hook — a "
+        "nonzero steady-state rate means a shape/dtype is retriggering "
+        "jit (the dynamic twin of the static recompile-hazard rule)",
+    "kf_jit_compile_seconds":
+        "wall seconds per observed XLA compilation (jax monitoring "
+        "hook; absent on jax versions without it)",
+    "kf_device_memory_bytes":
+        "accelerator memory by kind (in_use / limit) from "
+        "device.memory_stats(); absent on backends without stats (CPU)",
 }
 
 

@@ -125,6 +125,13 @@ EVENT_KINDS = frozenset({
                    # write and the "restore" mark of a cold restart —
                    # rare boundary events, so always recordable; the
                    # always-on surfaces are the kf_ckpt_* gauges
+    "alert",       # kf-sentinel rule firing (monitor/sentinel.py): a
+                   # detector/burn-rate/watermark rule crossed its
+                   # threshold and an incident flight record was cut.
+                   # A counted kind labeled by RULE name: every firing
+                   # ticks kf_alerts_total{rule=...} even with tracing
+                   # off — an alert that /metrics cannot count did not
+                   # happen
     "step",        # training-step mark
     "mark",        # generic one-shot annotation
 })
@@ -142,8 +149,13 @@ _COUNTED_KINDS = {
     "slice": "kf_slice_events_total",
     "swap": "kf_strategy_swaps_total",
     "request": "kf_serve_requests_total",
+    "alert": "kf_alerts_total",
 }
-_LABELED_KINDS = ("chaos", "shrink", "slice", "swap", "request")
+_LABELED_KINDS = ("chaos", "shrink", "slice", "swap", "request", "alert")
+#: label KEY per labeled kind; default "what".  Alerts label by "rule"
+#: so the counter reads kf_alerts_total{rule="regress:step_time_s"} —
+#: the name SLO dashboards group by.
+_LABEL_KEYS = {"alert": "rule"}
 
 _lock = threading.Lock()
 _ring: collections.deque = collections.deque()
@@ -325,7 +337,8 @@ def _count(kind: str, name: str) -> None:
     if metric is None:
         return
     if kind in _LABELED_KINDS:
-        REGISTRY.counter(metric, what=name).inc()
+        REGISTRY.counter(metric,
+                         **{_LABEL_KEYS.get(kind, "what"): name}).inc()
     else:
         REGISTRY.counter(metric).inc()
 

@@ -180,7 +180,16 @@ class Peer:
                     rank = self.rank()
                 if rank is not None:
                     from kungfu_tpu.monitor.aggregator import RankReporter
+                    from kungfu_tpu.monitor.metrics import \
+                        publish_device_memory
+                    from kungfu_tpu.utils.jaxcompat import \
+                        install_compile_metrics
 
+                    # XLA compiles become registry series the snapshot
+                    # carries (kf_jit_compiles_total — the sentinel's
+                    # recompile-steady feedstock); no-op on jax
+                    # versions without the monitoring hook
+                    install_compile_metrics()
                     # slice identity rides the same stable bootstrap
                     # frame as the rank: kftop's per-slice grouping
                     # must not re-home a row when a shrink renumbers
@@ -193,6 +202,9 @@ class Peer:
                         net_totals_fn=(self._net_totals
                                        if monitor is not None else None),
                         slice_id=slice_id,
+                        # HBM gauges refresh once per push (None-safe:
+                        # CPU backends simply publish nothing)
+                        pre_snapshot_fn=publish_device_memory,
                     ).start()
             log_event("peer-started")
 

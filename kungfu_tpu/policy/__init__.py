@@ -32,4 +32,8 @@ def __getattr__(name):
         from kungfu_tpu.policy import serve as _serve
 
         return getattr(_serve, name)
+    if name == "sentinel_signals":
+        from kungfu_tpu.policy.sentinel import sentinel_signals
+
+        return sentinel_signals
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

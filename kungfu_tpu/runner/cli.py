@@ -129,6 +129,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "collective spans and byte rates.  View with "
                         "scripts/kftop; starts an ephemeral builtin config "
                         "server when none is configured")
+    p.add_argument("-sentinel", dest="sentinel", default="",
+                   help="kf-sentinel judging plane: durable metrics "
+                        "history + online regression/SLO-burn detectors "
+                        "+ incident flight records under DIR "
+                        "(KF_SENTINEL_DIR).  Implies -monitor; alerts at "
+                        "/alerts and in kftop; replay offline with "
+                        "scripts/kfhist --dir DIR --verdict")
     p.add_argument("-monitor-interval", dest="monitor_interval", type=float,
                    default=0.0,
                    help="snapshot push period seconds "
@@ -292,6 +299,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
     cluster = build_cluster(ns)
 
+    if ns.sentinel:
+        # the judge needs the aggregator it attaches to
+        ns.monitor = True
+
     config_server_url = ns.config_server
     builtin = None
     if ns.builtin_config_port or (ns.monitor and not config_server_url):
@@ -315,6 +326,22 @@ def main(argv: Optional[List[str]] = None) -> int:
             aggregator = ClusterAggregator(
                 stale_after=(STALE_PERIODS * ns.monitor_interval
                              if ns.monitor_interval > 0 else None))
+            if ns.sentinel:
+                import os as _os
+
+                from kungfu_tpu.monitor.sentinel import Sentinel
+                from kungfu_tpu.utils.envs import SENTINEL_DIR
+
+                root = _os.path.abspath(ns.sentinel)
+                _os.makedirs(root, exist_ok=True)
+                # publish the root so Sentinel.from_env picks up the
+                # whole sentinel knob family (utils/envs.py) from the
+                # environment
+                _os.environ[SENTINEL_DIR] = root
+                aggregator.attach_sentinel(Sentinel.from_env())
+                _log.info("sentinel history -> %s "
+                          "(replay: scripts/kfhist --dir %s --verdict)",
+                          root, root)
         # -monitor with no config server still needs a push target: an
         # ephemeral builtin server carries the aggregator (port 0 = OS-
         # assigned, reflected in builtin.port)

@@ -93,6 +93,48 @@ class SLOTargets:
         )
 
 
+@dataclass(frozen=True)
+class SLORules:
+    """Declarative burn-rate rules the kf-sentinel evaluates online.
+
+    Budgets are in MILLISECONDS because the sentinel judges the
+    aggregator rollup series (``ttft_ms``/``e2e_ms``, already ms), not
+    the local histograms.  The two-window test
+    (:func:`kungfu_tpu.monitor.detect.slo_burn`) alerts only when BOTH
+    the short window (fast burn, happening now) and the long window
+    (sustained burn, not one blip) exceed their violation fractions —
+    docs/sentinel.md has the rule table.
+
+    monitor/sentinel.py reads the same env tokens from ``os.environ``
+    directly (mirror constants — kfhist's stubbed context never imports
+    this jax-adjacent package); tests pin both sides to these exact
+    defaults so the contract cannot drift.
+    """
+
+    ttft_budget_ms: float = DEFAULT_TTFT_MS
+    e2e_budget_ms: float = DEFAULT_E2E_MS
+    short_window: int = 6
+    long_window: int = 24
+    short_frac: float = 0.5
+    long_frac: float = 0.25
+
+    @classmethod
+    def from_env(cls) -> "SLORules":
+        return cls(
+            ttft_budget_ms=envs.parse_float_env(envs.SERVE_SLO_TTFT_MS,
+                                                DEFAULT_TTFT_MS),
+            e2e_budget_ms=envs.parse_float_env(envs.SERVE_SLO_E2E_MS,
+                                               DEFAULT_E2E_MS),
+            short_window=envs.parse_int_env(envs.SENTINEL_SLO_SHORT, 6),
+            long_window=envs.parse_int_env(envs.SENTINEL_SLO_LONG, 24),
+        )
+
+    def budgets(self) -> Dict[str, float]:
+        """Rollup-series name -> ms budget, the shape the sentinel's
+        rule loop iterates."""
+        return {"ttft_ms": self.ttft_budget_ms, "e2e_ms": self.e2e_budget_ms}
+
+
 def slo_snapshot() -> Dict[str, Dict[str, float]]:
     """Current percentile summaries of the three serving histograms
     (local process view; the cross-rank view is kftop's)."""

@@ -222,6 +222,43 @@ Persistence envs (the durable state plane,
                                -restore-from``)
 =============================  ================================================
 
+Sentinel envs (the kf-sentinel judging plane,
+:mod:`kungfu_tpu.monitor.sentinel`; see docs/sentinel.md — the sentinel
+and kfhist read these tokens from ``os.environ`` directly via mirror
+constants, like timeline.py's CAP_ENV, so the stubbed kfhist/CI context
+never imports this jax-adjacent module; :func:`sentinel_knobs` below
+pins the defaults both sides must agree on):
+
+=============================  ================================================
+``KF_SENTINEL_DIR``            durable metrics-history root; unset = the
+                               whole sentinel plane is off and aggregator
+                               behavior is byte-identical (``kfrun
+                               -sentinel`` sets it)
+``KF_SENTINEL_KEEP_BYTES``     per-stream history ring byte budget,
+                               default 8 MiB; oldest sealed segments are
+                               GC'd past it (monitor/history.py)
+``KF_SENTINEL_PERIOD``         seconds between sentinel samples, default
+                               1.0; <= 0 samples on every aggregator
+                               ingest (tests)
+``KF_SENTINEL_WINDOW``         changepoint window in samples, default 8
+                               (monitor/detect.py)
+``KF_SENTINEL_THRESHOLD``      median-shift score (MAD multiples) before
+                               a series alerts, default 4.0
+``KF_SENTINEL_MFU_FLOOR``      MFU watermark: alert when the cluster MFU
+                               mean sinks below it; default 0 = off
+``KF_SENTINEL_STEP_CEILING_S`` step-time watermark seconds; default 0 =
+                               off
+``KF_SENTINEL_WARMUP_STEPS``   steps considered warmup, default 32; XLA
+                               recompiles AFTER it raise the
+                               recompile-steady alert
+``KF_SENTINEL_INCIDENT_WINDOW`` history records embedded in an incident
+                               flight record, default 64
+``KF_SENTINEL_SLO_SHORT``      SLO burn-rate short window in samples,
+                               default 6 (serve/slo.py SLORules)
+``KF_SENTINEL_SLO_LONG``       SLO burn-rate long window in samples,
+                               default 24 (serve/slo.py SLORules)
+=============================  ================================================
+
 Fault-injection envs (the chaos layer, :mod:`kungfu_tpu.chaos`; see
 docs/fault_tolerance.md for the full matrix):
 
@@ -431,6 +468,23 @@ PERSIST_ASYNC_DEPTH = "KF_PERSIST_ASYNC_DEPTH"
 PERSIST_KEEP = "KF_PERSIST_KEEP"
 PERSIST_RESTORE = "KF_PERSIST_RESTORE"
 
+# kf-sentinel envs (monitor/sentinel.py + monitor/history.py define
+# mirror constants next to their readers and parse os.environ directly —
+# the stubbed kfhist/kftop context cannot import this module; registered
+# here so the env-contract scan anchors the tokens, and sentinel_knobs()
+# below pins the defaults both sides must agree on)
+SENTINEL_DIR = "KF_SENTINEL_DIR"
+SENTINEL_KEEP_BYTES = "KF_SENTINEL_KEEP_BYTES"
+SENTINEL_PERIOD = "KF_SENTINEL_PERIOD"
+SENTINEL_WINDOW = "KF_SENTINEL_WINDOW"
+SENTINEL_THRESHOLD = "KF_SENTINEL_THRESHOLD"
+SENTINEL_MFU_FLOOR = "KF_SENTINEL_MFU_FLOOR"
+SENTINEL_STEP_CEILING_S = "KF_SENTINEL_STEP_CEILING_S"
+SENTINEL_WARMUP_STEPS = "KF_SENTINEL_WARMUP_STEPS"
+SENTINEL_INCIDENT_WINDOW = "KF_SENTINEL_INCIDENT_WINDOW"
+SENTINEL_SLO_SHORT = "KF_SENTINEL_SLO_SHORT"
+SENTINEL_SLO_LONG = "KF_SENTINEL_SLO_LONG"
+
 # fault-injection envs (read by kungfu_tpu/chaos/inject.py at controller
 # creation; registered here so the env-contract scan anchors them to the
 # same registry as every other KF_* knob)
@@ -500,6 +554,29 @@ def persist_knobs() -> dict:
         "depth": parse_int_env(PERSIST_ASYNC_DEPTH, 2),
         "keep": parse_int_env(PERSIST_KEEP, 3),
         "restore": parse_bool_env(PERSIST_RESTORE, False),
+    }
+
+
+def sentinel_knobs() -> dict:
+    """The kf-sentinel plane knobs, parsed with their defaults.
+
+    monitor/sentinel.py reads the same tokens from ``os.environ``
+    directly (the stubbed kfhist context cannot import this module);
+    tests pin that both sides use these exact defaults so the
+    documented contract cannot drift.
+    """
+    return {
+        "dir": os.environ.get(SENTINEL_DIR, ""),
+        "keep_bytes": parse_int_env(SENTINEL_KEEP_BYTES, 8 << 20),
+        "period_s": parse_float_env(SENTINEL_PERIOD, 1.0),
+        "window": parse_int_env(SENTINEL_WINDOW, 8),
+        "threshold": parse_float_env(SENTINEL_THRESHOLD, 4.0),
+        "mfu_floor": parse_float_env(SENTINEL_MFU_FLOOR, 0.0),
+        "step_ceiling_s": parse_float_env(SENTINEL_STEP_CEILING_S, 0.0),
+        "warmup_steps": parse_int_env(SENTINEL_WARMUP_STEPS, 32),
+        "incident_window": parse_int_env(SENTINEL_INCIDENT_WINDOW, 64),
+        "slo_short": parse_int_env(SENTINEL_SLO_SHORT, 6),
+        "slo_long": parse_int_env(SENTINEL_SLO_LONG, 24),
     }
 
 

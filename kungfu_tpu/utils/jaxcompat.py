@@ -82,6 +82,47 @@ def pcast_varying(x, axes):
     return pcast(x, tuple(axes), to="varying")
 
 
+#: one-shot guard for install_compile_metrics (a second install would
+#: double-count every compile)
+_COMPILE_METRICS_INSTALLED = False
+
+#: the jax monitoring event that marks one XLA backend compile — the
+#: recompile signal kf-sentinel's recompile-steady watermark judges
+_BACKEND_COMPILE_EVENT = "backend_compile_duration"
+
+
+def install_compile_metrics() -> bool:
+    """Mirror XLA compiles into the unified registry:
+    ``kf_jit_compiles_total`` (counter) and ``kf_jit_compile_seconds``
+    (histogram) tick on every ``/jax/core/compile/
+    backend_compile_duration`` monitoring event — so the cluster
+    snapshots carry them, kftop can show them, and the sentinel's
+    recompile-steady watermark can alert on compiles after warmup
+    (a steady-state recompile means a shape leak / cache bust).
+
+    None-safe across jax versions: where ``jax.monitoring`` has no
+    duration-listener hook this is a no-op returning ``False``.
+    Idempotent — peers and tests may both call it."""
+    global _COMPILE_METRICS_INSTALLED
+    if _COMPILE_METRICS_INSTALLED:
+        return True
+    register = getattr(getattr(jax, "monitoring", None),
+                       "register_event_duration_secs_listener", None)
+    if register is None:
+        return False
+    from kungfu_tpu.monitor.registry import REGISTRY
+
+    def _on_duration(name: str, duration: float, **_kw) -> None:
+        if name.endswith(_BACKEND_COMPILE_EVENT):
+            REGISTRY.counter("kf_jit_compiles_total").inc()
+            REGISTRY.histogram("kf_jit_compile_seconds").observe(
+                float(duration))
+
+    register(_on_duration)
+    _COMPILE_METRICS_INSTALLED = True
+    return True
+
+
 def set_cpu_device_count(n: int) -> None:
     """Force an ``n``-device virtual CPU platform across jax versions.
 

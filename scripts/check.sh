@@ -42,9 +42,21 @@
 #                    newest complete manifest, a halved world restores
 #                    bitwise from the same directory
 #                    (docs/persistence.md)
+# 3d. kfhist       — durable sentinel history self-check: segmented
+#                    ring write/seal/GC, torn-record skip, replayed
+#                    changepoint verdict (docs/sentinel.md)
+# 3e. sentinel     — kf-sentinel e2e gate: mid-run chaos onset, online
+#                    changepoint alert, incident flight record naming
+#                    the planted edge, offline kfhist replay identical
+# 3f. benchdiff    — every BENCH_extra.json gate inside its tolerance
+#                    band of the checked-in tests/bench_baseline.json
 # 4. compileall    — every .py parses/compiles on this interpreter
 # 5. flag stamps   — no sanitizer flags leaked into the production
 #                    .buildflags stamp (variants must never mix)
+# 6. tier-1 budget — the 'not slow' suite finishes green inside
+#                    tests/tier1_budget.json budget_s (new heavy tests
+#                    must be slow-marked, not squeezed into tier-1);
+#                    KF_CHECK_SKIP_TIER1=1 skips for local iteration
 set -euo pipefail
 
 ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
@@ -89,6 +101,31 @@ fi
 
 echo "== kftop self-check (/cluster schema round-trip)"
 if ! python3 scripts/kftop --self-check; then
+    fail=1
+fi
+
+echo "== kfhist self-check (durable history ring + offline verdict)"
+# kf-sentinel's offline reader: segmented-ring write/seal/GC round-trip,
+# torn-record skip, and the replayed changepoint verdict over a planted
+# shift (docs/sentinel.md)
+if ! python3 scripts/kfhist --self-check; then
+    fail=1
+fi
+
+echo "== kfbench-diff self-check (tolerance-band compare logic)"
+if ! python3 scripts/kfbench-diff --self-check; then
+    fail=1
+fi
+
+echo "== benchdiff (BENCH_extra.json vs the checked-in baseline)"
+# every recorded gate must sit inside its tolerance band of
+# tests/bench_baseline.json — a PR that quietly tanks a measured gate
+# fails here, not in archaeology.  Regenerate after recording new rows:
+#   scripts/kfbench-diff --snapshot BENCH_extra.json > tests/bench_baseline.json
+if ! python3 scripts/kfbench-diff tests/bench_baseline.json \
+        BENCH_extra.json > /tmp/_kf_benchdiff.log 2>&1; then
+    echo "ERROR: a recorded bench gate regressed vs the checked-in baseline"
+    tail -20 /tmp/_kf_benchdiff.log || true
     fail=1
 fi
 
@@ -207,6 +244,27 @@ if ! timeout -k 10 300 python3 bench.py --xray --quick \
     fail=1
 fi
 
+echo "== sentinel-gate (mid-run chaos onset -> online alert == offline replay)"
+# kf-sentinel end to end: 3-rank paced mesh, delay clauses armed
+# MID-RUN (after_step) on the 0<->1 link — the clean baseline must stay
+# silent, the regress:step_time_s changepoint alert must fire online
+# within K=2 windows, the incident flight record's xray verdict must
+# name the planted rank/edge, and kfhist --verdict over the durable
+# history must reproduce the identical verdicts (docs/sentinel.md).
+# Bounded: a wedged mesh must fail the gate, not hang it.
+rm -f /tmp/_kf_sentinel_gate.log
+if ! timeout -k 10 300 python3 bench.py --sentinel --quick \
+        > /tmp/_kf_sentinel_gate.log 2>/dev/null \
+        || ! grep -q '"no_false_positive_in_clean_phase": true' \
+        /tmp/_kf_sentinel_gate.log \
+        || ! grep -q '"offline_verdict_identical_to_incident": true' \
+        /tmp/_kf_sentinel_gate.log \
+        || ! grep -q '"vs_baseline": 1.0' /tmp/_kf_sentinel_gate.log; then
+    echo "ERROR: sentinel gate failed (detection, incident, or replay)"
+    tail -5 /tmp/_kf_sentinel_gate.log || true
+    fail=1
+fi
+
 echo "== pallas-check (ICI ring kernels bitwise vs the lax references)"
 # the make pallas-check gate: interpreter-path kernels pinned bitwise
 # against the order-matched lax emulation and the psum_scatter/
@@ -244,6 +302,33 @@ if [ -f kungfu_tpu/native/.buildflags-asan ] \
     && ! grep -q "fsanitize=address" kungfu_tpu/native/.buildflags-asan; then
     echo "ERROR: .buildflags-asan lost -fsanitize=address"
     fail=1
+fi
+
+echo "== tier-1 time budget (suite green inside the checked-in cap)"
+# the tier-1 suite must FINISH, green, inside tests/tier1_budget.json's
+# budget_s — the cap the CI runner enforces with a hard timeout.  A new
+# e2e test that pushes the suite past this line belongs in tier-2
+# (@pytest.mark.slow), not inside the budget.  Opt out for quick local
+# iterations with KF_CHECK_SKIP_TIER1=1 (CI must not).
+if [ "${KF_CHECK_SKIP_TIER1:-0}" = "1" ]; then
+    echo "   skipped (KF_CHECK_SKIP_TIER1=1): tier-1 budget not verified"
+else
+    T1_BUDGET=$(python3 -c "import json; \
+print(int(json.load(open('tests/tier1_budget.json'))['budget_s']))")
+    rm -f /tmp/_kf_tier1_budget.log
+    t1_start=$(date +%s)
+    if ! timeout -k 10 "$T1_BUDGET" env JAX_PLATFORMS=cpu \
+            python3 -m pytest tests/ -q -m 'not slow' \
+            --continue-on-collection-errors -p no:cacheprovider \
+            -p no:xdist -p no:randomly \
+            > /tmp/_kf_tier1_budget.log 2>&1; then
+        echo "ERROR: tier-1 failed or blew the ${T1_BUDGET}s wall budget"
+        tail -15 /tmp/_kf_tier1_budget.log || true
+        fail=1
+    else
+        echo "   tier-1 green in $(( $(date +%s) - t1_start ))s" \
+            "(budget ${T1_BUDGET}s)"
+    fi
 fi
 
 if [ "$fail" -ne 0 ]; then

@@ -480,6 +480,7 @@ class TestFusedLMHead:
         with pytest.raises(ValueError, match="KF_TPU_LM_HEAD"):
             model.loss(params, batch)
 
+    @pytest.mark.slow  # ~16s: fuzz sweep recompiles per shape
     def test_random_shape_sweep(self):
         """Randomized ragged shapes and block sizes: loss + grads must
         match the reference everywhere (pad/mask path fuzz)."""

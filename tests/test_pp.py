@@ -432,8 +432,10 @@ class TestPipelineBitwise:
     @pytest.mark.parametrize("S,m,sched", [
         (2, 4, "1f1b"),     # aligned
         (2, 3, "1f1b"),     # ragged microbatch count
-        (4, 4, "1f1b"),     # deeper pipe
-        (4, 6, "1f1b"),     # ragged, deeper
+        # deep-pipe variants cost ~20s each on the 1-core box; the slow
+        # lane keeps them, tier-1 keeps the shallow spine
+        pytest.param(4, 4, "1f1b", marks=pytest.mark.slow),   # deeper pipe
+        pytest.param(4, 6, "1f1b", marks=pytest.mark.slow),   # ragged, deeper
         (2, 4, "sequential"),
     ])
     def test_bitwise_vs_reference(self, S, m, sched, monkeypatch):
@@ -461,6 +463,7 @@ class TestPipelineBitwise:
             for c in chans:
                 c.close()
 
+    @pytest.mark.slow  # ~20s: multi-step 1f1b replay on the 1-core box
     def test_multi_step_bitwise(self, monkeypatch):
         plan = ParallelPlan(pp=2, n_micro=2)
         full = pp.init_stacked_params(CFG, jax.random.PRNGKey(1))
@@ -754,6 +757,7 @@ class TestChaosSliceLossRecarve:
     post-loss world's final params bitwise a fixed-world replay from
     the same committed boundary."""
 
+    @pytest.mark.slow  # ~60s: full slice-loss recarve + bitwise replay
     def test_die_slice_recarve_bitwise(self, monkeypatch):
         from tests.test_slices import make_slice_peers
 

@@ -296,6 +296,7 @@ class TestRouterLive:
         finally:
             teardown_cluster(peers, servers, router)
 
+    @pytest.mark.slow  # ~75s: live 3-worker cluster + chaos kill + replay
     def test_worker_kill_replays_on_survivor(self, monkeypatch,
                                              model_and_params):
         """The SLO-gated fault scenario: a chaos-killed worker's
@@ -318,6 +319,7 @@ class TestRouterLive:
         finally:
             teardown_cluster(peers, servers, router)
 
+    @pytest.mark.slow  # ~20s live cluster; flaky under full-suite load
     def test_slice_kill_excludes_whole_slice(self, monkeypatch,
                                              model_and_params):
         """die_slice kills both ranks of slice 1; the router expands the
