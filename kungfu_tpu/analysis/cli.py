@@ -47,6 +47,7 @@ from kungfu_tpu.analysis import (
     envcheck,
     handlecheck,
     jitpurity,
+    ledgerschema,
     lockcheck,
     protoverify,
     pylockorder,
@@ -71,6 +72,7 @@ CHECKERS: Dict[str, object] = {
     pylockorder.CHECKER: pylockorder.check,
     tracevocab.CHECKER: tracevocab.check,
     aggschema.CHECKER: aggschema.check,
+    ledgerschema.CHECKER: ledgerschema.check,
     shardaxis.CHECKER: shardaxis.check,
     shardspec.CHECKER: shardspec.check,
     recompilehazard.CHECKER: recompilehazard.check,

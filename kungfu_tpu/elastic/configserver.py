@@ -20,6 +20,9 @@ shrink:
 * ``GET  /alerts``  → the kf-sentinel alert state (active rules, fired
   alerts, detector verdicts) — 404 unless a Sentinel is attached to the
   mounted aggregator (``kfrun -sentinel`` / ``KF_SENTINEL_DIR``)
+* ``GET  /decisions`` → the kf-ledger view (recent decision records
+  joined to their measured effects, plus the summary) — same 404
+  contract as ``/alerts``
 """
 
 from __future__ import annotations
@@ -85,6 +88,15 @@ class ConfigServer:
                         return
                     self._reply(200,
                                 json.dumps(sentinel.alerts_view()).encode())
+                    return
+                if self.path.startswith("/decisions"):
+                    agg = srv.aggregator
+                    sentinel = getattr(agg, "_sentinel", None)
+                    if agg is None or sentinel is None:
+                        self._reply(404, b'{"error": "no sentinel"}')
+                        return
+                    self._reply(
+                        200, json.dumps(sentinel.ledger.view()).encode())
                     return
                 if self.path.startswith("/metrics"):
                     agg = srv.aggregator

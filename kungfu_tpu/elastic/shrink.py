@@ -46,7 +46,7 @@ from typing import Iterable, List, Optional, Sequence, Tuple
 
 from kungfu_tpu.comm.faults import (PeerFailureError, QuorumLostError,
                                     SliceExcludedError)
-from kungfu_tpu.monitor import timeline
+from kungfu_tpu.monitor import ledger, timeline
 from kungfu_tpu.plan.cluster import Cluster
 from kungfu_tpu.utils.log import get_logger, log_event
 
@@ -326,6 +326,11 @@ def shrink_to_survivors(peer, dead_ranks: Sequence[int]) -> bool:
     )
     timeline.event("shrink", "propose", rank=me, dead=dead,
                    version=version, survivors=len(survivors))
+    # kf-ledger: a shrink is the most consequential "decision" the
+    # cluster makes — the consensus version is the agreement round
+    ledger.record_decision(
+        "shrink", "world", len(workers), len(survivors),
+        consensus_seq=version, evidence={"dead": list(dead)})
     if topo is not None:
         timeline.event("slice", "propose", rank=me,
                        dead_slices=sorted({topo.slice_of(r) for r in dead}),

@@ -70,7 +70,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from kungfu_tpu.monitor import timeline
+from kungfu_tpu.monitor import ledger, timeline
 from kungfu_tpu.monitor.skew import (COLLECTIVE_KINDS, SPIKE_FACTOR,
                                      skew_rows, straggler_verdict)
 from kungfu_tpu.policy.bandit import ArmStats, ScheduleTable
@@ -294,6 +294,11 @@ class HostBanditDriver:
             "swap", proposal, rank=self._rank(), plane="host",
             seq=self._seq, prev=prev, step=timeline.current_step(),
         )
+        # kf-ledger: the durable accountability record — the swap digest
+        # seq is the consensus round that agreed on this change
+        ledger.record_decision(
+            "bandit-host", "strategy", prev, proposal,
+            consensus_seq=self._seq, evidence={"plane": "host"})
         self.active = proposal
         self._settling = True
         self.swaps += 1
@@ -471,6 +476,11 @@ class DeviceBanditDriver:
                 bucket=self._bucket_names[b], seq=self._seq, prev=prev,
                 step=timeline.current_step(),
             )
+            ledger.record_decision(
+                "bandit-device", "schedule", prev, arm,
+                consensus_seq=self._seq,
+                evidence={"plane": "device",
+                          "bucket": self._bucket_names[b]})
             self.swaps += 1
             swapped = True
             _log.info("bandit swap (device, %s bucket): %s -> %s at seq %d",

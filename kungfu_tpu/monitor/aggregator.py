@@ -121,8 +121,14 @@ VIEW_FIELDS = frozenset({
     "fastest_s", "skew_s", "total_s",
     # kf-sentinel section (present ONLY when a Sentinel is attached —
     # the disabled plane is byte-identical to the pre-sentinel view):
-    # active rules + fired-alert log + live detector verdicts
+    # active rules + fired-alert log + live detector verdicts, plus the
+    # kf-ledger decision summary a policy steers by
     "alerts", "active", "rule", "evidence", "incident", "verdicts",
+    "decisions",
+    # kf-pulse section (None when no rank exports the gradient-signal
+    # gauges): cluster means of the kf_gns / kf_grad_variance gauges and
+    # the per-group kf_grad_norm{group=} rollup
+    "pulse", "gns", "grad_variance", "groups",
     # serving summary (kf-serve; None on deployments with no serve
     # metrics): cluster-wide sums of the per-rank serve gauges/counters
     # plus window-mean latencies from the pushed histogram deltas
@@ -393,6 +399,40 @@ class ClusterAggregator:
         }
 
     @staticmethod
+    def _pulse_summary(rows: List[dict]) -> Optional[dict]:
+        """Cluster-wide gradient-signal rollup (kf-pulse): means of the
+        per-rank ``kf_gns`` / ``kf_grad_variance`` gauges (every rank
+        publishes the SAME collective estimate, so the mean passes
+        identical values through) plus the per-group
+        ``kf_grad_norm{group=}`` rollup.  ``None`` when no rank exports
+        pulse gauges, so an uninstrumented deployment renders no PULSE
+        section."""
+        gns: List[float] = []
+        gvar: List[float] = []
+        groups: Dict[str, List[float]] = {}
+        prefix = 'kf_grad_norm{group="'
+        for row in rows:
+            gauges = row.get("gauges") or {}
+            v = gauges.get("kf_gns")
+            if v is not None:
+                gns.append(float(v))
+            v = gauges.get("kf_grad_variance")
+            if v is not None:
+                gvar.append(float(v))
+            for key, val in gauges.items():
+                if key.startswith(prefix) and key.endswith('"}'):
+                    groups.setdefault(key[len(prefix):-2],
+                                      []).append(float(val))
+        if not gns and not gvar and not groups:
+            return None
+        return {
+            "gns": (sum(gns) / len(gns)) if gns else None,
+            "grad_variance": (sum(gvar) / len(gvar)) if gvar else None,
+            "groups": {g: sum(vs) / len(vs)
+                       for g, vs in sorted(groups.items())},
+        }
+
+    @staticmethod
     def _xray_summary(rows: List[dict],
                       events: List[dict]) -> Optional[dict]:
         """The ``/cluster`` ``xray`` section: step-time attribution +
@@ -524,6 +564,7 @@ class ClusterAggregator:
             "slices": slice_groups,
             "stale_slices": stale_slices,
             "serving": self._serving_summary(rows),
+            "pulse": self._pulse_summary(rows),
             "xray": self._xray_summary(rows, events),
             "skew": skewlib.skew_rows(events)[:top],
             "slowest_per_step": skewlib.slowest_rank_per_step(events)[-top:],
@@ -575,6 +616,22 @@ class ClusterAggregator:
                 "# TYPE kf_cluster_kv_cache_bytes gauge",
                 f"kf_cluster_kv_cache_bytes {srv['kv_bytes']}",
             ]
+        if view["pulse"]:
+            pl = view["pulse"]
+            if pl.get("gns") is not None:
+                lines += [
+                    "# HELP kf_cluster_gns gradient noise scale, mean "
+                    "over reporting ranks (kf-pulse)",
+                    "# TYPE kf_cluster_gns gauge",
+                    f"kf_cluster_gns {pl['gns']:.6g}",
+                ]
+            if pl.get("grad_variance") is not None:
+                lines += [
+                    "# HELP kf_cluster_grad_variance cross-peer gradient "
+                    "variance, mean over reporting ranks (kf-pulse)",
+                    "# TYPE kf_cluster_grad_variance gauge",
+                    f"kf_cluster_grad_variance {pl['grad_variance']:.6g}",
+                ]
         if view["xray"]:
             xr = view["xray"]
             if xr.get("mfu"):

@@ -17,13 +17,17 @@ Modes::
     kfhist --dir RUNDIR --series step_time_s # one series' samples
     kfhist --dir RUNDIR --verdict            # detector replay
     kfhist --dir RUNDIR --verdict --upto N   # ...over the first N records
+    kfhist --dir RUNDIR --decisions          # kf-ledger effect replay
     kfhist --json ...                        # machine output (scripts)
     kfhist --self-check                      # ring+detector round trip
 
 ``--upto`` selects the exact record prefix an incident flight record
 was judged over (its ``history_n`` field), so ``kfhist --verdict --upto
 <history_n>`` must reproduce the bundle's embedded ``verdicts`` byte
-for byte.
+for byte.  ``--decisions`` extends the doctrine to the kf-ledger: each
+decision's effect verdict is recomputed offline from the durable
+``decisions`` + ``cluster`` streams (:func:`kungfu_tpu.monitor.ledger.
+replay_effects`) and must match the online effect record byte for byte.
 
 Stdlib-only, launched through ``scripts/kfhist`` with the same package
 stubs as ``kftop``/``kftrace``: no jax, no package ``__init__`` chain.
@@ -38,6 +42,7 @@ import tempfile
 from typing import Dict, List, Optional, Sequence
 
 from kungfu_tpu.monitor import detect, history
+from kungfu_tpu.monitor import ledger as ledgerlib
 from kungfu_tpu.monitor import sentinel as sentinellib
 
 
@@ -99,6 +104,60 @@ def _print_verdict(out: dict) -> None:
               f"(threshold {v['threshold']})")
 
 
+def decisions_from_dir(root: str) -> dict:
+    """The offline kf-ledger replay, with a ``match`` flag per decision:
+    ``True`` iff the recomputed effect record equals the stream's online
+    one byte for byte (``json.dumps(..., sort_keys=True)``)."""
+    out = ledgerlib.replay_effects(root)
+    for row in out["decisions"]:
+        online, replayed = row["online"], row["replayed"]
+        if online is None and replayed is None:
+            row["match"] = None          # still pending on both sides
+        else:
+            row["match"] = (
+                json.dumps(online, sort_keys=True)
+                == json.dumps(replayed, sort_keys=True))
+    return out
+
+
+def _print_decisions(out: dict) -> None:
+    rows = out["decisions"]
+    print(f"kfhist: {out['records']} ledger record(s), "
+          f"{out['skipped']} skipped, {len(rows)} decision(s)")
+    if not rows:
+        print("  (no decisions recorded — actors write via "
+              "kungfu_tpu.monitor.ledger.record_decision)")
+        return
+    lf = ledgerlib.lfield
+    for row in rows:
+        d = row["decision"]
+        head = (f"  #{lf(d, 'seq')} {lf(d, 'actor')}/{lf(d, 'knob')}: "
+                f"{lf(d, 'old')!r} -> {lf(d, 'new')!r}"
+                f" (step {lf(d, 'step')}, consensus "
+                f"{lf(d, 'consensus_seq')})")
+        print(head)
+        e = row["replayed"]
+        if e is None:
+            if row["match"] is None:
+                print("    effect: pending (after window not filled)")
+            else:
+                print("    effect: replay produced none but the stream "
+                      "has an online record — replay MISMATCH")
+            continue
+        if lf(e, "verdict") == "insufficient":
+            print(f"    effect: insufficient baseline "
+                  f"({lf(e, 'series')})")
+        else:
+            print(f"    effect: {lf(e, 'verdict').upper()} — "
+                  f"{lf(e, 'series')} {lf(e, 'before_median')} -> "
+                  f"{lf(e, 'after_median')} "
+                  f"(shift {lf(e, 'shift')}, score {lf(e, 'score')}, "
+                  f"threshold {lf(e, 'threshold')})")
+        mark = {True: "replay MATCH", False: "replay MISMATCH",
+                None: "replay n/a"}[row["match"]]
+        print(f"    {mark}")
+
+
 # -- self-check --------------------------------------------------------------
 def self_check() -> int:
     """Ring + reader + detector round trip in a temp dir: segmentation
@@ -150,6 +209,26 @@ def self_check() -> int:
                           for seq, p in history._segments(d, "gc")
                           if seq != ring2._seq)
         ok = ok and sealed_size <= 256
+        # kf-ledger round trip (own subdir — the cluster stream above
+        # would shift the sample positions): a decision judged online
+        # over the live feed must replay byte-identically offline
+        ld = os.path.join(d, "ledger")
+        lg = ledgerlib.DecisionLedger(ld, window=4, threshold=4.0)
+        cluster_ring = history.HistoryRing(ld, "cluster",
+                                           keep_bytes=1 << 20)
+        for i, st in enumerate([0.2] * 6 + [0.1] * 4):
+            if i == 6:
+                lg.decide("selfcheck", "knob", "a", "b", wall=0.0,
+                          trace_id="t0", step=i)
+            rec = {"kfhist": 1, "wall": 2000.0 + i,
+                   "series": {"step_time_s": st}}
+            cluster_ring.append(rec)
+            lg.on_sample(rec)
+        rep = decisions_from_dir(ld)
+        ok = (ok and len(rep["decisions"]) == 1
+              and rep["decisions"][0]["match"] is True
+              and ledgerlib.lfield(rep["decisions"][0]["replayed"],
+                                   "verdict") == "improved")
     if not ok:
         print("kfhist: self-check FAILED (ring/detector round-trip "
               "mismatch)", file=sys.stderr)
@@ -184,6 +263,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "judged over)")
     p.add_argument("--verdict", action="store_true",
                    help="replay the online detector over the stream")
+    p.add_argument("--decisions", action="store_true",
+                   help="replay the kf-ledger decision effects offline "
+                        "and check them against the online records")
     p.add_argument("--window", type=int, default=None,
                    help="changepoint window (default: KF_SENTINEL_WINDOW)")
     p.add_argument("--threshold", type=float, default=None,
@@ -219,6 +301,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         else:
             _print_verdict(out)
         return 0
+
+    if args.decisions:
+        out = decisions_from_dir(args.dir)
+        if args.json:
+            json.dump(out, sys.stdout, indent=2, sort_keys=True)
+            sys.stdout.write("\n")
+        else:
+            _print_decisions(out)
+        return 1 if any(row["match"] is False
+                        for row in out["decisions"]) else 0
 
     records, skipped = history.scan_stream(args.dir, args.stream)
     if args.upto is not None and args.upto >= 0:

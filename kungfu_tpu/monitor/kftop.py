@@ -145,6 +145,64 @@ def _device_mem(row: dict):
             gauges.get('kf_device_memory_bytes{kind="limit"}', 0.0))
 
 
+def _pulse_lines(view: dict) -> List[str]:
+    """The PULSE section (kf-pulse gradient-signal monitoring; present
+    only when some rank exports the pulse gauges — docs/pulse.md):
+    cluster GNS/variance means plus per-rank and per-group gradient
+    norms from the same pushed gauges."""
+    pl = field(view, "pulse")
+    if not pl:
+        return []
+    gns = field(pl, "gns")
+    gvar = field(pl, "grad_variance")
+    lines = ["", "== PULSE (gradient noise scale / variance; "
+                 "docs/pulse.md)"]
+    head = (f"  gns {gns:.4g}" if gns is not None else "  gns -")
+    head += (f" | grad-var {gvar:.4g}" if gvar is not None
+             else " | grad-var -")
+    groups = field(pl, "groups") or {}
+    if groups:
+        head += " | " + " ".join(
+            f"|g|[{g}] {v:.4g}" for g, v in sorted(groups.items()))
+    lines.append(head)
+    per_rank = []
+    for row in field(view, "ranks") or []:
+        gauges = field(row, "gauges") or {}
+        v = gauges.get("kf_gns")
+        if v is not None:
+            per_rank.append(f"r{field(row, 'rank')}:{float(v):.4g}")
+    if per_rank:
+        lines.append("  per-rank gns: " + " ".join(per_rank))
+    return lines
+
+
+def _decision_lines(view: dict) -> List[str]:
+    """The DECISIONS tail of the ALERTS section (kf-ledger): how many
+    adaptive-actor decisions the run has made, how they measured out,
+    and the newest effect verdict (docs/pulse.md)."""
+    al = field(view, "alerts")
+    if not al:
+        return []
+    dec = field(al, "decisions")
+    if not dec or not dec.get("total"):
+        return []
+    by_verdict = dec.get("by_verdict") or {}
+    line = (f"  decisions: {dec.get('total')} made, "
+            f"{dec.get('judged')} judged, {dec.get('pending')} pending")
+    if by_verdict:
+        line += " (" + " ".join(
+            f"{k}:{v}" for k, v in sorted(by_verdict.items())) + ")"
+    lines = [line]
+    last = dec.get("last")
+    if last:
+        lines.append(
+            f"  last effect: {last.get('actor')}/{last.get('knob')} "
+            f"-> {last.get('verdict')} "
+            f"({last.get('series')} {last.get('before_median')} -> "
+            f"{last.get('after_median')}, score {last.get('score')})")
+    return lines
+
+
 def _alert_lines(view: dict) -> List[str]:
     """The ALERTS section (kf-sentinel; present only when a Sentinel is
     attached to the aggregator — docs/sentinel.md)."""
@@ -355,8 +413,10 @@ def render_view(view: dict, top: int = 10) -> str:
             + ", ".join(ckpt_stale)
             + " (durable plane wedged? a preemption now replays all of "
               "that; docs/persistence.md)")
+    lines.extend(_pulse_lines(view))
     lines.extend(_serving_lines(view))
     lines.extend(_alert_lines(view))
+    lines.extend(_decision_lines(view))
     return "\n".join(lines) + "\n"
 
 
@@ -387,7 +447,12 @@ def self_check() -> int:
     for rank in range(3):
         dur = 0.10 if rank == 2 else 0.01
         counters = {"kf_engine_retries_total": rank}
-        gauges = {"kf_stat_gns": 1.5}
+        gauges = {"kf_stat_gns": 1.5,
+                  # kf-pulse gauges on every rank (the collective
+                  # estimate is identical across peers by construction)
+                  "kf_gns": 1.5,
+                  "kf_grad_variance": 0.25,
+                  'kf_grad_norm{group="flat"}': 2.0}
         latency = {"kf_collective_latency_seconds": {"count": 2, "sum": dur}}
         if rank == 0:  # one rank exporting the kf-xray gauges
             gauges["kf_mfu"] = 0.41
@@ -469,13 +534,22 @@ def self_check() -> int:
           and field(xr, "phase_seconds") == {"compute": 0.2,
                                              "comm_exposed": 0.05}
           and field(xr, "dropped_events") == {"2": 5})
+    # kf-pulse: the per-rank gauges must roll up to the cluster means
+    # and the per-group norm table
+    pl = field(view, "pulse")
+    ok = (ok and pl is not None
+          and abs(field(pl, "gns") - 1.5) < 1e-9
+          and abs(field(pl, "grad_variance") - 0.25) < 1e-9
+          and field(pl, "groups") == {"flat": 2.0})
     # kf-sentinel: the busted step-time ceiling must be an active alert
-    # in the view, and the fired alert must carry its incident path
+    # in the view, and the fired alert must carry its incident path —
+    # and the alerts section must carry the kf-ledger decision summary
     al = field(view, "alerts")
     ok = (ok and al is not None
           and "watermark:step_time" in (field(al, "active") or [])
           and (field(al, "alerts") or [])
-          and field(field(al, "alerts")[0], "incident"))
+          and field(field(al, "alerts")[0], "incident")
+          and isinstance(field(al, "decisions"), dict))
     text = render_view(view)
     ok = (ok and "STALE" in text and "all_reduce/grad3" in text
           and "coll-lat" in text and "SLICE LOSS" in text
@@ -483,6 +557,7 @@ def self_check() -> int:
           and "== XRAY" in text and "TRACE LOSS" in text
           and "rank 2: 5" in text and "CKPT STALE" in text
           and "== ALERTS" in text and "watermark:step_time" in text
+          and "== PULSE" in text and "gns 1.5" in text
           and "dev-mem" in text and "2.0GiB/8.0GiB" in text)
     tmp.cleanup()
     if not ok:

@@ -1,0 +1,211 @@
+"""kf-pulse: gradient-signal monitoring — noise scale and variance.
+
+The reference framework's signature online statistic is the **gradient
+noise scale** (OpenAI GNS estimator, ``tensorflow/ops/cpu/collective.
+cpp`` ``NoiseScale``): from ONE training step it estimates the batch
+size past which data parallelism stops buying convergence, by comparing
+the gradient computed on a small batch (one rank's) against the same
+step's large-batch gradient (the allreduced mean).  This module is the
+host-plane half of that wire-up:
+
+* :func:`noise_scale` — the ONE scalar implementation of the estimator,
+  shared by the host collective plane (:func:`kungfu_tpu.ops.monitor.
+  host_noise_scale`) and by tests pinning the in-graph
+  :func:`~kungfu_tpu.ops.monitor.global_noise_scale` equal to it.
+  Returns ``None`` on a single worker — with ``b_small == b_big`` the
+  two-batch estimator is undefined, and 0.0 would read as a (wrong)
+  measurement;
+* :class:`PulseMonitor` — EMA smoothing + period gating + gauge export.
+  The train-step factories (``dp_train_step`` / ``zero_train_step`` /
+  ``ShardedTrainer``) compile ONE extra jit program that additionally
+  returns the already-reduced square-norm pair; the monitor decides
+  per step which program runs (``KF_PULSE_EVERY``), so on off steps the
+  bare step's jit program is byte-identical to an uninstrumented build.
+  On sample steps it publishes ``kf_gns``, ``kf_grad_variance`` and the
+  per-group ``kf_grad_norm{group=...}`` gauges into the unified
+  registry, where the :class:`~kungfu_tpu.monitor.aggregator.
+  RankReporter` snapshot carries them to the aggregator's ``/cluster``
+  rollup, kftop's PULSE section, and the sentinel's ``regress:gns``
+  detect stream.
+
+No second gradient all-reduce: the small-batch/large-batch pair comes
+from the per-rank flat gradient vs the post-reduce gradient the step
+already holds; the only extra collective is the cross-peer MEAN of the
+local square norms — one scalar, so the estimate is symmetric across
+peers (every rank publishes the same number).
+
+Cost contract: ``KF_PULSE_EVERY=0`` disables the plane —
+:func:`PulseMonitor.from_env` returns ``None`` and the step factories
+return the bare program untouched.
+
+Env reads are direct ``os.environ`` via the mirror constants below
+(defaults pinned equal to :func:`kungfu_tpu.utils.envs.pulse_knobs` by
+tests), like every monitor/ module: stdlib-only, importable from the
+stubbed ``kftop``/``kfhist`` context.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+from kungfu_tpu.monitor import timeline
+from kungfu_tpu.monitor.registry import REGISTRY
+
+# env mirror constants (utils/envs.py registers the same tokens;
+# pulse_knobs() pins the defaults both sides must agree on)
+EVERY_ENV = "KF_PULSE_EVERY"
+EMA_ENV = "KF_PULSE_EMA"
+
+#: sample every N steps; 0 disables the plane entirely
+DEFAULT_EVERY = 10
+#: EMA weight for the published estimates (~5-sample memory, the same
+#: alpha as the reporter's step-time EMA)
+DEFAULT_EMA_ALPHA = 0.2
+
+#: the epsilon guarding the |G|^2 denominator (reference
+#: ``grad_noise_scale.py``; also used by ops/monitor.py in-graph)
+GNS_EPS = 1e-30
+
+
+def _i(env: str, default: int) -> int:
+    try:
+        return int(os.environ.get(env, "") or default)
+    except ValueError:
+        return default
+
+
+def _f(env: str, default: float) -> float:
+    try:
+        return float(os.environ.get(env, "") or default)
+    except ValueError:
+        return default
+
+
+def noise_scale(g_local_sq: float, g_global_sq: float,
+                b_small: float, n: int) -> Optional[float]:
+    """The OpenAI two-batch GNS estimate ``S / |G|^2`` from one step.
+
+    ``g_local_sq``: cross-peer MEAN of the per-rank (small-batch)
+    gradient square norms; ``g_global_sq``: square norm of the
+    allreduced (large-batch) mean gradient; ``b_small``: per-rank batch
+    size, ``n``: peers (``b_big = n * b_small``).
+
+    The ONE host-side implementation: :func:`kungfu_tpu.ops.monitor.
+    host_noise_scale` delegates here, and the in-graph estimator is
+    pinned equal by tests across world sizes.  ``None`` when ``n <= 1``
+    — the estimator needs two distinct batch sizes to exist."""
+    n = int(n)
+    if n <= 1:
+        return None
+    b_small = float(b_small)
+    b_big = b_small * n
+    g_local_sq = float(g_local_sq)
+    g_global_sq = float(g_global_sq)
+    g2 = (b_big * g_global_sq - b_small * g_local_sq) / (b_big - b_small)
+    s = (g_local_sq - g_global_sq) / (1.0 / b_small - 1.0 / b_big)
+    return s / (abs(g2) + GNS_EPS)
+
+
+def grad_variance(g_local_sq: float, g_global_sq: float) -> float:
+    """Cross-peer gradient variance ``E_i |g_i|^2 - |g_avg|^2`` from the
+    same square-norm pair the GNS estimate consumes (clamped at 0 — a
+    float cancellation must not report negative variance)."""
+    return max(0.0, float(g_local_sq) - float(g_global_sq))
+
+
+class PulseMonitor:
+    """EMA smoothing + period gating + gauge export for the pulse pair.
+
+    Host-side and stdlib-only: the jit programs hand over plain floats
+    (the square-norm pair and optional per-group norms); this object
+    owns every remaining decision — when to sample
+    (:meth:`should_sample`), how to smooth (EMA), and what to publish
+    (the ``kf_gns`` / ``kf_grad_variance`` / ``kf_grad_norm{group=}``
+    gauges plus a ``pulse`` timeline mark when tracing is on)."""
+
+    def __init__(self, every: Optional[int] = None,
+                 ema_alpha: Optional[float] = None):
+        self.every = max(1, int(every if every is not None
+                                else _i(EVERY_ENV, DEFAULT_EVERY)))
+        self.ema_alpha = float(ema_alpha if ema_alpha is not None
+                               else _f(EMA_ENV, DEFAULT_EMA_ALPHA))
+        self.gns: Optional[float] = None            # EMA-smoothed
+        self.variance: Optional[float] = None       # EMA-smoothed
+        self.samples = 0
+        self._count = 0
+
+    @classmethod
+    def from_env(cls) -> Optional["PulseMonitor"]:
+        """The production constructor: ``None`` (no pulse, no cost) when
+        ``KF_PULSE_EVERY`` is 0 or negative."""
+        every = _i(EVERY_ENV, DEFAULT_EVERY)
+        if every <= 0:
+            return None
+        return cls(every=every)
+
+    def should_sample(self, step: Optional[int] = None) -> bool:
+        """True on pulse steps.  With an explicit ``step`` the gate is
+        ``step % every == 0`` (deterministic across restarts from a
+        checkpointed step); without one an internal call counter gates
+        — the step factories use the counter so caller numbering
+        schemes cannot skew the period.  The counter's FIRST sample is
+        the ``every``-th call, not the first: step 0 is the compile
+        transient, and short runs (most tests) never pay the
+        instrumented program's compile at all."""
+        if step is not None:
+            return int(step) % self.every == 0
+        self._count += 1
+        return self._count % self.every == 0
+
+    def _ema(self, prev: Optional[float], x: float) -> float:
+        if prev is None:
+            return x
+        a = self.ema_alpha
+        return (1.0 - a) * prev + a * x
+
+    def publish_norms(self, group_norms: Dict[str, float],
+                      step: Optional[int] = None) -> None:
+        """Per-group norm gauges only — for meshes where the two-batch
+        GNS pair is undefined (tp/pp/sp/expert sharding mixes what "one
+        rank's gradient" means) but the per-kind ``|g|`` is still an
+        exact, free readout of the already-reduced gradients."""
+        for group, norm in (group_norms or {}).items():
+            REGISTRY.gauge("kf_grad_norm", group=str(group)).set(float(norm))
+        timeline.event("pulse", "norms",
+                       **({} if step is None else {"pulse_step": int(step)}))
+
+    def update(self, g_local_sq: float, g_global_sq: float,
+               b_small: float, n: int,
+               group_norms: Optional[Dict[str, float]] = None,
+               step: Optional[int] = None) -> dict:
+        """One pulse sample: smooth, publish, return the sample dict.
+
+        ``gns`` is ``None`` (and its gauge untouched) on a single
+        worker; the variance is still defined (it is 0 there) and
+        publishes regardless, so a world-size change mid-run cannot
+        leave a stale noise-scale gauge lying about the new world."""
+        raw = noise_scale(g_local_sq, g_global_sq, b_small, n)
+        var = grad_variance(g_local_sq, g_global_sq)
+        self.samples += 1
+        if raw is not None:
+            self.gns = self._ema(self.gns, raw)
+            REGISTRY.gauge("kf_gns").set(self.gns)
+        self.variance = self._ema(self.variance, var)
+        REGISTRY.gauge("kf_grad_variance").set(self.variance)
+        for group, norm in (group_norms or {}).items():
+            REGISTRY.gauge("kf_grad_norm", group=str(group)).set(float(norm))
+        out = {
+            "gns": self.gns,
+            "gns_raw": raw,
+            "grad_variance": self.variance,
+            "grad_variance_raw": var,
+            "n": int(n),
+            "b_small": float(b_small),
+        }
+        # hot-ish kind (every `every` steps): ring-recorded only when
+        # tracing is on; the always-on surfaces are the gauges above
+        timeline.event("pulse", "sample",
+                       gns=raw, var=var,
+                       **({} if step is None else {"pulse_step": int(step)}))
+        return out

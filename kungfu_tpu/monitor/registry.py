@@ -267,6 +267,21 @@ METRIC_HELP: Dict[str, str] = {
     "kf_device_memory_bytes":
         "accelerator memory by kind (in_use / limit) from "
         "device.memory_stats(); absent on backends without stats (CPU)",
+    "kf_gns":
+        "EMA-smoothed gradient-noise-scale estimate (OpenAI GNS; "
+        "kf-pulse) — piggybacks on already-reduced gradient buckets, "
+        "sampled every KF_PULSE_EVERY steps; absent on a single worker "
+        "where the two-batch estimator is undefined",
+    "kf_grad_variance":
+        "EMA-smoothed cross-peer gradient variance E_i|g_i - g_avg|^2 "
+        "from the same reduced buckets as kf_gns (kf-pulse)",
+    "kf_grad_norm":
+        "per-parameter-group gradient L2 norm, group= label keyed by "
+        "the sharding kind (kf-pulse)",
+    "kf_decisions_total":
+        "adaptive-control decisions recorded in the kf-ledger, by "
+        "actor= label — each one carries a durable (knob, old, new, "
+        "evidence) record joined to its measured effect",
 }
 
 

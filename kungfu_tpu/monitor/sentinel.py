@@ -52,6 +52,7 @@ from collections import deque
 from typing import Callable, Dict, List, Optional
 
 from kungfu_tpu.monitor import detect, history, timeline
+from kungfu_tpu.monitor import ledger as ledgerlib
 from kungfu_tpu.monitor.aggregator import field, sum_metric
 
 # env mirror constants (utils/envs.py registers the same tokens;
@@ -89,6 +90,10 @@ CHANGEPOINT_SERIES = {
     "ttft_ms": "up",
     "e2e_ms": "up",
     "mfu": "down",
+    # kf-pulse: a RISING gradient noise scale means the current batch
+    # size stopped averaging the noise away — the convergence-efficiency
+    # regression the GNS→batch-size autopilot (ROADMAP item 4) steers by
+    "gns": "up",
 }
 
 #: merged timeline events an incident flight record carries at most
@@ -149,6 +154,18 @@ def extract_series(view: dict) -> Dict[str, float]:
                    for r in rows)
     if compiles:
         out["jit_compiles"] = float(compiles)
+    # kf-pulse gauges: every reporting rank publishes the SAME collective
+    # estimate (the inner mean is a collective), so the rollup is the
+    # mean over the ranks carrying the gauge — identical per-rank values
+    # pass through unchanged, and a straggler snapshot cannot double-count
+    gns = [(field(r, "gauges") or {}).get("kf_gns") for r in rows]
+    gns = [float(v) for v in gns if v is not None]
+    if gns:
+        out["gns"] = sum(gns) / len(gns)
+    gvar = [(field(r, "gauges") or {}).get("kf_grad_variance") for r in rows]
+    gvar = [float(v) for v in gvar if v is not None]
+    if gvar:
+        out["grad_variance"] = sum(gvar) / len(gvar)
     xr = field(view, "xray")
     if xr:
         mfu = field(xr, "mfu")
@@ -210,6 +227,13 @@ class Sentinel:
         self._lock = threading.Lock()
         self._cluster_ring = history.HistoryRing(root, CLUSTER_STREAM,
                                                  keep_bytes=keep_bytes)
+        # the decision ledger shares the sentinel's root and detector
+        # knobs; ledger_for() registers the instance so every actor's
+        # env-keyed record_decision() lands in the SAME stream whose
+        # sample feed _observe_locked drives
+        self.ledger = ledgerlib.ledger_for(root, window=self.window,
+                                           threshold=self.threshold,
+                                           keep_bytes=keep_bytes)
         self._rank_rings: Dict[int, history.HistoryRing] = {}
         self._keep_bytes = keep_bytes
         # per-series rolling buffers, capped at EXACTLY the tail
@@ -292,6 +316,13 @@ class Sentinel:
         self._cluster_ring.append(record)
         self._records += 1
         self._recent.append(record)
+        # the decision ledger sees EXACTLY the records the cluster
+        # stream holds, in order — its series_n positions are therefore
+        # replayable offline from the durable stream (kfhist --decisions)
+        try:
+            self.ledger.on_sample(record)
+        except Exception:  # noqa: BLE001 - the join must not take sampling down
+            pass
         for row in field(view, "ranks") or []:
             rank = field(row, "rank")
             if not isinstance(rank, int):
@@ -480,4 +511,5 @@ class Sentinel:
                 "records": self._records,
                 "window": self.window,
                 "threshold": self.threshold,
+                "decisions": self.ledger.summary(),
             }

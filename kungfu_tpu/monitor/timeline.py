@@ -132,6 +132,20 @@ EVENT_KINDS = frozenset({
                    # ticks kf_alerts_total{rule=...} even with tracing
                    # off — an alert that /metrics cannot count did not
                    # happen
+    "decision",    # adaptive-actor knob change (kf-ledger,
+                   # monitor/ledger.py: a bandit swap, a batch-width
+                   # move, an autoscale resize, a shrink — any actor
+                   # writing a durable decision record).  A counted
+                   # kind labeled by ACTOR name: every decision ticks
+                   # kf_decisions_total{actor=...} even with tracing
+                   # off — a knob change /metrics cannot count did not
+                   # happen
+    "pulse",       # gradient-signal sample mark (kf-pulse,
+                   # monitor/pulse.py: the GNS/variance pair computed
+                   # every KF_PULSE_EVERY steps).  A hot-ish kind,
+                   # recorded only when tracing is on — the always-on
+                   # surfaces are the kf_gns / kf_grad_variance /
+                   # kf_grad_norm gauges
     "step",        # training-step mark
     "mark",        # generic one-shot annotation
 })
@@ -150,12 +164,15 @@ _COUNTED_KINDS = {
     "swap": "kf_strategy_swaps_total",
     "request": "kf_serve_requests_total",
     "alert": "kf_alerts_total",
+    "decision": "kf_decisions_total",
 }
-_LABELED_KINDS = ("chaos", "shrink", "slice", "swap", "request", "alert")
+_LABELED_KINDS = ("chaos", "shrink", "slice", "swap", "request", "alert",
+                  "decision")
 #: label KEY per labeled kind; default "what".  Alerts label by "rule"
 #: so the counter reads kf_alerts_total{rule="regress:step_time_s"} —
-#: the name SLO dashboards group by.
-_LABEL_KEYS = {"alert": "rule"}
+#: the name SLO dashboards group by; decisions label by ACTOR the same
+#: way (kf_decisions_total{actor="bandit-host"}).
+_LABEL_KEYS = {"alert": "rule", "decision": "actor"}
 
 _lock = threading.Lock()
 _ring: collections.deque = collections.deque()

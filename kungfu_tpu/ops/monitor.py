@@ -15,6 +15,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from kungfu_tpu.monitor import pulse
 from kungfu_tpu.ops.collective import all_reduce, peer_size
 
 
@@ -23,7 +24,7 @@ def _sq_norm(tree):
     return sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
 
 
-def host_noise_scale(engine, local_flat, avg_flat, local_batch_size) -> float:
+def host_noise_scale(engine, local_flat, avg_flat, local_batch_size):
     """Gradient-noise-scale estimate over the HOST collective plane (the
     multi-process analog of :func:`global_noise_scale` — same OpenAI
     estimator, with the cross-peer mean of the local square norms running
@@ -32,13 +33,15 @@ def host_noise_scale(engine, local_flat, avg_flat, local_batch_size) -> float:
     ``local_flat``: this worker's fused local gradient (numpy);
     ``avg_flat``: the allreduced MEAN gradient the step just applied.
     Every worker must call this at the same step point — the inner mean
-    is a collective.  Returns the raw per-step estimate; smooth with an
-    EMA before acting on it (reference ``grad_noise_scale.py:41-88``)."""
+    is a collective.  Returns the raw per-step estimate (the scalar
+    math is ONE shared implementation, :func:`kungfu_tpu.monitor.pulse.
+    noise_scale`), or ``None`` on a single worker where the two-batch
+    estimator is undefined — same no-signal contract as the in-graph
+    estimator.  Smooth with an EMA before acting on it (reference
+    ``grad_noise_scale.py:41-88``)."""
     import numpy as np
 
     n = len(engine.peers)
-    b_small = float(local_batch_size)
-    b_big = b_small * n
     g_local_sq = float(np.sum(np.square(np.asarray(local_flat, np.float64))))
     g_local_sq = float(
         engine.all_reduce(
@@ -46,13 +49,7 @@ def host_noise_scale(engine, local_flat, avg_flat, local_batch_size) -> float:
         )[0]
     )
     g_global_sq = float(np.sum(np.square(np.asarray(avg_flat, np.float64))))
-    if n == 1:
-        # b_small == b_big: the two-batch estimator is undefined on a
-        # single worker; report 0 (callers treat <=0 as "no signal")
-        return 0.0
-    g2 = (b_big * g_global_sq - b_small * g_local_sq) / (b_big - b_small)
-    s = (g_local_sq - g_global_sq) / (1.0 / b_small - 1.0 / b_big)
-    return s / (abs(g2) + 1e-30)
+    return pulse.noise_scale(g_local_sq, g_global_sq, local_batch_size, n)
 
 
 def global_noise_scale(local_grads, avg_grads, local_batch_size, axis):
@@ -63,8 +60,13 @@ def global_noise_scale(local_grads, avg_grads, local_batch_size, axis):
 
     Returns the raw (noisy) per-step estimate ``S / |G|^2``; smooth it with
     :func:`kungfu_tpu.ops.state.exponential_moving_average` as the reference
-    does (``grad_noise_scale.py:41-88``)."""
+    does (``grad_noise_scale.py:41-88``).  ``None`` (a trace-time Python
+    value — the axis size is static) on a single peer, matching
+    :func:`host_noise_scale`: with ``b_small == b_big`` the estimator
+    divides by zero, and any number it returned would be a lie."""
     n = peer_size(axis)
+    if int(n) <= 1:
+        return None
     b_small = jnp.asarray(local_batch_size, jnp.float32)
     b_big = b_small * n
     g_local_sq = _sq_norm(local_grads)
@@ -73,7 +75,7 @@ def global_noise_scale(local_grads, avg_grads, local_batch_size, axis):
     g_global_sq = _sq_norm(avg_grads)
     g2 = (b_big * g_global_sq - b_small * g_local_sq) / (b_big - b_small)
     s = (g_local_sq - g_global_sq) / (1.0 / b_small - 1.0 / b_big)
-    return s / (jnp.abs(g2) + 1e-30)
+    return s / (jnp.abs(g2) + pulse.GNS_EPS)
 
 
 def group_all_reduce_with_variance(grads, axis) -> Tuple:

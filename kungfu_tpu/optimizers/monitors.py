@@ -40,6 +40,12 @@ def monitor_gradient_noise_scale(
     def update(grads, state, params=None):
         avg = ops.group_all_reduce(grads, axis, op="mean")
         raw = global_noise_scale(grads, avg, local_batch_size, axis)
+        if raw is None:
+            # single worker: the two-batch estimator does not exist —
+            # train normally, carry the EMA/estimate untouched
+            updates, new_inner = inner.update(avg, state.inner, params)
+            return updates, GNSState(new_inner, state.ema,
+                                     state.noise_scale)
         new_ema, smoothed = exponential_moving_average(state.ema, raw, ema_alpha)
         updates, new_inner = inner.update(avg, state.inner, params)
         return updates, GNSState(new_inner, new_ema, smoothed)

@@ -26,6 +26,7 @@ reference handles with its group allreduce after local backprop
 from __future__ import annotations
 
 import functools
+import math
 from dataclasses import dataclass, replace as _dc_replace
 from typing import Any, Dict, List, Optional, Tuple, Union
 
@@ -254,6 +255,10 @@ class ShardedTrainer:
         self.mesh = plan.build_mesh(devices)
         self.param_specs, self.param_kinds = self._layout()
         self._step_fn = None
+        self._pulse_fn = None
+        from kungfu_tpu.monitor import pulse as pulselib
+        #: kf-pulse gradient-signal monitor (None when KF_PULSE_EVERY=0)
+        self.pulse = pulselib.PulseMonitor.from_env()
 
     # -- parameter layout -------------------------------------------------
     def _layout(self):
@@ -506,15 +511,53 @@ class ShardedTrainer:
         return jax.tree_util.tree_unflatten(treedef, flat_g)
 
     # -- jitted step -------------------------------------------------------
-    def _build_step(self):
+    def _pure_dp(self) -> bool:
+        """True when the mesh is data-parallel ONLY — the shape where
+        the two-batch GNS pair is defined (each dp rank holds a full
+        model replica, so "one rank's gradient" is a real small-batch
+        gradient).  tp/pp/sp/expert sharding splits the model itself;
+        those meshes publish per-kind norms only."""
+        p = self.plan
+        return (p.pp == 1 and p.sp == 1 and p.tp == 1
+                and self.n_experts == 0)
+
+    def _build_step(self, with_pulse: bool = False):
         plan = self.plan
         pspecs = self.param_specs
         batch_spec = P(AXIS_DP, AXIS_SP)
+        kinds = sorted(set(jax.tree_util.tree_leaves(self.param_kinds)))
+        all_axes = (AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP)
+        pure_dp = self._pure_dp()
 
         def per_device(lparams, ids, targets):
             grad_fn = jax.value_and_grad(self._local_loss, has_aux=True)
             (own, (nll, aux)), grads = grad_fn(lparams, ids, targets)
+            gl = jnp.float32(0.0)
+            if with_pulse and pure_dp:
+                # kf-pulse small-batch side: this rank's full-replica
+                # gradient square norm, MEANed across dp peers (the
+                # plane's only extra collective — one scalar)
+                gl = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                         for g in jax.tree_util.tree_leaves(grads))
+                gl = jax.lax.pmean(gl, AXIS_DP)
             grads = self.sync_grads(grads)
+            group_sq = {}
+            if with_pulse:
+                # per-kind |g|^2 of the POST-sync gradients: leaves of
+                # a kind are replicated over its psum axes and sharded
+                # over the rest, so a psum over (all - psum_axes)
+                # reassembles the exact global square norm — scalar
+                # collectives only, on 1-in-`every` steps
+                flat_g = jax.tree_util.tree_leaves(grads)
+                flat_k = jax.tree_util.tree_leaves(self.param_kinds)
+                for kind in kinds:
+                    s = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                            for g, k in zip(flat_g, flat_k) if k == kind)
+                    shard_axes = tuple(a for a in all_axes
+                                       if a not in _KIND_AXES[kind][0])
+                    if shard_axes:
+                        s = jax.lax.psum(s, shard_axes)
+                    group_sq[kind] = s
             # report: gather the stage-masked terms into global means
             nll = jax.lax.pmean(
                 jax.lax.psum(nll, AXIS_PP), (AXIS_DP, AXIS_SP, AXIS_TP)
@@ -522,26 +565,37 @@ class ShardedTrainer:
             aux = jax.lax.pmean(
                 jax.lax.psum(aux, AXIS_PP), (AXIS_DP, AXIS_SP, AXIS_TP)
             )
+            if with_pulse:
+                return grads, nll, aux, group_sq, gl
             return grads, nll, aux
 
+        out_specs = ((pspecs, P(), P(), {k: P() for k in kinds}, P())
+                     if with_pulse else (pspecs, P(), P()))
         sharded = shard_map(
             per_device,
             mesh=self.mesh,
             in_specs=(pspecs, batch_spec, batch_spec),
-            out_specs=(pspecs, P(), P()),
+            out_specs=out_specs,
             check_vma=False,
         )
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def step(state, batch):
             ids, targets = batch
-            grads, nll, aux = sharded(state["params"], ids, targets)
+            if with_pulse:
+                grads, nll, aux, group_sq, gl = sharded(
+                    state["params"], ids, targets)
+            else:
+                grads, nll, aux = sharded(state["params"], ids, targets)
             updates, opt_state = self.tx.update(grads, state["opt_state"], state["params"])
             params = optax.apply_updates(state["params"], updates)
-            return (
+            out = (
                 {"params": params, "opt_state": opt_state, "step": state["step"] + 1},
                 nll + MOE_AUX_COEF * aux,
             )
+            if with_pulse:
+                return out + (group_sq, gl)
+            return out
 
         return step
 
@@ -553,7 +607,32 @@ class ShardedTrainer:
         bspec = NamedSharding(self.mesh, P(AXIS_DP, AXIS_SP))
         ids = jax.device_put(jnp.asarray(ids), bspec)
         targets = jax.device_put(jnp.asarray(targets), bspec)
+        mon = self.pulse
+        if mon is not None and mon.should_sample():
+            if self._pulse_fn is None:
+                # compiled on the first pulse step only (runs shorter
+                # than KF_PULSE_EVERY never pay this compile)
+                self._pulse_fn = self._build_step(with_pulse=True)
+            new_state, loss, group_sq, gl = self._pulse_fn(
+                state, (ids, targets))
+            self._publish_pulse(mon, group_sq, gl, int(ids.shape[0]))
+            return new_state, loss
         return self._step_fn(state, (ids, targets))
+
+    def _publish_pulse(self, mon, group_sq, gl, global_batch: int) -> None:
+        norms = {k: math.sqrt(max(0.0, float(v)))
+                 for k, v in group_sq.items()}
+        if self._pure_dp():
+            n = int(self.plan.dp)
+            # sorted fold: the replayed sum must not depend on the
+            # param-kind dict's insertion order (docs/determinism.md)
+            gg = sum(float(group_sq[k]) for k in sorted(group_sq))
+            b_small = max(1, global_batch // max(1, n))
+            mon.update(float(gl), gg, b_small, n, group_norms=norms)
+        else:
+            # sharded meshes: the GNS pair is undefined (no rank holds
+            # a full small-batch gradient) — norms are still exact
+            mon.publish_norms(norms)
 
     # -- losses without update (for tests) ---------------------------------
     def loss(self, state, batch) -> jnp.ndarray:
@@ -719,7 +798,67 @@ def dp_train_step(
         return p, s, l
 
     donate_args = (0, 1) if donate else ()
-    return jax.jit(step3, donate_argnums=donate_args)
+    base = jax.jit(step3, donate_argnums=donate_args)
+
+    # -- kf-pulse: GNS/variance sampling on the replicated no-aux step --
+    # replicated_params=False trains intentionally DIVERGED replicas
+    # (SMA/AdaptiveSGD) — "one rank's gradient vs the mean" is not a
+    # small/large-batch pair there, so only the S-SGD shape samples.
+    from kungfu_tpu.monitor import pulse as pulselib
+
+    mon = pulselib.PulseMonitor.from_env() if replicated_params else None
+    if mon is None:
+        return base
+
+    from kungfu_tpu import ops
+    from kungfu_tpu.ops.monitor import _sq_norm
+
+    def body_pulse(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        # small-batch side: per-rank square norm, MEANed across peers
+        # (one extra scalar collective)
+        g_local_sq = jax.lax.pmean(_sq_norm(grads), axis)
+        # large-batch side: the mean gradient.  `tx` performs the
+        # identical mean-allreduce inside update(); when the ops match
+        # XLA CSEs the two psums into one, and this program only runs
+        # on 1-in-`every` steps regardless
+        avg = ops.group_all_reduce(grads, axis, op="mean")
+        g_global_sq = _sq_norm(avg)
+        updates, new_state = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return (new_params, new_state, jax.lax.pmean(loss, axis),
+                g_local_sq, g_global_sq)
+
+    def pulse_outer(params, opt_state, batch):
+        bspecs = jax.tree_util.tree_map(batch_spec, batch)
+        f = shard_map(
+            body_pulse,
+            mesh=mesh,
+            in_specs=(pspec, pspec, bspecs),
+            out_specs=(pspec, pspec, P(), P(), P()),
+            check_vma=False,
+        )
+        return f(params, opt_state, batch)
+
+    # compiled lazily on the first pulse step (never, for runs shorter
+    # than KF_PULSE_EVERY)
+    pulse_jit = jax.jit(pulse_outer, donate_argnums=donate_args)
+    n = int(comm.size)
+
+    def stepped(params, opt_state, batch):
+        if mon.should_sample():
+            p, s, loss, gl, gg = pulse_jit(params, opt_state, batch)
+            gl, gg = float(gl), float(gg)
+            leaves = jax.tree_util.tree_leaves(batch)
+            b_small = (max(1, int(leaves[0].shape[0]) // n)
+                       if (leaves and n) else 1)
+            mon.update(gl, gg, b_small, n,
+                       group_norms={"flat": max(0.0, gg) ** 0.5})
+            return p, s, loss
+        return base(params, opt_state, batch)
+
+    stepped.pulse = mon  # introspection hook for tests/tools
+    return stepped
 
 
 def stack_for_replicas(tree, n: int):

@@ -540,6 +540,13 @@ class ZeroStep:
     :meth:`init_params` once to carve the flat shard, then
     ``step(p_shard, opt_shard, batch)``; :meth:`gather_params`
     reassembles the full tree for eval/checkpoint/re-sync.
+
+    kf-pulse: stages 1/2 carry a second jit program (``step_pulse``)
+    that additionally returns the (local, reduced) gradient square-norm
+    pair; :attr:`pulse` gates which program runs per step
+    (``KF_PULSE_EVERY``) and publishes ``kf_gns`` /
+    ``kf_grad_variance`` / ``kf_grad_norm{group="flat"}``.  Off steps
+    and ``KF_PULSE_EVERY=0`` runs execute the bare program untouched.
     """
 
     def __init__(self, loss_fn, inner, comm, stage: int, average: bool,
@@ -564,6 +571,14 @@ class ZeroStep:
         self._schedule = schedule
         self._cache = {}
         self._g3 = None  # stage-3 active geometry (set by init_params)
+        from kungfu_tpu.monitor import pulse as pulselib
+        #: kf-pulse gradient-signal monitor (None when KF_PULSE_EVERY=0).
+        #: Stages 1/2 only: stage 3 never materializes a per-rank FULL
+        #: flat gradient (the backward pass emits the bucketed
+        #: reduce-scatter directly), so the small-batch side of the GNS
+        #: pair does not exist there without a second gradient pass.
+        self.pulse = (pulselib.PulseMonitor.from_env()
+                      if stage in (1, 2) else None)
 
     # -- back-compat unpacking: step, init_opt = zero_train_step(...) -----
     def __iter__(self):
@@ -578,7 +593,25 @@ class ZeroStep:
         if self.stage == 3:
             built = self._require_g3()
             return built["step"](params, opt_shard, batch)
-        return self._get(params)["step"](params, opt_shard, batch)
+        built = self._get(params)
+        mon = self.pulse
+        if mon is not None and mon.should_sample():
+            # kf-pulse step: the SECOND jit program returns the
+            # already-reduced square-norm pair on top of the normal
+            # outputs; off steps run the bare program untouched
+            p, opt_shard, loss, gl, gg = built["step_pulse"](
+                params, opt_shard, batch)
+            self._publish_pulse(mon, float(gl), float(gg), batch)
+            return p, opt_shard, loss
+        return built["step"](params, opt_shard, batch)
+
+    def _publish_pulse(self, mon, g_local_sq, g_global_sq, batch):
+        n = int(self.comm.size)
+        leaves = jax.tree_util.tree_leaves(batch)
+        b_small = (int(leaves[0].shape[0]) // n) if (leaves and n) else 1
+        mon.update(g_local_sq, g_global_sq, max(1, b_small), n,
+                   group_norms={
+                       "flat": math.sqrt(max(0.0, g_global_sq))})
 
     def init_opt(self, params):
         out = self._get(params)["init_opt"](params)
@@ -661,45 +694,95 @@ class ZeroStep:
         if self.stage in (1, 2):
             from kungfu_tpu.ops.pallas._sharding import match_vma
 
-            def step_body(p, opt_shard, batch):
-                p_var = jax.tree_util.tree_map(
-                    lambda a: match_vma(a, frozenset(geo.axes_t)), p)
-                loss, grads = jax.value_and_grad(loss_fn)(p_var, batch)
-                g = geo.flat_of(grads)
-                if self.stage == 1:
-                    # the classic ZeRO-1 all-reduce path: every device
-                    # sees the full reduced gradient, then updates only
-                    # its own chunk — 2x the wire bytes of the stage-2
-                    # reduce-scatter (the measured delta in bench --zero)
-                    for ax in geo.scatter_axes:
-                        g = lax.psum(g, ax)
-                    g_shard = lax.dynamic_slice(
-                        g, (geo.my_offset(),), (chunk,))
-                else:
-                    g_shard = reduce_scatter_flat(
-                        g, geo.scatter_axes, chunk, geo.widths,
-                        schedule=self._schedule)
-                if average:
-                    g_shard = g_shard / n
-                p_shard = lax.dynamic_slice(
-                    geo.flat_of(p), (geo.my_offset(),), (chunk,))
-                updates, opt_shard = inner.update(g_shard, opt_shard, p_shard)
-                p_shard = optax.apply_updates(p_shard, updates)
-                loss = lax.pmean(loss, axes)
-                return p_shard, opt_shard, loss
+            def make_body(with_pulse):
+                def step_body(p, opt_shard, batch):
+                    p_var = jax.tree_util.tree_map(
+                        lambda a: match_vma(a, frozenset(geo.axes_t)), p)
+                    loss, grads = jax.value_and_grad(loss_fn)(p_var, batch)
+                    g = geo.flat_of(grads)
+                    gl_sq = gg_sq = None
+                    if with_pulse:
+                        # kf-pulse small-batch side: this rank's flat
+                        # gradient square norm.  The cross-peer MEAN
+                        # lands below — stage 1 pmeans it directly;
+                        # stage 2 folds it into ONE stacked psum with
+                        # the shard term, so a pulse sample costs a
+                        # single extra scalar collective either way
+                        gl_sq = jnp.sum(
+                            jnp.square(g.astype(jnp.float32)))
+                    if self.stage == 1:
+                        # the classic ZeRO-1 all-reduce path: every device
+                        # sees the full reduced gradient, then updates only
+                        # its own chunk — 2x the wire bytes of the stage-2
+                        # reduce-scatter (the measured delta in bench --zero)
+                        for ax in geo.scatter_axes:
+                            g = lax.psum(g, ax)
+                        if with_pulse:
+                            for ax in geo.scatter_axes:
+                                gl_sq = lax.pmean(gl_sq, ax)
+                            # g is the full SUMMED gradient (replicated):
+                            # |mean|^2 = |sum|^2 / n^2 — no collective
+                            gg_sq = jnp.sum(
+                                jnp.square(g.astype(jnp.float32))
+                            ) / float(n * n)
+                        g_shard = lax.dynamic_slice(
+                            g, (geo.my_offset(),), (chunk,))
+                    else:
+                        g_shard = reduce_scatter_flat(
+                            g, geo.scatter_axes, chunk, geo.widths,
+                            schedule=self._schedule)
+                        if with_pulse:
+                            # the shards tile the summed flat buffer
+                            # disjointly, so psum of the shard square
+                            # norms IS |sum|^2; stacked with the local
+                            # term both scalars ride one psum (psum/n
+                            # is bitwise what pmean lowers to)
+                            pair = jnp.stack([gl_sq, jnp.sum(
+                                jnp.square(g_shard.astype(jnp.float32)))])
+                            for ax in geo.scatter_axes:
+                                pair = lax.psum(pair, ax)
+                            gl_sq = pair[0] / float(n)
+                            gg_sq = pair[1] / float(n * n)
+                    if average:
+                        g_shard = g_shard / n
+                    p_shard = lax.dynamic_slice(
+                        geo.flat_of(p), (geo.my_offset(),), (chunk,))
+                    updates, opt_shard = inner.update(
+                        g_shard, opt_shard, p_shard)
+                    p_shard = optax.apply_updates(p_shard, updates)
+                    loss = lax.pmean(loss, axes)
+                    if with_pulse:
+                        return p_shard, opt_shard, loss, gl_sq, gg_sq
+                    return p_shard, opt_shard, loss
+                return step_body
 
             inner_step = shard_map(
-                step_body, mesh=mesh,
+                make_body(False), mesh=mesh,
                 in_specs=(P(), state_specs, P(axes)),
                 out_specs=(P(axes), state_specs, P()),
+            )
+            inner_pulse = shard_map(
+                make_body(True), mesh=mesh,
+                in_specs=(P(), state_specs, P(axes)),
+                out_specs=(P(axes), state_specs, P(), P(), P()),
             )
 
             def outer(p, opt_shard, batch):
                 p_flat, opt_shard, loss = inner_step(p, opt_shard, batch)
                 return regather(p_flat), opt_shard, loss
 
+            def outer_pulse(p, opt_shard, batch):
+                p_flat, opt_shard, loss, gl, gg = inner_pulse(
+                    p, opt_shard, batch)
+                return regather(p_flat), opt_shard, loss, gl, gg
+
             step = jax.jit(outer, donate_argnums=(0, 1) if donate else ())
-            return {"geo": geo, "step": step, "init_opt": init_opt}
+            # compiled lazily on the first pulse step (never, for runs
+            # shorter than KF_PULSE_EVERY)
+            step_pulse = jax.jit(
+                outer_pulse, donate_argnums=(0, 1) if donate else ())
+            return {"geo": geo, "step": step, "step_pulse": step_pulse,
+                    "init_opt": init_opt}
 
         # -- stage 3: params live sharded; gather is JIT inside the step --
         def init_params_body(p):

@@ -227,6 +227,13 @@ class OverlapDepthBandit:
         pick = self.stats.select()
         if pick == self.active:
             return False
+        from kungfu_tpu.monitor import ledger
+
+        # kf-ledger: depth changes are local (no consensus fence — the
+        # depth is not collective-shape-bearing), so consensus_seq=None
+        ledger.record_decision(
+            "overlap-depth", "depth", int(self.active), int(pick),
+            evidence={"checks": self._n // self.check_every})
         self.active = pick
         self._engine.set_overlap_depth(int(pick))
         self.swaps += 1

@@ -46,4 +46,8 @@ def sentinel_signals(view: dict) -> Optional[dict]:
                              if r.startswith("watermark:")),
         "fired_total": len(fired),
         "verdicts": verdicts,
+        # the kf-ledger rollup: how many decisions the adaptive actors
+        # made and how their measured effects judged (None on builds
+        # whose sentinel predates the ledger)
+        "decisions": field(al, "decisions"),
     }

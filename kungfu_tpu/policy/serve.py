@@ -83,16 +83,29 @@ class BatchWidthController:
         if over and self.width > self.lo:
             # blowing the SLO: shed decode width — fewer slots per step
             # shortens every active request's per-token latency
-            self.width = self._apply(self.width - 1)
+            prev, self.width = self.width, self._apply(self.width - 1)
             self._cool = self.cooldown_steps
+            self._record(prev, queued, e2e_ms)
             _log.info("batch width -> %d (e2e %.0fms > %.0fms budget)",
                       self.width, e2e_ms, budget_ms)
         elif (not over and queued >= self.widen_at_queue
               and self.width < self.hi):
-            self.width = self._apply(self.width + 1)
+            prev, self.width = self.width, self._apply(self.width + 1)
             self._cool = self.cooldown_steps
+            self._record(prev, queued, e2e_ms)
             _log.info("batch width -> %d (queue %d)", self.width, queued)
         return self.width
+
+    def _record(self, prev: int, queued: int,
+                e2e_ms: Optional[float]) -> None:
+        from kungfu_tpu.monitor import ledger
+
+        # kf-ledger: width moves answer to serving latency, not step
+        # time — the effect series is the e2e window mean
+        ledger.record_decision(
+            "batch-width", "width", prev, self.width,
+            evidence={"queued": int(queued), "e2e_ms": e2e_ms},
+            effect_series="e2e_ms")
 
     def observe_view(self, view: dict) -> int:
         sig = serve_signals(view)
@@ -147,11 +160,24 @@ class ServeAutoscalePolicy(BasePolicy):
                 and ctx.cluster_size < self.max_workers):
             _log.info("autoscale: +1 worker (queue %d, e2e %.0fms)",
                       queued, e2e)
+            self._record(ctx.cluster_size, ctx.cluster_size + 1,
+                         queued, e2e)
             ctx.request_resize(ctx.cluster_size + 1)
             self._cool = self.cooldown_steps
         elif (queued == 0 and active == 0 and not over
               and ctx.cluster_size > self.min_workers
               and (e2e is None or e2e < 0.25 * budget_ms)):
             _log.info("autoscale: -1 worker (idle)")
+            self._record(ctx.cluster_size, ctx.cluster_size - 1,
+                         queued, e2e)
             ctx.request_resize(ctx.cluster_size - 1)
             self._cool = self.cooldown_steps
+
+    @staticmethod
+    def _record(prev: int, new: int, queued: int, e2e) -> None:
+        from kungfu_tpu.monitor import ledger
+
+        ledger.record_decision(
+            "serve-autoscale", "workers", prev, new,
+            evidence={"queued": int(queued), "e2e_ms": e2e},
+            effect_series="e2e_ms")

@@ -34,7 +34,7 @@ from __future__ import annotations
 
 from typing import Callable, List, Optional, Tuple
 
-from kungfu_tpu.monitor import timeline
+from kungfu_tpu.monitor import ledger, timeline
 from kungfu_tpu.policy.base import PolicyContext
 from kungfu_tpu.policy.serve import ServeAutoscalePolicy
 from kungfu_tpu.utils.log import get_logger
@@ -156,6 +156,11 @@ class ServeFleet:
                 spawned.append(r)
             timeline.event("serve", "scale-up", rank=peer.chaos_rank(),
                            ranks=spawned, target=aligned)
+            ledger.record_decision(
+                "serve-fleet", "workers", len(live),
+                len(live) + len(spawned),
+                evidence={"ranks": spawned, "target": aligned},
+                effect_series="e2e_ms")
             _log.info("autoscale: spawned workers %s (target %d)",
                       spawned, aligned)
             return spawned
@@ -203,6 +208,11 @@ class ServeFleet:
         if victims:
             timeline.event("serve", "scale-down", rank=peer.chaos_rank(),
                            ranks=victims, target=aligned)
+            ledger.record_decision(
+                "serve-fleet", "workers", len(live),
+                len(live) - len(victims),
+                evidence={"ranks": victims, "target": aligned},
+                effect_series="e2e_ms")
             _log.info("autoscale: retired workers %s (target %d)",
                       victims, aligned)
         return []

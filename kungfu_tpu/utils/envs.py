@@ -259,6 +259,21 @@ pins the defaults both sides must agree on):
                                default 24 (serve/slo.py SLORules)
 =============================  ================================================
 
+Pulse envs (kf-pulse gradient-signal monitoring,
+:mod:`kungfu_tpu.monitor.pulse`; see docs/pulse.md — the pulse module
+reads these via mirror constants, same stdlib-only doctrine as the
+sentinel; :func:`pulse_knobs` pins the shared defaults):
+
+=============================  ================================================
+``KF_PULSE_EVERY``             sample the gradient-noise-scale /
+                               variance pair every N training steps,
+                               default 10; <= 0 disables the pulse
+                               plane (``PulseMonitor.from_env`` returns
+                               None and the step is byte-identical)
+``KF_PULSE_EMA``               EMA weight for smoothing the per-sample
+                               GNS/variance estimates, default 0.2
+=============================  ================================================
+
 Fault-injection envs (the chaos layer, :mod:`kungfu_tpu.chaos`; see
 docs/fault_tolerance.md for the full matrix):
 
@@ -485,6 +500,12 @@ SENTINEL_INCIDENT_WINDOW = "KF_SENTINEL_INCIDENT_WINDOW"
 SENTINEL_SLO_SHORT = "KF_SENTINEL_SLO_SHORT"
 SENTINEL_SLO_LONG = "KF_SENTINEL_SLO_LONG"
 
+# kf-pulse envs (monitor/pulse.py defines mirror constants next to its
+# reader, same doctrine as the sentinel tokens above; pulse_knobs()
+# below pins the defaults both sides must agree on)
+PULSE_EVERY = "KF_PULSE_EVERY"
+PULSE_EMA = "KF_PULSE_EMA"
+
 # fault-injection envs (read by kungfu_tpu/chaos/inject.py at controller
 # creation; registered here so the env-contract scan anchors them to the
 # same registry as every other KF_* knob)
@@ -577,6 +598,20 @@ def sentinel_knobs() -> dict:
         "incident_window": parse_int_env(SENTINEL_INCIDENT_WINDOW, 64),
         "slo_short": parse_int_env(SENTINEL_SLO_SHORT, 6),
         "slo_long": parse_int_env(SENTINEL_SLO_LONG, 24),
+    }
+
+
+def pulse_knobs() -> dict:
+    """The kf-pulse plane knobs, parsed with their defaults.
+
+    monitor/pulse.py reads the same tokens from ``os.environ`` directly
+    (mirror constants, same doctrine as :func:`sentinel_knobs`); tests
+    pin that both sides use these exact defaults so the documented
+    contract cannot drift.
+    """
+    return {
+        "every": parse_int_env(PULSE_EVERY, 10),
+        "ema": parse_float_env(PULSE_EMA, 0.2),
     }
 
 

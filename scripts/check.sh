@@ -4,7 +4,7 @@
 #
 #   scripts/check.sh
 #
-# 1. kflint        — all eighteen project-invariant checkers, including
+# 1. kflint        — all nineteen project-invariant checkers, including
 #                    the kf-verify interprocedural rules and the
 #                    kf-shard axis-environment rules (docs/lint.md),
 #                    over kungfu_tpu/, scripts/, benchmarks/, examples/,
@@ -24,6 +24,10 @@
 #                    geometry <= 16 ranks, docs/lint.md) also gates
 #                    empty — a divergent collective or an orphan tag is
 #                    a distributed hang waiting to happen, never debt.
+# 1e. ledger-schema— decision-ledger field names literal + declared in
+#                    LEDGER_FIELDS, rerun WITHOUT the baseline: a typo'd
+#                    field silently drops a decision's evidence from the
+#                    kfhist --decisions replay — never debt.
 # 1d. kf-det       — replay-taint / rng-discipline / reduction-order
 #                    rerun WITHOUT the baseline: entropy reaching a
 #                    consensus/rendezvous/commit/manifest sink, a
@@ -91,6 +95,13 @@ echo "== empty-baseline gate (kf-det: replay-taint, rng-discipline, reduction-or
 # finding here means a restart or replica would not reproduce bitwise
 if ! python3 scripts/kflint --checker replay-taint \
         --checker rng-discipline --checker reduction-order; then
+    fail=1
+fi
+
+echo "== empty-baseline gate (ledger-schema: decision-ledger field literacy)"
+# no --baseline on purpose: a schema typo in a decision record never
+# ratchets — the offline effect replay would silently lose evidence
+if ! python3 scripts/kflint --checker ledger-schema; then
     fail=1
 fi
 

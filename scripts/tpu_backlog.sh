@@ -46,6 +46,11 @@ run pp         1500 python bench.py --pp
 # 1-core GIL steal) and the goodput row exercises multi-host manifests
 # on the shared filesystem
 run persist    1500 python bench.py --persist
+# kf-pulse: on a real pod the overhead row gains a true denominator
+# (real ICI scalar collectives are ~us, so the <=2% gate has far more
+# margin than the CPU-mesh run) and the GNS estimate lands on a real
+# model's gradients instead of the mlp stand-in
+run pulse      1500 python bench.py --pulse
 run xent_cross 1800 python benchmarks/xent_sweep.py --crossover
 run bn_sweep   1800 python benchmarks/bn_sweep.py
 run longctx    1500 python bench.py --kernels --seq-len 8192

@@ -1,0 +1,59 @@
+"""ledger-schema fixture: typo'd / dynamic decision-ledger field names."""
+
+from kungfu_tpu.monitor import ledger
+from kungfu_tpu.monitor.ledger import lfield as lf, ledger_record, record_decision
+
+
+def good_reads(rec):
+    actor = ledger.lfield(rec, "actor")  # in schema: clean
+    return actor, lf(rec, "verdict")  # through the alias: clean
+
+
+def typo_read(rec):
+    return ledger.lfield(rec, "actr")  # typo: flagged
+
+
+def dynamic_read(rec, k):
+    return lf(rec, k)  # dynamic: flagged
+
+
+def no_name(rec):
+    return ledger.lfield(rec)  # missing name: flagged
+
+
+def good_record():
+    return ledger_record(actor="x", knob="k", old=1, new=2)  # clean
+
+
+def typo_record():
+    return ledger_record(actor="x", knbo="k")  # typo'd field: flagged
+
+
+def splat_record(extra):
+    return ledger_record(actor="x", **extra)  # dynamic splat: flagged
+
+
+def good_decision():
+    record_decision("x", "k", 1, 2, evidence={"why": 1})  # clean
+
+
+def typo_decision():
+    record_decision("x", "k", 1, 2, evidnce={})  # typo'd field: flagged
+
+
+def waived(rec, k):
+    return ledger.lfield(rec, k)  # kflint: allow(ledger-schema)
+
+
+class Unrelated:
+    def lfield(self, *a):
+        return self
+
+    def ledger_record(self, *a):
+        return self
+
+
+def not_the_ledger():
+    u = Unrelated()
+    u.lfield("whatever")  # other receiver: NOT flagged
+    u.ledger_record(bogus=1)

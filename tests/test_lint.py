@@ -16,6 +16,7 @@ from kungfu_tpu.analysis import (
     envcheck,
     handlecheck,
     jitpurity,
+    ledgerschema,
     lockcheck,
     protoverify,
     pylockorder,
@@ -510,6 +511,76 @@ class TestAggSchema:
     def test_no_aggregator_module_is_silent(self, tmp_path):
         root = _tmp_tree(tmp_path, {"kungfu_tpu/mod.py": "aggschema_bad.py"})
         assert aggschema.check(root) == []
+
+
+MINI_LEDGER = (
+    "LEDGER_FIELDS = frozenset({\n"
+    '    "kfledger", "actor", "knob", "old", "new",\n'
+    '    "evidence", "verdict", "effect_series",\n'
+    "})\n"
+)
+
+
+class TestLedgerSchema:
+    """The decision-ledger sibling of agg-schema: ledger.lfield() names
+    and ledger_record()/record_decision() keywords must be literals from
+    the declared LEDGER_FIELDS schema — a typo'd field silently drops a
+    decision's evidence from the offline replay instead of erroring."""
+
+    def _tree(self, tmp_path):
+        return _tmp_tree(tmp_path, {
+            "kungfu_tpu/monitor/ledger.py": MINI_LEDGER,
+            "kungfu_tpu/mod.py": "ledgerschema_bad.py",
+        })
+
+    def test_fixture_violations_caught(self, tmp_path):
+        got = sorted((v.line, v.message)
+                     for v in ledgerschema.check(self._tree(tmp_path)))
+        assert [line for line, _ in got] == [13, 17, 21, 29, 33, 41], got
+        assert "'actr'" in got[0][1]
+        assert "must be a string literal" in got[1][1]
+        assert "without a field name" in got[2][1]
+        assert "'knbo'" in got[3][1]
+        assert "**dynamic" in got[4][1]
+        assert "'evidnce'" in got[5][1]
+
+    def test_suppression_honored(self, tmp_path):
+        flagged = {v.line
+                   for v in ledgerschema.check(self._tree(tmp_path))}
+        assert 45 not in flagged, flagged  # the waived dynamic read
+
+    def test_unrelated_receivers_not_flagged(self, tmp_path):
+        flagged = {v.line
+                   for v in ledgerschema.check(self._tree(tmp_path))}
+        assert 57 not in flagged and 58 not in flagged, flagged
+
+    def test_schema_mutation_is_caught(self, tmp_path):
+        # mutation check: drop "verdict" from the declared schema and the
+        # previously-clean read at line 9 must surface — proving the rule
+        # reads the live declaration rather than a hardcoded field list
+        root = _tmp_tree(tmp_path, {
+            "kungfu_tpu/monitor/ledger.py":
+                MINI_LEDGER.replace('"verdict", ', ""),
+            "kungfu_tpu/mod.py": "ledgerschema_bad.py",
+        })
+        flagged = {v.line for v in ledgerschema.check(root)}
+        assert 9 in flagged, flagged
+
+    def test_schema_parsed_from_real_tree(self):
+        from kungfu_tpu.analysis.ledgerschema import _schema
+        from kungfu_tpu.monitor.ledger import LEDGER_FIELDS
+
+        assert _schema(ROOT) == set(LEDGER_FIELDS)
+
+    def test_actors_are_covered_and_clean(self):
+        # every adaptive actor writes through record_decision: in scan
+        # scope, no findings anywhere in the real tree
+        assert ledgerschema.check(ROOT) == []
+
+    def test_no_ledger_module_is_silent(self, tmp_path):
+        root = _tmp_tree(tmp_path,
+                         {"kungfu_tpu/mod.py": "ledgerschema_bad.py"})
+        assert ledgerschema.check(root) == []
 
 
 class TestBaselineAndJson:

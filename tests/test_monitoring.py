@@ -65,17 +65,67 @@ class TestHostNoiseScale:
 
     def test_single_worker_reports_no_signal(self):
         """b_small == b_big on one worker: the two-batch estimator is
-        undefined; callers treat <=0 as "no signal" and must get 0.0,
-        not a division artifact."""
+        undefined; callers must get ``None`` ("no estimate"), not 0.0 —
+        a zero would read as a measured noise scale of zero and the
+        pulse plane would EMA it into the published gauge."""
         from kungfu_tpu.ops.monitor import host_noise_scale
 
         chans, engines = self._engines(23720, 1)
         try:
             g = np.random.RandomState(0).uniform(-1, 1, 32).astype(np.float32)
-            assert host_noise_scale(engines[0], g, g, 16) == 0.0
+            assert host_noise_scale(engines[0], g, g, 16) is None
         finally:
             for c in chans:
                 c.close()
+
+    @pytest.mark.parametrize("n", [3, 5])
+    def test_non_power_of_two_world_matches_in_graph(self, n):
+        """The one-estimator property across ODD world sizes: the
+        host-plane value over a real n-peer engine equals the in-graph
+        ``global_noise_scale`` over an n-device mesh on the SAME
+        per-peer gradients.  Non-power-of-two sizes exercise the
+        b_big = n*b_small arithmetic where a pairwise-halving mental
+        model would silently diverge."""
+        import jax
+        from jax.sharding import Mesh
+        from jax.sharding import PartitionSpec as P
+
+        import kungfu_tpu.ops.collective as kc
+        from kungfu_tpu.ops.monitor import global_noise_scale, host_noise_scale
+        from kungfu_tpu.utils.jaxcompat import shard_map
+
+        b_small = 8.0
+        rng = np.random.RandomState(100 + n)
+        base = rng.uniform(1.0, 2.0, 48)
+        grads = np.stack(
+            [base + 0.1 * rng.uniform(-1, 1, 48) for _ in range(n)]
+        ).astype(np.float32)
+
+        chans, engines = self._engines(23740 + 10 * n, n)
+        try:
+            def one(i):
+                avg = engines[i].all_reduce(grads[i], op="mean")
+                return host_noise_scale(engines[i], grads[i], avg, b_small)
+
+            host_vals = run_all([lambda i=i: one(i) for i in range(n)])
+        finally:
+            for c in chans:
+                c.close()
+        assert all(v is not None for v in host_vals)
+        # symmetric: every rank publishes the same estimate
+        for v in host_vals[1:]:
+            assert host_vals[0] == pytest.approx(v, rel=1e-9)
+
+        mesh = Mesh(np.array(jax.devices()[:n]), ("kf",))
+
+        def gns_fn(g):
+            avg = kc.all_reduce(g, "kf", op="mean")
+            return global_noise_scale(g, avg, b_small, "kf")[None]
+
+        got = shard_map(gns_fn, mesh=mesh, in_specs=P("kf"),
+                        out_specs=P("kf"))(grads)
+        in_graph = float(np.asarray(got)[0])
+        assert host_vals[0] == pytest.approx(in_graph, rel=1e-3)
 
     def test_two_peer_engine_matches_in_graph_estimator(self):
         """The host-plane estimate over a real 2-peer CollectiveEngine

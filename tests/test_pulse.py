@@ -1,0 +1,663 @@
+"""kf-pulse tests: the GNS/variance estimator math, the PulseMonitor
+gating/EMA/gauge contract, the decision ledger (online judging, durable
+streams, byte-identical offline replay, closed schema), the monitoring
+surfaces that carry the signal (aggregator rollup, kftop PULSE section,
+sentinel ``regress:gns``, ``/decisions`` route, ``kfhist --decisions``,
+``policy.sentinel_signals``), and THE acceptance chain: a real
+``zero_train_step`` loop whose measured ``kf_gns`` flows rank ->
+reporter -> aggregator ``/cluster`` -> kftop -> sentinel alert."""
+
+import json
+import math
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from kungfu_tpu.comm.device import Communicator
+from kungfu_tpu.monitor import detect, history, kfhist, kftop, timeline
+from kungfu_tpu.monitor import ledger as ledgerlib
+from kungfu_tpu.monitor import pulse as pulselib
+from kungfu_tpu.monitor.aggregator import (
+    ClusterAggregator,
+    RankReporter,
+    field,
+    make_snapshot,
+)
+from kungfu_tpu.monitor.registry import REGISTRY
+from kungfu_tpu.monitor.sentinel import Sentinel, extract_series
+from kungfu_tpu.parallel.zero import zero_train_step
+from kungfu_tpu.utils import envs
+
+N_DEV = 4
+
+#: every env the pulse/ledger planes key off — these tests must see a
+#: clean environment regardless of the invoking shell
+_PULSE_ENVS = (
+    "KF_PULSE_EVERY", "KF_PULSE_EMA",
+    "KF_SENTINEL_DIR", "KF_SENTINEL_WINDOW", "KF_SENTINEL_THRESHOLD",
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_pulse_env(monkeypatch):
+    for tok in _PULSE_ENVS:
+        monkeypatch.delenv(tok, raising=False)
+    ledgerlib.reset()
+    yield
+    ledgerlib.reset()
+
+
+def _mesh(tmp_path, **kw):
+    """Fake-clock aggregator + attached sentinel (the test_sentinel.py
+    idiom): one ingest per logical step, clock bumped 1 s after each."""
+    clock = [1000.0]
+    agg = ClusterAggregator(stale_after=3600.0, time_fn=lambda: clock[0])
+    kw.setdefault("window", 4)
+    s = Sentinel(str(tmp_path), period_s=1.0, **kw)
+    agg.attach_sentinel(s)
+    return agg, s, clock
+
+
+def _drive(agg, clock, step, step_time_s, **extra):
+    agg.ingest(make_snapshot(rank=0, step=step, step_time_s=step_time_s,
+                             wall=clock[0], **extra))
+    clock[0] += 1.0
+
+
+# -- the estimator math ------------------------------------------------------
+class TestNoiseScale:
+    def test_hand_derived_value(self):
+        # gl=3, gg=1, b_small=8, n=4 (b_big=32):
+        #   |G|^2 = (32*1 - 8*3) / 24 = 1/3
+        #   S     = (3 - 1) / (1/8 - 1/32) = 64/3
+        #   GNS   = S / |G|^2 = 64
+        assert pulselib.noise_scale(3.0, 1.0, 8.0, 4) \
+            == pytest.approx(64.0)
+
+    def test_none_below_two_workers(self):
+        assert pulselib.noise_scale(3.0, 1.0, 8.0, 1) is None
+        assert pulselib.noise_scale(3.0, 1.0, 8.0, 0) is None
+
+    def test_variance_is_clamped_nonnegative(self):
+        assert pulselib.grad_variance(3.0, 1.0) == pytest.approx(2.0)
+        # float cancellation must not report negative variance
+        assert pulselib.grad_variance(1.0, 1.0 + 1e-9) == 0.0
+
+
+class TestPulseMonitor:
+    def test_from_env_disable_and_parse(self, monkeypatch):
+        monkeypatch.setenv(pulselib.EVERY_ENV, "0")
+        assert pulselib.PulseMonitor.from_env() is None
+        monkeypatch.setenv(pulselib.EVERY_ENV, "-3")
+        assert pulselib.PulseMonitor.from_env() is None
+        monkeypatch.setenv(pulselib.EVERY_ENV, "7")
+        assert pulselib.PulseMonitor.from_env().every == 7
+        monkeypatch.delenv(pulselib.EVERY_ENV)
+        assert pulselib.PulseMonitor.from_env().every \
+            == pulselib.DEFAULT_EVERY
+        monkeypatch.setenv(pulselib.EVERY_ENV, "bogus")
+        assert pulselib.PulseMonitor.from_env().every \
+            == pulselib.DEFAULT_EVERY
+
+    def test_counter_gate_first_sample_at_every_th_call(self):
+        # step 0 is the compile transient: the counter path must NOT
+        # sample the first call, so short runs never pay the
+        # instrumented program's compile
+        mon = pulselib.PulseMonitor(every=3)
+        assert [mon.should_sample() for _ in range(7)] \
+            == [False, False, True, False, False, True, False]
+
+    def test_explicit_step_gate_is_modular(self):
+        mon = pulselib.PulseMonitor(every=4)
+        assert mon.should_sample(step=0)
+        assert not any(mon.should_sample(step=i) for i in (1, 2, 3))
+        assert mon.should_sample(step=4)
+        # explicit steps never advance the internal counter
+        assert [mon.should_sample() for _ in range(4)] \
+            == [False, False, False, True]
+
+    def test_update_smooths_and_publishes(self):
+        mon = pulselib.PulseMonitor(every=1, ema_alpha=0.5)
+        out = mon.update(3.0, 1.0, 8.0, 4)
+        assert out["gns_raw"] == pytest.approx(64.0)
+        assert out["gns"] == pytest.approx(64.0)       # first sample = raw
+        assert out["grad_variance_raw"] == pytest.approx(2.0)
+        snap = REGISTRY.snapshot()
+        assert snap["kf_gns"] == pytest.approx(64.0)
+        assert snap["kf_grad_variance"] == pytest.approx(2.0)
+        out = mon.update(1.0, 1.0, 8.0, 4)             # raw gns/var = 0
+        assert out["gns"] == pytest.approx(32.0)       # 0.5*64 + 0.5*0
+        assert out["grad_variance"] == pytest.approx(1.0)
+        assert REGISTRY.snapshot()["kf_gns"] == pytest.approx(32.0)
+        assert mon.samples == 2
+
+    def test_single_worker_leaves_gns_gauge_untouched(self):
+        REGISTRY.gauge("kf_gns").set(123.0)
+        mon = pulselib.PulseMonitor(every=1)
+        out = mon.update(3.0, 1.0, 8.0, 1)
+        assert out["gns"] is None and out["gns_raw"] is None
+        # the variance is still defined (and published) on one worker
+        assert out["grad_variance"] == pytest.approx(2.0)
+        assert REGISTRY.snapshot()["kf_gns"] == pytest.approx(123.0)
+
+    def test_publish_norms_labeled_gauges(self):
+        mon = pulselib.PulseMonitor(every=1)
+        mon.publish_norms({"moe": 2.5, "dense": 0.5})
+        snap = REGISTRY.snapshot()
+        assert snap['kf_grad_norm{group="moe"}'] == pytest.approx(2.5)
+        assert snap['kf_grad_norm{group="dense"}'] == pytest.approx(0.5)
+
+
+class TestKnobParity:
+    def test_env_tokens_match(self):
+        assert envs.PULSE_EVERY == pulselib.EVERY_ENV == "KF_PULSE_EVERY"
+        assert envs.PULSE_EMA == pulselib.EMA_ENV == "KF_PULSE_EMA"
+
+    def test_defaults_match(self):
+        kb = envs.pulse_knobs()
+        assert kb["every"] == pulselib.DEFAULT_EVERY
+        assert kb["ema"] == pulselib.DEFAULT_EMA_ALPHA
+
+    def test_env_overrides_flow_both_sides(self, monkeypatch):
+        monkeypatch.setenv(envs.PULSE_EVERY, "5")
+        monkeypatch.setenv(envs.PULSE_EMA, "0.5")
+        assert envs.pulse_knobs() == {"every": 5, "ema": 0.5}
+        mon = pulselib.PulseMonitor.from_env()
+        assert mon.every == 5 and mon.ema_alpha == 0.5
+
+
+# -- the decision ledger -----------------------------------------------------
+class TestLedgerSchema:
+    def test_unknown_write_field_raises(self):
+        with pytest.raises(ValueError, match="bogus"):
+            ledgerlib.ledger_record(kind="decision", bogus=1)
+
+    def test_unknown_read_field_raises(self):
+        with pytest.raises(KeyError, match="bogus"):
+            ledgerlib.lfield({}, "bogus")
+
+    def test_read_tolerates_non_dict(self):
+        assert ledgerlib.lfield(None, "actor", "dflt") == "dflt"
+
+
+class TestDecisionLedger:
+    def _feed(self, led, values, series="step_time_s"):
+        out = []
+        for v in values:
+            out.extend(led.on_sample({"series": {series: v}}))
+        return out
+
+    def test_improved_verdict_and_join(self, tmp_path):
+        led = ledgerlib.DecisionLedger(str(tmp_path), window=3,
+                                       threshold=4.0)
+        self._feed(led, [1.0, 1.01, 0.99])
+        rec = led.decide("bandit-host", "strategy", "STAR", "MST",
+                         consensus_seq=7)
+        assert ledgerlib.lfield(rec, "seq") == 1
+        assert ledgerlib.lfield(rec, "series_n") == 3
+        effects = self._feed(led, [0.1, 0.12, 0.11])
+        assert len(effects) == 1
+        e = effects[0]
+        assert ledgerlib.lfield(e, "verdict") == "improved"
+        assert ledgerlib.lfield(e, "decision_seq") == 1
+        assert ledgerlib.lfield(e, "before_median") == pytest.approx(1.0)
+        assert ledgerlib.lfield(e, "after_median") == pytest.approx(0.11)
+        summ = led.summary()
+        assert summ["total"] == 1 and summ["judged"] == 1
+        assert summ["pending"] == 0
+        assert summ["by_verdict"] == {"improved": 1}
+        view = led.view()
+        assert view["kfledger"] == 1
+        row = view["decisions"][0]
+        assert ledgerlib.lfield(row["decision"], "actor") == "bandit-host"
+        assert ledgerlib.lfield(row["effect"], "verdict") == "improved"
+
+    def test_regressed_and_neutral_verdicts(self, tmp_path):
+        led = ledgerlib.DecisionLedger(str(tmp_path), window=3,
+                                       threshold=4.0)
+        self._feed(led, [1.0, 1.01, 0.99])
+        led.decide("a", "k", 1, 2)
+        (e,) = self._feed(led, [5.0, 5.1, 5.05])
+        assert ledgerlib.lfield(e, "verdict") == "regressed"
+        self._feed(led, [5.0] * 3)
+        led.decide("a", "k", 2, 3)
+        (e,) = self._feed(led, [5.0, 5.05, 5.02])
+        assert ledgerlib.lfield(e, "verdict") == "neutral"
+
+    def test_good_direction_up_flips_the_sign(self, tmp_path):
+        led = ledgerlib.DecisionLedger(str(tmp_path), window=3,
+                                       threshold=4.0)
+        self._feed(led, [1.0, 1.01, 0.99], series="mfu")
+        led.decide("scaler", "replicas", 4, 8, effect_series="mfu",
+                   good_direction="up")
+        (e,) = self._feed(led, [5.0, 5.1, 5.05], series="mfu")
+        assert ledgerlib.lfield(e, "verdict") == "improved"
+
+    def test_insufficient_without_baseline(self, tmp_path):
+        led = ledgerlib.DecisionLedger(str(tmp_path), window=3)
+        led.decide("a", "k", 1, 2)          # no BEFORE samples at all
+        effects = self._feed(led, [0.1, 0.1, 0.1])
+        assert [ledgerlib.lfield(e, "verdict") for e in effects] \
+            == ["insufficient"]
+        assert ledgerlib.lfield(effects[0], "before_median") is None
+
+    def test_pending_until_after_window_fills(self, tmp_path):
+        led = ledgerlib.DecisionLedger(str(tmp_path), window=3)
+        self._feed(led, [1.0] * 3)
+        led.decide("a", "k", 1, 2)
+        assert self._feed(led, [0.1, 0.1]) == []
+        assert led.summary()["pending"] == 1
+        assert len(self._feed(led, [0.1])) == 1
+
+    def test_judge_math_matches_detect_floors(self):
+        d = ledgerlib.ledger_record(
+            kfledger=1, kind="decision", seq=9, actor="a", knob="k",
+            window=4, threshold=4.0, effect_series="step_time_s",
+            good_direction="down")
+        before = [1.0, 1.1, 0.9, 1.0]
+        after = [0.5, 0.55, 0.45, 0.5]
+        e = ledgerlib.judge(d, before, after)
+        med = detect.median(before)
+        scale = max(detect.mad(before, med),
+                    detect.DEFAULT_REL_FLOOR * abs(med) / 4.0,
+                    detect.ABS_FLOOR)
+        want = (detect.median(after) - med) / scale
+        assert ledgerlib.lfield(e, "score") == round(want, 6)
+        assert ledgerlib.lfield(e, "verdict") == "improved"
+
+    def test_decision_ticks_counter_and_timeline(self, tmp_path):
+        before = REGISTRY.counter("kf_decisions_total",
+                                  actor="test-actor").value
+        led = ledgerlib.DecisionLedger(str(tmp_path), window=2)
+        cursor, _ = timeline.events_tail(0)
+        led.decide("test-actor", "k", 1, 2)
+        after = REGISTRY.counter("kf_decisions_total",
+                                 actor="test-actor").value
+        assert after == before + 1
+        # force=True: the mark lands in the ring even with tracing off
+        _, events = timeline.events_tail(cursor)
+        marks = [e for e in events if e.get("kind") == "decision"]
+        assert marks and marks[-1]["name"] == "test-actor"
+
+
+class TestRecordDecisionHook:
+    def test_inactive_without_sentinel_dir(self):
+        assert ledgerlib.active() is None
+        assert ledgerlib.record_decision("a", "k", 1, 2) is None
+
+    def test_active_routes_to_env_keyed_singleton(self, monkeypatch,
+                                                  tmp_path):
+        monkeypatch.setenv("KF_SENTINEL_DIR", str(tmp_path))
+        monkeypatch.setenv("KF_SENTINEL_WINDOW", "3")
+        rec = ledgerlib.record_decision("bandit-host", "strategy",
+                                        "STAR", "MST")
+        assert ledgerlib.lfield(rec, "actor") == "bandit-host"
+        led = ledgerlib.active()
+        assert led is ledgerlib.ledger_for(str(tmp_path))
+        assert led.window == 3
+        records, skipped = history.scan_stream(
+            str(tmp_path), ledgerlib.DECISIONS_STREAM)
+        assert skipped == 0 and len(records) == 1
+        assert records[0]["kind"] == "decision"
+
+    def test_never_raises_through_actor(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("KF_SENTINEL_DIR", str(tmp_path))
+
+        def boom(self, *a, **kw):
+            raise RuntimeError("unwritable ledger")
+
+        monkeypatch.setattr(ledgerlib.DecisionLedger, "decide", boom)
+        assert ledgerlib.record_decision("a", "k", 1, 2) is None
+
+
+class TestOfflineReplay:
+    def _run(self, root, window=3):
+        """Durable online run: cluster stream + ledger fed the EXACT
+        same records (the sentinel's _observe_locked contract)."""
+        led = ledgerlib.ledger_for(root, window=window)
+        ring = history.HistoryRing(root, "cluster")
+
+        def feed(v):
+            rec = {"series": {"step_time_s": v}}
+            ring.append(rec)
+            led.on_sample(rec)
+
+        for v in [1.0, 1.02, 0.98]:
+            feed(v)
+        led.decide("bandit-host", "strategy", "STAR", "MST")
+        for v in [0.1, 0.12, 0.11]:
+            feed(v)
+        led.decide("bandit-host", "strategy", "MST", "RING")
+        for v in [0.1, 0.11]:
+            feed(v)                         # second decision stays pending
+        return led
+
+    def test_replay_is_byte_identical(self, tmp_path):
+        self._run(str(tmp_path))
+        out = ledgerlib.replay_effects(str(tmp_path))
+        judged = [r for r in out["decisions"] if r["online"] is not None]
+        assert len(judged) == 1
+        for row in judged:
+            assert json.dumps(row["online"], sort_keys=True) \
+                == json.dumps(row["replayed"], sort_keys=True)
+
+    def test_kfhist_decisions_flags_matches(self, tmp_path):
+        self._run(str(tmp_path))
+        out = kfhist.decisions_from_dir(str(tmp_path))
+        matches = [r["match"] for r in out["decisions"]]
+        assert matches == [True, None]      # judged + still pending
+
+    def test_kfhist_cli_decisions_json(self, tmp_path, capsys):
+        self._run(str(tmp_path))
+        rc = kfhist.main(["--dir", str(tmp_path), "--decisions", "--json"])
+        assert rc == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["kfledger"] == 1
+        assert payload["decisions"][0]["match"] is True
+
+
+# -- monitoring surfaces -----------------------------------------------------
+class TestAggregatorPulse:
+    def _instrumented(self):
+        agg = ClusterAggregator(stale_after=3600.0)
+        agg.ingest(make_snapshot(
+            rank=0, step=3, step_time_s=0.1,
+            gauges={"kf_gns": 4.0, "kf_grad_variance": 1.0,
+                    'kf_grad_norm{group="dense"}': 2.0}))
+        agg.ingest(make_snapshot(
+            rank=1, step=3, step_time_s=0.1,
+            gauges={"kf_gns": 6.0, "kf_grad_variance": 3.0,
+                    'kf_grad_norm{group="dense"}': 4.0}))
+        return agg
+
+    def test_cluster_rollup_means(self):
+        view = self._instrumented().cluster_view()
+        pl = field(view, "pulse")
+        assert pl["gns"] == pytest.approx(5.0)
+        assert pl["grad_variance"] == pytest.approx(2.0)
+        assert pl["groups"] == {"dense": pytest.approx(3.0)}
+
+    def test_prometheus_gauges(self):
+        prom = self._instrumented().render_prometheus()
+        assert "kf_cluster_gns 5" in prom
+        assert "kf_cluster_grad_variance 2" in prom
+
+    def test_absent_when_uninstrumented(self):
+        agg = ClusterAggregator(stale_after=3600.0)
+        agg.ingest(make_snapshot(rank=0, step=3, step_time_s=0.1))
+        view = agg.cluster_view()
+        assert field(view, "pulse") is None
+        assert "== PULSE" not in kftop.render_view(view)
+        assert "kf_cluster_gns" not in agg.render_prometheus()
+
+    def test_kftop_pulse_section(self):
+        text = kftop.render_view(self._instrumented().cluster_view())
+        assert "== PULSE" in text
+        assert "gns 5" in text
+        assert "per-rank gns: r0:4 r1:6" in text
+
+    def test_kftop_decisions_line(self, tmp_path):
+        agg, s, clock = _mesh(tmp_path)
+        s.ledger.decide("bandit-host", "strategy", "STAR", "MST")
+        _drive(agg, clock, 0, 0.1)
+        text = kftop.render_view(agg.cluster_view())
+        assert "decisions: 1 made" in text
+
+
+class TestSentinelGns:
+    def test_extract_series_gns_rollup(self):
+        view = {"ranks": [
+            {"rank": 0, "step": 5,
+             "gauges": {"kf_gns": 4.0, "kf_grad_variance": 0.5}},
+            {"rank": 1, "step": 5, "gauges": {"kf_gns": 6.0}},
+        ]}
+        s = extract_series(view)
+        assert s["gns"] == pytest.approx(5.0)
+        assert s["grad_variance"] == pytest.approx(0.5)
+        assert "gns" not in extract_series(
+            {"ranks": [{"rank": 0, "step": 5}]})
+
+    def test_planted_gns_shift_fires_regress(self, tmp_path):
+        agg, s, clock = _mesh(tmp_path)
+        for i in range(16):
+            _drive(agg, clock, i, 0.1, gauges={"kf_gns": 5.0})
+        assert s.alerts_view()["alerts"] == []
+        fired_after = None
+        for j in range(16):
+            _drive(agg, clock, 16 + j, 0.1, gauges={"kf_gns": 25.0})
+            if any(a["rule"] == "regress:gns"
+                   for a in s.alerts_view()["alerts"]):
+                fired_after = j + 1
+                break
+        assert fired_after is not None and fired_after <= 2 * s.window
+
+    def test_gns_direction_is_up_only(self, tmp_path):
+        # DIRECTIONS pins gns "up": a drop (more data-parallel headroom)
+        # is not a regression
+        agg, s, clock = _mesh(tmp_path)
+        for i in range(16):
+            _drive(agg, clock, i, 0.1, gauges={"kf_gns": 25.0})
+        for j in range(16):
+            _drive(agg, clock, 16 + j, 0.1, gauges={"kf_gns": 5.0})
+        assert "regress:gns" not in s.alerts_view()["active"]
+
+
+class TestPolicySignals:
+    def test_decisions_shape_in_signals(self, tmp_path):
+        from kungfu_tpu.policy.sentinel import sentinel_signals
+
+        agg, s, clock = _mesh(tmp_path)
+        s.ledger.decide("bandit-host", "strategy", "STAR", "MST")
+        _drive(agg, clock, 0, 0.1)
+        sig = sentinel_signals(s.alerts_view())
+        assert sig is not None
+        dec = sig["decisions"]
+        assert dec["total"] == 1 and dec["pending"] == 1
+        assert set(dec) >= {"total", "judged", "pending", "by_verdict",
+                            "last"}
+
+
+class TestDecisionsRoute:
+    @pytest.fixture
+    def server(self):
+        from kungfu_tpu.elastic.configserver import ConfigServer
+        from kungfu_tpu.plan import Cluster, PeerList
+
+        workers = PeerList.parse(
+            "127.0.0.1:27461,127.0.0.1:27462,127.0.0.1:27463")
+        cluster = Cluster(PeerList.parse("127.0.0.1:38094"), workers)
+        agg = ClusterAggregator(stale_after=60.0)
+        srv = ConfigServer(port=0, cluster=cluster, aggregator=agg).start()
+        yield srv, agg, f"http://127.0.0.1:{srv.port}"
+        srv.stop()
+
+    def test_404_then_ledger_view(self, server, tmp_path):
+        srv, agg, base = server
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/decisions", timeout=5)
+        assert ei.value.code == 404
+        s = Sentinel(str(tmp_path), window=4)
+        agg.attach_sentinel(s)
+        s.ledger.decide("bandit-host", "strategy", "STAR", "MST")
+        with urllib.request.urlopen(base + "/decisions", timeout=5) as resp:
+            payload = json.loads(resp.read().decode())
+        assert payload["kfledger"] == 1
+        assert payload["summary"]["total"] == 1
+        d = payload["decisions"][0]["decision"]
+        assert d["actor"] == "bandit-host" and d["new"] == "MST"
+
+
+# -- the acceptance chain ----------------------------------------------------
+def _mlp_arms(monkeypatch):
+    """Two zero stage-2 builds from identical init: KF_PULSE_EVERY=0
+    (bare) and =2 (instrumented)."""
+    comm = Communicator(devices=jax.devices()[:N_DEV], local_size=N_DEV)
+    rng = np.random.RandomState(0)
+    params = {"w0": jnp.asarray(rng.randn(12, 6), jnp.float32),
+              "w1": jnp.asarray(rng.randn(6, 3), jnp.float32)}
+    batch = (jnp.asarray(rng.randn(4 * N_DEV, 12), jnp.float32),
+             jnp.asarray(rng.randn(4 * N_DEV, 3), jnp.float32))
+
+    def loss_fn(p, b):
+        x, y = b
+        return jnp.mean((jnp.tanh(x @ p["w0"]) @ p["w1"] - y) ** 2)
+
+    arms = {}
+    for name, every in (("bare", "0"), ("pulse", "2")):
+        monkeypatch.setenv("KF_PULSE_EVERY", every)
+        z = zero_train_step(loss_fn, optax.adam(1e-2), comm, stage=2)
+        arms[name] = [z, z.init_params(params), z.init_opt(params)]
+    return arms, batch
+
+
+class TestZeroPulseEndToEnd:
+    def test_kf_gns_full_chain(self, monkeypatch, tmp_path):
+        """ISSUE 20 acceptance: a real zero_train_step loop measures
+        kf_gns; the gauge rides the rank snapshot to a live aggregator's
+        /cluster view, renders in kftop's PULSE section, and a planted
+        shift of the measured value trips the sentinel's regress:gns."""
+        arms, batch = _mlp_arms(monkeypatch)
+        (z_off, p_off, o_off) = arms["bare"]
+        (z_on, p_on, o_on) = arms["pulse"]
+        assert z_off.pulse is None and z_on.pulse is not None
+
+        for _ in range(4):
+            p_off, o_off, _ = z_off.step(p_off, o_off, batch)
+            p_on, o_on, _ = z_on.step(p_on, o_on, batch)
+        jax.block_until_ready((p_off, p_on))
+        # counter gate: samples at calls 2 and 4
+        assert z_on.pulse.samples == 2
+        # off steps run the bare program untouched — bitwise equal
+        for k in p_off:
+            assert np.array_equal(np.asarray(p_off[k]),
+                                  np.asarray(p_on[k])), k
+        gns = REGISTRY.snapshot().get("kf_gns")
+        assert gns is not None and math.isfinite(float(gns))
+        gns = float(gns)
+
+        # rank -> reporter -> live aggregator -> /cluster -> kftop
+        from kungfu_tpu.elastic.configserver import ConfigServer
+        from kungfu_tpu.plan import Cluster, PeerList
+
+        cluster = Cluster(PeerList.parse("127.0.0.1:38095"),
+                          PeerList.parse("127.0.0.1:27471"))
+        agg = ClusterAggregator(stale_after=60.0)
+        srv = ConfigServer(port=0, cluster=cluster,
+                           aggregator=agg).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            RankReporter(0, base + "/get", period=30.0).push_once()
+            with urllib.request.urlopen(base + "/cluster",
+                                        timeout=5) as resp:
+                view = json.loads(resp.read().decode())
+        finally:
+            srv.stop()
+        pl = field(view, "pulse")
+        assert pl is not None
+        assert pl["gns"] == pytest.approx(gns)
+        text = kftop.render_view(view)
+        assert "== PULSE" in text and "r0:" in text
+
+        # sentinel: the measured value is the baseline; a planted 5x
+        # shift must fire regress:gns
+        agg2, s, clock = _mesh(tmp_path)
+        for i in range(16):
+            _drive(agg2, clock, i, 0.1, gauges={"kf_gns": gns})
+        assert "regress:gns" not in s.alerts_view()["active"]
+        for j in range(16):
+            _drive(agg2, clock, 16 + j, 0.1,
+                   gauges={"kf_gns": gns * 5.0})
+            if "regress:gns" in s.alerts_view()["active"]:
+                break
+        assert "regress:gns" in s.alerts_view()["active"]
+
+    def test_dp_train_step_pulse(self, monkeypatch):
+        """The dp path: same monitor, same gauges, pulse attr exposed."""
+        from kungfu_tpu.optimizers import synchronous_sgd
+        from kungfu_tpu.parallel.train import dp_train_step
+
+        comm = Communicator(devices=jax.devices()[:N_DEV],
+                            local_size=N_DEV)
+        rng = np.random.RandomState(1)
+        params = {"w": jnp.asarray(rng.randn(8, 4), jnp.float32)}
+        batch = (jnp.asarray(rng.randn(2 * N_DEV, 8), jnp.float32),
+                 jnp.asarray(rng.randn(2 * N_DEV, 4), jnp.float32))
+
+        def loss_fn(p, b):
+            x, y = b
+            return jnp.mean((x @ p["w"] - y) ** 2)
+
+        monkeypatch.setenv("KF_PULSE_EVERY", "1")
+        tx = synchronous_sgd(optax.sgd(0.1), comm.axis)
+        step = dp_train_step(loss_fn, tx, comm)
+        assert step.pulse is not None and step.pulse.every == 1
+        p, o = params, tx.init(params)
+        p, o, loss = step(p, o, batch)
+        jax.block_until_ready(loss)
+        assert step.pulse.samples == 1
+        gns = REGISTRY.snapshot().get("kf_gns")
+        assert gns is not None and math.isfinite(float(gns))
+
+
+@pytest.mark.slow  # compile-heavy: a second ShardedTrainer jit program
+class TestShardedTrainerPulse:
+    def test_mixed_mesh_publishes_norms_only(self, monkeypatch):
+        """tp/sp sharding makes the two-batch GNS pair undefined — the
+        trainer must publish per-kind norms and leave kf_gns alone."""
+        from kungfu_tpu.models.transformer import TransformerConfig
+        from kungfu_tpu.parallel import MeshPlan, ShardedTrainer
+
+        monkeypatch.setenv("KF_PULSE_EVERY", "1")
+        REGISTRY.gauge("kf_gns").set(-7.0)  # sentinel value
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+            max_seq=32, causal=True, pos="rope", dtype="float32")
+        trainer = ShardedTrainer(cfg, MeshPlan(dp=2, pp=1, sp=1, tp=2))
+        assert trainer.pulse is not None
+        from kungfu_tpu.models.transformer import Transformer
+
+        params = trainer.from_transformer_params(
+            Transformer(cfg).init(jax.random.PRNGKey(0)))
+        state = {"params": params, "opt_state": trainer.tx.init(params),
+                 "step": 0}
+        rng = np.random.default_rng(0)
+        batch = (jnp.asarray(rng.integers(0, 64, (8, 32)), jnp.int32),
+                 jnp.asarray(rng.integers(0, 64, (8, 32)), jnp.int32))
+        state, loss = trainer.step(state, batch)
+        assert np.isfinite(float(loss))
+        assert trainer.pulse.samples == 0      # GNS pair undefined here
+        snap = REGISTRY.snapshot()
+        norm_keys = [k for k in snap if k.startswith('kf_grad_norm{')]
+        assert norm_keys and all(math.isfinite(snap[k])
+                                 for k in norm_keys)
+        assert snap["kf_gns"] == pytest.approx(-7.0)   # untouched
+
+    def test_pure_dp_mesh_measures_gns(self, monkeypatch):
+        from kungfu_tpu.models.transformer import (
+            Transformer,
+            TransformerConfig,
+        )
+        from kungfu_tpu.parallel import MeshPlan, ShardedTrainer
+
+        monkeypatch.setenv("KF_PULSE_EVERY", "1")
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+            max_seq=32, causal=True, pos="rope", dtype="float32")
+        trainer = ShardedTrainer(cfg, MeshPlan(dp=4, pp=1, sp=1, tp=1))
+        params = trainer.from_transformer_params(
+            Transformer(cfg).init(jax.random.PRNGKey(0)))
+        state = {"params": params, "opt_state": trainer.tx.init(params),
+                 "step": 0}
+        rng = np.random.default_rng(1)
+        batch = (jnp.asarray(rng.integers(0, 64, (8, 32)), jnp.int32),
+                 jnp.asarray(rng.integers(0, 64, (8, 32)), jnp.int32))
+        state, loss = trainer.step(state, batch)
+        assert np.isfinite(float(loss))
+        assert trainer.pulse.samples == 1
+        gns = REGISTRY.snapshot().get("kf_gns")
+        assert gns is not None and math.isfinite(float(gns))
